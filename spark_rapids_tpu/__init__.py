@@ -1,0 +1,31 @@
+"""spark_rapids_tpu: a TPU-native columnar SQL execution framework.
+
+Ground-up rebuild of the capabilities of the RAPIDS Accelerator for Apache
+Spark (reference: /root/reference, wbo4958/spark-rapids) with a TPU-first
+architecture: Arrow-style columns live in HBM as JAX arrays, expression trees
+fuse into single XLA computations, aggregation/join/sort are built from
+XLA-friendly sort + segmented-reduce primitives (plus Pallas kernels for the
+irregular parts), and distributed exchange rides ICI/DCN via jax.sharding
+collectives instead of UCX RDMA.
+"""
+import os
+
+import jax
+
+# Spark semantics require true 64-bit longs/doubles (BIGINT, DOUBLE,
+# TIMESTAMP micros, DECIMAL64 unscaled values). TPUs emulate 64-bit, so hot
+# paths stick to 32-bit types, but the engine must be *able* to carry them.
+# This flips a process-global JAX flag, like the reference plugin owning RMM
+# for the whole executor; co-resident JAX code that needs float32 defaults
+# can opt out with SPARK_RAPIDS_TPU_NO_X64=1 (the engine then rejects
+# LongType/DoubleType columns at type-check time instead).
+if not os.environ.get("SPARK_RAPIDS_TPU_NO_X64"):
+    jax.config.update("jax_enable_x64", True)
+
+X64_ENABLED = jax.config.jax_enable_x64
+
+from . import types  # noqa: E402,F401
+from .conf import RapidsConf  # noqa: E402,F401
+from .columnar import ColumnarBatch, DeviceColumn, HostColumn  # noqa: E402,F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,2 @@
+from .column import DeviceColumn, HostColumn, column_from_pylist, string_column_from_parts  # noqa: F401
+from .batch import ColumnarBatch, batch_from_rows, schema_of  # noqa: F401

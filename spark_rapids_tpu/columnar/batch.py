@@ -1,0 +1,96 @@
+"""ColumnarBatch: an ordered set of device columns sharing a row count.
+
+Reference analog: cudf ``Table`` + Spark ``ColumnarBatch`` as bridged by
+GpuColumnVector.from(Table) (GpuColumnVector.java:330-420). Here the batch IS
+the table; schema travels with it so operators can type-check lazily.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from ..types import DataType, StructField, StructType
+from .column import DeviceColumn, column_from_pylist
+
+
+class ColumnarBatch:
+    __slots__ = ("columns", "schema", "_num_rows")
+
+    def __init__(self, columns: Sequence[DeviceColumn], schema: StructType,
+                 num_rows: Optional[int] = None):
+        self.columns: List[DeviceColumn] = list(columns)
+        self.schema = schema
+        if num_rows is None:
+            num_rows = int(columns[0].length) if columns else 0
+        self._num_rows = num_rows
+        for c in self.columns:
+            if int(c.length) != num_rows:
+                raise ValueError(
+                    f"column row count {int(c.length)} != batch rows {num_rows}"
+                )
+
+    @property
+    def num_rows(self) -> int:
+        return int(self._num_rows)
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, i: int) -> DeviceColumn:
+        return self.columns[i]
+
+    def column_by_name(self, name: str) -> DeviceColumn:
+        return self.columns[self.schema.field_index(name)]
+
+    def device_memory_size(self) -> int:
+        return sum(c.device_memory_size() for c in self.columns)
+
+    def select(self, indices: Iterable[int]) -> "ColumnarBatch":
+        idx = list(indices)
+        return ColumnarBatch(
+            [self.columns[i] for i in idx],
+            StructType(tuple(self.schema.fields[i] for i in idx)),
+            self.num_rows,
+        )
+
+    # -- host interchange -------------------------------------------------
+    @staticmethod
+    def from_pydict(data: Dict[str, Sequence[Any]], schema: StructType) -> "ColumnarBatch":
+        cols = []
+        n = None
+        for f in schema.fields:
+            values = data[f.name]
+            if n is None:
+                n = len(values)
+            cols.append(column_from_pylist(values, f.dataType))
+        return ColumnarBatch(cols, schema, n or 0)
+
+    def to_pydict(self) -> Dict[str, List[Any]]:
+        return {
+            f.name: c.to_pylist() for f, c in zip(self.schema.fields, self.columns)
+        }
+
+    def to_rows(self) -> List[tuple]:
+        """Columnar-to-row boundary (reference: GpuColumnarToRowExec.scala:38)."""
+        cols = [c.to_pylist() for c in self.columns]
+        return list(zip(*cols)) if cols else [() for _ in range(self.num_rows)]
+
+    def __repr__(self):
+        names = ",".join(f.name for f in self.schema.fields)
+        return f"ColumnarBatch(rows={self.num_rows}, cols=[{names}])"
+
+
+def schema_of(**kwargs: DataType) -> StructType:
+    return StructType(tuple(StructField(k, v) for k, v in kwargs.items()))
+
+
+def batch_from_rows(rows: Sequence[Sequence[Any]], schema: StructType) -> ColumnarBatch:
+    """Row-to-columnar transition (reference: GpuRowToColumnarExec.scala:37)."""
+    data: Dict[str, List[Any]] = {f.name: [] for f in schema.fields}
+    width = len(schema.fields)
+    for i, row in enumerate(rows):
+        if len(row) != width:
+            raise ValueError(f"row {i} has {len(row)} values, schema has {width}")
+        for f, v in zip(schema.fields, row):
+            data[f.name].append(v)
+    return ColumnarBatch.from_pydict(data, schema)

@@ -1,0 +1,215 @@
+"""Device-resident Arrow-style columns over JAX arrays.
+
+TPU re-design of the reference's columnar data layer
+(sql-plugin/src/main/java/.../GpuColumnVector.java:40 — Spark ColumnVector
+facade over a cudf device column; RapidsHostColumnVector for the host mirror).
+There is no cudf on TPU, so the column itself is the primitive:
+
+  * fixed-width column: ``data``  (capacity,) jnp array of the storage dtype
+                        ``validity`` (capacity,) bool, True = non-null
+  * string column:      ``offsets`` (capacity+1,) int32 into ``chars`` (uint8)
+                        + validity — classic Arrow layout so Pallas/XLA
+                        kernels can gather bytes with static shapes.
+
+``capacity`` (the physical array length) is a power-of-two bucket >= the
+logical ``length`` so XLA executables are reused across ragged batch sizes
+(see utils/bucketing.py). Padding slots always hold validity=False and
+zeroed data, making masked reductions well-defined without NaN poison.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import (
+    DataType,
+    NullType,
+    STRING,
+    BinaryType,
+    StringType,
+)
+from ..utils.bucketing import bucket_rows
+
+
+def _np_storage(dt: DataType) -> np.dtype:
+    return dt.to_numpy()
+
+
+@dataclasses.dataclass
+class HostColumn:
+    """Host mirror of a device column (reference: RapidsHostColumnVector.java).
+
+    ``data`` is a numpy array for fixed-width types; for strings/binary it is
+    an object ndarray of ``str``/``bytes`` (or None). ``validity`` is a bool
+    ndarray, True = valid.
+    """
+
+    dtype: DataType
+    data: np.ndarray
+    validity: np.ndarray
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    @staticmethod
+    def from_pylist(values: Sequence[Any], dtype: DataType) -> "HostColumn":
+        n = len(values)
+        validity = np.array([v is not None for v in values], dtype=bool)
+        if isinstance(dtype, (StringType, BinaryType)):
+            data = np.empty(n, dtype=object)
+            for i, v in enumerate(values):
+                data[i] = v
+        elif isinstance(dtype, NullType):
+            data = np.zeros(n, dtype=bool)
+            validity = np.zeros(n, dtype=bool)
+        else:
+            storage = _np_storage(dtype)
+            data = np.zeros(n, dtype=storage)
+            for i, v in enumerate(values):
+                if v is not None:
+                    data[i] = v
+        return HostColumn(dtype, data, validity)
+
+    def to_pylist(self) -> List[Any]:
+        out: List[Any] = []
+        for i in range(len(self.data)):
+            if not self.validity[i]:
+                out.append(None)
+            else:
+                v = self.data[i]
+                if isinstance(v, np.generic):
+                    v = v.item()
+                out.append(v)
+        return out
+
+    def to_device(self, capacity: Optional[int] = None) -> "DeviceColumn":
+        return DeviceColumn.from_host(self, capacity)
+
+
+class DeviceColumn:
+    """A TPU-resident column (reference: GpuColumnVector.java facade role)."""
+
+    __slots__ = ("dtype", "length", "data", "validity", "offsets", "chars")
+
+    def __init__(
+        self,
+        dtype: DataType,
+        length,
+        data: Optional[jax.Array],
+        validity: jax.Array,
+        offsets: Optional[jax.Array] = None,
+        chars: Optional[jax.Array] = None,
+    ):
+        self.dtype = dtype
+        self.length = length  # logical rows; python int at batch boundaries
+        self.data = data
+        self.validity = validity
+        self.offsets = offsets
+        self.chars = chars
+
+    # -- construction -----------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        if self.is_string:
+            return int(self.offsets.shape[0]) - 1
+        return int(self.validity.shape[0])
+
+    @property
+    def is_string(self) -> bool:
+        return isinstance(self.dtype, (StringType, BinaryType))
+
+    @staticmethod
+    def from_host(host: HostColumn, capacity: Optional[int] = None) -> "DeviceColumn":
+        n = len(host)
+        cap = capacity or bucket_rows(n)
+        if cap < n:
+            raise ValueError(f"capacity {cap} < row count {n}")
+        validity = np.zeros(cap, dtype=bool)
+        validity[:n] = host.validity
+        if isinstance(host.dtype, (StringType, BinaryType)):
+            encoded: List[bytes] = []
+            for i in range(n):
+                v = host.data[i]
+                if v is None or not host.validity[i]:
+                    encoded.append(b"")
+                elif isinstance(v, bytes):
+                    encoded.append(v)
+                else:
+                    encoded.append(str(v).encode("utf-8"))
+            offsets = np.zeros(cap + 1, dtype=np.int32)
+            sizes = np.array([len(b) for b in encoded] + [0] * (cap - n), dtype=np.int32)
+            np.cumsum(sizes, out=offsets[1:])
+            total = int(offsets[n]) if n else 0
+            char_cap = bucket_rows(max(total, 1), min_bucket=128)
+            chars = np.zeros(char_cap, dtype=np.uint8)
+            if total:
+                chars[:total] = np.frombuffer(b"".join(encoded), dtype=np.uint8)
+            return DeviceColumn(
+                host.dtype, n, None,
+                jnp.asarray(validity),
+                offsets=jnp.asarray(offsets),
+                chars=jnp.asarray(chars),
+            )
+        storage = _np_storage(host.dtype) if not isinstance(host.dtype, NullType) else np.bool_
+        data = np.zeros(cap, dtype=storage)
+        data[:n] = np.where(host.validity, host.data, np.zeros(1, dtype=storage))
+        return DeviceColumn(host.dtype, n, jnp.asarray(data), jnp.asarray(validity))
+
+    # -- host readback ----------------------------------------------------
+    def to_host(self) -> HostColumn:
+        n = int(self.length)
+        validity = np.asarray(jax.device_get(self.validity))[:n]
+        if self.is_string:
+            offsets = np.asarray(jax.device_get(self.offsets))
+            chars = np.asarray(jax.device_get(self.chars))
+            data = np.empty(n, dtype=object)
+            raw = chars.tobytes()
+            for i in range(n):
+                if validity[i]:
+                    b = raw[int(offsets[i]) : int(offsets[i + 1])]
+                    data[i] = b if isinstance(self.dtype, BinaryType) else b.decode("utf-8")
+                else:
+                    data[i] = None
+            return HostColumn(self.dtype, data, validity)
+        data = np.asarray(jax.device_get(self.data))[:n].copy()
+        return HostColumn(self.dtype, data, validity)
+
+    def to_pylist(self) -> List[Any]:
+        return self.to_host().to_pylist()
+
+    # -- stats ------------------------------------------------------------
+    def null_count(self) -> int:
+        n = int(self.length)
+        return n - int(jnp.sum(self.validity[:n].astype(jnp.int32)))
+
+    def device_memory_size(self) -> int:
+        total = self.validity.size * self.validity.dtype.itemsize
+        if self.is_string:
+            total += self.offsets.size * 4 + self.chars.size
+        elif self.data is not None:
+            total += self.data.size * self.data.dtype.itemsize
+        return int(total)
+
+    def __repr__(self):
+        return (
+            f"DeviceColumn({self.dtype}, rows={self.length}, "
+            f"cap={self.capacity})"
+        )
+
+
+def column_from_pylist(values: Sequence[Any], dtype: DataType) -> DeviceColumn:
+    return HostColumn.from_pylist(values, dtype).to_device()
+
+
+def string_column_from_parts(
+    length,
+    offsets: jax.Array,
+    chars: jax.Array,
+    validity: jax.Array,
+    dtype: DataType = STRING,
+) -> DeviceColumn:
+    return DeviceColumn(dtype, length, None, validity, offsets=offsets, chars=chars)

@@ -1,0 +1,283 @@
+"""Typed configuration system (`spark.rapids.tpu.*`).
+
+Re-creation of the reference's RapidsConf (sql-plugin/.../RapidsConf.scala:120-160
+entry builders; ~90 keys at :282-814; markdown generator at :838): every tunable
+is a registered, documented, validated entry; `RapidsConf.help()` generates the
+user-facing configs doc.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+
+_REGISTRY: Dict[str, "ConfEntry"] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+@dataclasses.dataclass
+class ConfEntry:
+    key: str
+    default: Any
+    doc: str
+    conf_type: type
+    internal: bool = False
+    check: Optional[Callable[[Any], Optional[str]]] = None
+    valid_values: Optional[Sequence[Any]] = None
+
+    def convert(self, raw: Any) -> Any:
+        if raw is None:
+            return self.default
+        if self.conf_type is bool:
+            if isinstance(raw, bool):
+                v: Any = raw
+            else:
+                s = str(raw).strip().lower()
+                if s not in ("true", "false"):
+                    raise ValueError(f"{self.key}: expected boolean, got {raw!r}")
+                v = s == "true"
+        elif self.conf_type in (int, float):
+            v = self.conf_type(raw)
+        else:
+            v = str(raw)
+        if self.valid_values is not None and v not in self.valid_values:
+            raise ValueError(
+                f"{self.key}: {v!r} not in allowed values {list(self.valid_values)}"
+            )
+        if self.check is not None:
+            err = self.check(v)
+            if err:
+                raise ValueError(f"{self.key}: {err}")
+        return v
+
+
+def _register(entry: ConfEntry) -> ConfEntry:
+    with _REGISTRY_LOCK:
+        if entry.key in _REGISTRY:
+            raise ValueError(f"duplicate conf key {entry.key}")
+        _REGISTRY[entry.key] = entry
+    return entry
+
+
+def conf(key, default, doc, conf_type=None, internal=False, check=None, valid_values=None):
+    if conf_type is None:
+        conf_type = type(default) if default is not None else str
+    return _register(ConfEntry(key, default, doc, conf_type, internal, check, valid_values))
+
+
+def _positive(v):
+    return None if v > 0 else "must be positive"
+
+
+def _fraction(v):
+    return None if 0.0 <= v <= 1.0 else "must be in [0, 1]"
+
+
+# ---------------------------------------------------------------------------
+# General (reference: RapidsConf.scala:282-450)
+# ---------------------------------------------------------------------------
+SQL_ENABLED = conf(
+    "spark.rapids.tpu.sql.enabled", True,
+    "Enable or disable TPU acceleration of SQL operators entirely.")
+EXPLAIN = conf(
+    "spark.rapids.tpu.sql.explain", "NONE",
+    "Explain why parts of a query were or were not placed on the TPU. "
+    "NONE/ALL/NOT_ON_TPU.", valid_values=("NONE", "ALL", "NOT_ON_TPU"))
+INCOMPATIBLE_OPS = conf(
+    "spark.rapids.tpu.sql.incompatibleOps.enabled", False,
+    "Enable operators that produce results slightly different from Spark "
+    "(e.g. float aggregation ordering).")
+IMPROVED_FLOAT_OPS = conf(
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled", False,
+    "Allow floating-point aggregations whose result may differ in "
+    "last-ulp ordering from CPU Spark.")
+HAS_NANS = conf(
+    "spark.rapids.tpu.sql.hasNans", True,
+    "Assume columns may contain NaNs; disables some fast paths when true.")
+ENABLE_FLOAT_ROUND_TRIP = conf(
+    "spark.rapids.tpu.sql.castFloatToString.enabled", False,
+    "Casting floats to string may differ in tie-breaking digits from Java's "
+    "formatting; enable if acceptable.")
+ENABLE_CAST_STRING_TO_FLOAT = conf(
+    "spark.rapids.tpu.sql.castStringToFloat.enabled", False,
+    "String-to-float casts can differ in last-ulp from Spark.")
+ENABLE_CAST_STRING_TO_TIMESTAMP = conf(
+    "spark.rapids.tpu.sql.castStringToTimestamp.enabled", False,
+    "String-to-timestamp casts support a subset of formats.")
+DECIMAL_ENABLED = conf(
+    "spark.rapids.tpu.sql.decimalType.enabled", True,
+    "Enable DECIMAL(<=18) columns on the TPU (stored as int64 unscaled).")
+UDF_COMPILER_ENABLED = conf(
+    "spark.rapids.tpu.sql.udfCompiler.enabled", False,
+    "Compile Python scalar UDF bytecode into engine expression trees "
+    "(analog of the reference's JVM-bytecode udf-compiler).")
+REPLACE_SORT_MERGE_JOIN = conf(
+    "spark.rapids.tpu.sql.replaceSortMergeJoin.enabled", True,
+    "Replace sort-merge joins with TPU hash joins (reference: RapidsConf.scala:476).")
+ENABLE_HASH_PARTIAL_AGG = conf(
+    "spark.rapids.tpu.sql.hashAgg.replaceMode", "all",
+    "Which aggregation modes to replace: all/partial/final.",
+    valid_values=("all", "partial", "final"))
+STABLE_SORT = conf(
+    "spark.rapids.tpu.sql.stableSort.enabled", True,
+    "Use stable sorts so row order matches CPU Spark for equal keys.")
+MAX_READER_BATCH_SIZE_ROWS = conf(
+    "spark.rapids.tpu.sql.reader.batchSizeRows", 1 << 20,
+    "Soft cap on rows per batch produced by scans.", check=_positive)
+MAX_READER_BATCH_SIZE_BYTES = conf(
+    "spark.rapids.tpu.sql.reader.batchSizeBytes", 2147483647,
+    "Soft cap on bytes per batch produced by scans.", check=_positive)
+TPU_BATCH_SIZE_BYTES = conf(
+    "spark.rapids.tpu.sql.batchSizeBytes", 1 << 31,
+    "Target batch size for coalescing (reference: RapidsConf.scala:372).",
+    check=_positive)
+SHAPE_BUCKET_MIN = conf(
+    "spark.rapids.tpu.sql.shapeBucket.minRows", 128,
+    "Row counts are padded up to power-of-two buckets >= this to bound XLA "
+    "recompilation (TPU-specific; no reference analog).", check=_positive)
+CONCURRENT_TPU_TASKS = conf(
+    "spark.rapids.tpu.sql.concurrentTpuTasks", 1,
+    "Number of tasks that may hold the TPU concurrently "
+    "(reference GpuSemaphore: GpuSemaphore.scala:27-66).", check=_positive)
+ENABLE_TRACE = conf(
+    "spark.rapids.tpu.sql.trace.enabled", False,
+    "Wrap operator hot sections in jax.profiler TraceAnnotations "
+    "(reference: NvtxWithMetrics.scala).")
+
+# ---------------------------------------------------------------------------
+# Memory (reference: RapidsConf.scala:200-340, GpuDeviceManager.scala:160-271)
+# ---------------------------------------------------------------------------
+HBM_POOL_FRACTION = conf(
+    "spark.rapids.tpu.memory.hbm.allocFraction", 0.9,
+    "Fraction of HBM to consider available to the pool.", check=_fraction)
+HBM_RESERVE = conf(
+    "spark.rapids.tpu.memory.hbm.reserve", 1 << 28,
+    "Bytes of HBM to hold back from the pool for XLA scratch.", check=_positive)
+HOST_SPILL_STORAGE_SIZE = conf(
+    "spark.rapids.tpu.memory.host.spillStorageSize", 1 << 30,
+    "Bytes of host memory for spilled buffers before going to disk.",
+    check=_positive)
+SPILL_ENABLED = conf(
+    "spark.rapids.tpu.memory.spill.enabled", True,
+    "Enable tiered DEVICE->HOST->DISK spill of cached batches.")
+MEMORY_DEBUG = conf(
+    "spark.rapids.tpu.memory.debug", False,
+    "Log allocation/spill events (reference: spark.rapids.memory.gpu.debug).")
+
+# ---------------------------------------------------------------------------
+# Shuffle (reference: RapidsConf.scala:687-786)
+# ---------------------------------------------------------------------------
+SHUFFLE_TRANSPORT_CLASS = conf(
+    "spark.rapids.tpu.shuffle.transport.class", "ici",
+    "Transport for exchange data: 'ici' (mesh all-to-all collectives) or "
+    "'host' (serialized host bytes).", valid_values=("ici", "host"))
+SHUFFLE_COMPRESSION_CODEC = conf(
+    "spark.rapids.tpu.shuffle.compression.codec", "none",
+    "Codec for host-path shuffle payloads: none/lz4/copy.",
+    valid_values=("none", "lz4", "copy"))
+SHUFFLE_PARTITIONING_MAX_PARTITIONS = conf(
+    "spark.rapids.tpu.shuffle.maxPartitions", 1 << 16,
+    "Upper bound on shuffle partitions.", check=_positive)
+SHUFFLE_BOUNCE_BUFFER_SIZE = conf(
+    "spark.rapids.tpu.shuffle.bounceBuffers.size", 4 << 20,
+    "Host staging-buffer size for the host transport path.", check=_positive)
+
+# ---------------------------------------------------------------------------
+# IO (reference: RapidsConf.scala:546-665)
+# ---------------------------------------------------------------------------
+PARQUET_ENABLED = conf(
+    "spark.rapids.tpu.sql.format.parquet.enabled", True,
+    "Enable TPU parquet scan/write.")
+PARQUET_READER_TYPE = conf(
+    "spark.rapids.tpu.sql.format.parquet.reader.type", "AUTO",
+    "PERFILE, COALESCING, MULTITHREADED or AUTO (reference: RapidsConf.scala:546).",
+    valid_values=("AUTO", "PERFILE", "COALESCING", "MULTITHREADED"))
+PARQUET_MULTITHREAD_READ_NUM_THREADS = conf(
+    "spark.rapids.tpu.sql.format.parquet.multiThreadedRead.numThreads", 4,
+    "Threads for the cloud multithreaded reader.", check=_positive)
+CLOUD_SCHEMES = conf(
+    "spark.rapids.tpu.cloudSchemes", "abfs,abfss,dbfs,gs,s3,s3a,s3n,wasbs",
+    "URI schemes treated as high-latency cloud stores.")
+CSV_ENABLED = conf(
+    "spark.rapids.tpu.sql.format.csv.enabled", True, "Enable TPU CSV scan.")
+ORC_ENABLED = conf(
+    "spark.rapids.tpu.sql.format.orc.enabled", False,
+    "ORC support (not yet implemented; scans fall back to CPU).")
+
+# ---------------------------------------------------------------------------
+# Test hooks (reference: RapidsConf 'test' keys)
+# ---------------------------------------------------------------------------
+TEST_CONF = conf(
+    "spark.rapids.tpu.sql.test.enabled", False,
+    "Fail instead of falling back to CPU when an operator is unsupported.",
+    internal=True)
+TEST_ALLOWED_NONTPU = conf(
+    "spark.rapids.tpu.sql.test.allowedNonTpu", "",
+    "Comma-separated operator class names allowed to stay on CPU when "
+    "test.enabled is set.", internal=True)
+
+
+class RapidsConf:
+    """Immutable snapshot of settings; unknown keys rejected, typed access."""
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        settings = dict(settings or {})
+        self._values: Dict[str, Any] = {}
+        for key, raw in settings.items():
+            entry = _REGISTRY.get(key)
+            if entry is None:
+                if key.startswith("spark.rapids.tpu."):
+                    raise ValueError(f"unknown config key {key}")
+                continue  # ignore non-rapids keys, like the reference does
+            self._values[key] = entry.convert(raw)
+
+    def get(self, entry: ConfEntry):
+        return self._values.get(entry.key, entry.default)
+
+    def __getitem__(self, entry: ConfEntry):
+        return self.get(entry)
+
+    # Convenience accessors mirroring RapidsConf's vals
+    @property
+    def is_sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED)
+
+    @property
+    def explain(self) -> str:
+        return self.get(EXPLAIN)
+
+    @property
+    def batch_size_bytes(self) -> int:
+        return self.get(TPU_BATCH_SIZE_BYTES)
+
+    @property
+    def concurrent_tpu_tasks(self) -> int:
+        return self.get(CONCURRENT_TPU_TASKS)
+
+    @property
+    def is_test_enabled(self) -> bool:
+        return self.get(TEST_CONF)
+
+    @property
+    def shape_bucket_min(self) -> int:
+        return self.get(SHAPE_BUCKET_MIN)
+
+    @staticmethod
+    def entries() -> List[ConfEntry]:
+        return sorted(_REGISTRY.values(), key=lambda e: e.key)
+
+    @staticmethod
+    def help(include_internal: bool = False) -> str:
+        """Generate the configs markdown doc (reference: RapidsConf.scala:838)."""
+        lines = [
+            "# TPU RAPIDS Configuration",
+            "",
+            "| Name | Description | Default |",
+            "|------|-------------|---------|",
+        ]
+        for e in RapidsConf.entries():
+            if e.internal and not include_internal:
+                continue
+            lines.append(f"| {e.key} | {e.doc} | {e.default} |")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,352 @@
+"""SQL type system for the TPU-native columnar engine.
+
+Mirrors the Spark SQL type lattice the reference plugin supports
+(reference: sql-plugin/.../TypeChecks.scala:453, GpuOverrides.scala:531-576 —
+decimal limited to 64-bit, timestamps UTC-only), re-expressed as a small
+Python hierarchy that maps each SQL type onto a TPU-resident JAX dtype:
+
+  * fixed-width types -> one jnp array (data) + bool validity
+  * StringType        -> int32 offsets + uint8 byte pool + bool validity
+  * DecimalType(p<=18)-> int64 unscaled values (DECIMAL64, like the reference)
+  * DateType          -> int32 days since epoch
+  * TimestampType     -> int64 microseconds since epoch, UTC only
+
+Design note (TPU-first): everything is kept in dtypes XLA tiles well.
+float64/int64 are emulated on TPU but required for Spark semantics
+(DoubleType / LongType); hot paths should prefer 32-bit types.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+class DataType:
+    """Base of all SQL types. Instances are value objects."""
+
+    #: short name used in schemas / docs (overridden per type)
+    name: str = "data"
+
+    def __eq__(self, other):
+        return type(self) is type(other)
+
+    def __hash__(self):
+        return hash(type(self))
+
+    def __repr__(self):
+        return self.name
+
+    @property
+    def simpleString(self) -> str:
+        return self.name
+
+    def to_numpy(self) -> np.dtype:
+        raise NotImplementedError(self.name)
+
+    @property
+    def is_numeric(self) -> bool:
+        return isinstance(
+            self,
+            (ByteType, ShortType, IntegerType, LongType, FloatType, DoubleType, DecimalType),
+        )
+
+    @property
+    def is_integral(self) -> bool:
+        return isinstance(self, (ByteType, ShortType, IntegerType, LongType))
+
+    @property
+    def is_floating(self) -> bool:
+        return isinstance(self, (FloatType, DoubleType))
+
+    @property
+    def default_size(self) -> int:
+        """Approximate bytes per value, for batch-size accounting
+        (reference: GpuBatchUtils.scala size estimation)."""
+        return np.dtype(self.to_numpy()).itemsize
+
+
+class NullType(DataType):
+    name = "null"
+
+    def to_numpy(self):
+        return np.dtype(np.bool_)
+
+
+class BooleanType(DataType):
+    name = "boolean"
+
+    def to_numpy(self):
+        return np.dtype(np.bool_)
+
+
+class ByteType(DataType):
+    name = "tinyint"
+
+    def to_numpy(self):
+        return np.dtype(np.int8)
+
+
+class ShortType(DataType):
+    name = "smallint"
+
+    def to_numpy(self):
+        return np.dtype(np.int16)
+
+
+class IntegerType(DataType):
+    name = "int"
+
+    def to_numpy(self):
+        return np.dtype(np.int32)
+
+
+class LongType(DataType):
+    name = "bigint"
+
+    def to_numpy(self):
+        return np.dtype(np.int64)
+
+
+class FloatType(DataType):
+    name = "float"
+
+    def to_numpy(self):
+        return np.dtype(np.float32)
+
+
+class DoubleType(DataType):
+    name = "double"
+
+    def to_numpy(self):
+        return np.dtype(np.float64)
+
+
+class StringType(DataType):
+    name = "string"
+
+    def to_numpy(self):
+        # host-side representation is a numpy object array of str (or None)
+        return np.dtype(object)
+
+    @property
+    def default_size(self) -> int:
+        return 16
+
+
+class BinaryType(DataType):
+    name = "binary"
+
+    def to_numpy(self):
+        return np.dtype(object)
+
+    @property
+    def default_size(self) -> int:
+        return 16
+
+
+class DateType(DataType):
+    """Days since unix epoch, int32 (Spark semantics)."""
+
+    name = "date"
+
+    def to_numpy(self):
+        return np.dtype(np.int32)
+
+
+class TimestampType(DataType):
+    """Microseconds since unix epoch, int64, UTC only (the reference rejects
+    non-UTC sessions: GpuOverrides.scala:562-564)."""
+
+    name = "timestamp"
+
+    def to_numpy(self):
+        return np.dtype(np.int64)
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalType(DataType):
+    """DECIMAL64: precision <= 18 stored as int64 unscaled values.
+
+    The reference caps GPU decimals at DECIMAL64 (GpuOverrides.scala:562);
+    we adopt the identical cap for the TPU engine.
+    """
+
+    precision: int = 10
+    scale: int = 0
+
+    MAX_PRECISION = 18
+
+    def __post_init__(self):
+        if not (0 < self.precision <= self.MAX_PRECISION):
+            raise ValueError(f"precision {self.precision} outside (0, 18]")
+        if not (0 <= self.scale <= self.precision):
+            raise ValueError(f"scale {self.scale} outside [0, precision]")
+
+    @property
+    def name(self):  # type: ignore[override]
+        return f"decimal({self.precision},{self.scale})"
+
+    def __repr__(self):
+        return self.name
+
+    def to_numpy(self):
+        return np.dtype(np.int64)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, DecimalType)
+            and other.precision == self.precision
+            and other.scale == self.scale
+        )
+
+    def __hash__(self):
+        return hash((DecimalType, self.precision, self.scale))
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrayType(DataType):
+    element_type: DataType = dataclasses.field(default_factory=IntegerType)
+    contains_null: bool = True
+
+    @property
+    def name(self):  # type: ignore[override]
+        return f"array<{self.element_type.simpleString}>"
+
+    def __repr__(self):
+        return self.name
+
+    def to_numpy(self):
+        return np.dtype(object)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ArrayType)
+            and other.element_type == self.element_type
+            and other.contains_null == self.contains_null
+        )
+
+    def __hash__(self):
+        return hash((ArrayType, self.element_type, self.contains_null))
+
+    @property
+    def default_size(self) -> int:
+        return 4 * self.element_type.default_size
+
+
+@dataclasses.dataclass(frozen=True)
+class StructField:
+    name: str
+    dataType: DataType
+    nullable: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class StructType(DataType):
+    fields: tuple = ()
+
+    @property
+    def name(self):  # type: ignore[override]
+        inner = ",".join(f"{f.name}:{f.dataType.simpleString}" for f in self.fields)
+        return f"struct<{inner}>"
+
+    def __repr__(self):
+        return self.name
+
+    def to_numpy(self):
+        return np.dtype(object)
+
+    def __eq__(self, other):
+        return isinstance(other, StructType) and other.fields == self.fields
+
+    def __hash__(self):
+        return hash((StructType, self.fields))
+
+    def __len__(self):
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def field_index(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def add(self, name: str, dt: DataType, nullable: bool = True) -> "StructType":
+        return StructType(self.fields + (StructField(name, dt, nullable),))
+
+    @property
+    def names(self):
+        return [f.name for f in self.fields]
+
+
+# Canonical singletons (Spark-style)
+NULL = NullType()
+BOOLEAN = BooleanType()
+BYTE = ByteType()
+SHORT = ShortType()
+INT = IntegerType()
+LONG = LongType()
+FLOAT = FloatType()
+DOUBLE = DoubleType()
+STRING = StringType()
+BINARY = BinaryType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+
+_BY_NAME = {
+    t.name: t
+    for t in (NULL, BOOLEAN, BYTE, SHORT, INT, LONG, FLOAT, DOUBLE, STRING, BINARY, DATE, TIMESTAMP)
+}
+_BY_NAME.update({"integer": INT, "long": LONG, "short": SHORT, "byte": BYTE, "bool": BOOLEAN})
+
+
+def type_from_name(name: str) -> DataType:
+    name = name.strip().lower()
+    if name.startswith("decimal"):
+        if "(" in name:
+            inner = name[name.index("(") + 1 : name.rindex(")")]
+            p, s = (int(x) for x in inner.split(","))
+            return DecimalType(p, s)
+        return DecimalType()
+    try:
+        return _BY_NAME[name]
+    except KeyError:
+        raise ValueError(f"unknown SQL type name: {name!r}") from None
+
+
+#: numeric widening lattice used by binary-expression type coercion
+_PROMOTION_ORDER = ["tinyint", "smallint", "int", "bigint", "float", "double"]
+
+
+def promote(a: DataType, b: DataType) -> DataType:
+    """Smallest common numeric type (Spark's findTightestCommonType, simplified)."""
+    if a == b:
+        return a
+    if isinstance(a, DecimalType) and isinstance(b, DecimalType):
+        # Spark's DecimalPrecision widening with precision-overflow handling:
+        # keep integer digits, shed fractional digits (down to a floor) when
+        # the combined precision exceeds DECIMAL64. Never silently drop
+        # integer digits — overflow there must surface as a planning error.
+        scale = max(a.scale, b.scale)
+        intd = max(a.precision - a.scale, b.precision - b.scale)
+        if intd + scale > DecimalType.MAX_PRECISION:
+            min_scale = min(scale, 6)
+            scale = max(DecimalType.MAX_PRECISION - intd, min_scale)
+            if intd + scale > DecimalType.MAX_PRECISION:
+                raise TypeError(
+                    f"decimal promotion of {a} and {b} needs {intd} integer "
+                    f"digits + {scale} fractional > DECIMAL64 capacity 18"
+                )
+        return DecimalType(intd + scale, scale)
+    if a.is_numeric and b.is_numeric and not isinstance(a, DecimalType) and not isinstance(b, DecimalType):
+        ia, ib = _PROMOTION_ORDER.index(a.name), _PROMOTION_ORDER.index(b.name)
+        return type_from_name(_PROMOTION_ORDER[max(ia, ib)])
+    raise TypeError(f"cannot promote {a} with {b}")
+
+
+def is_fixed_width(dt: DataType) -> bool:
+    return not isinstance(dt, (StringType, BinaryType, ArrayType, StructType, NullType))

@@ -1,0 +1,2 @@
+from .bucketing import round_up_pow2, bucket_rows  # noqa: F401
+from .arm import with_resource, close_on_except, safe_close  # noqa: F401

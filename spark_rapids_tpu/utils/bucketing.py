@@ -1,0 +1,21 @@
+"""Shape-bucketing helpers.
+
+TPU-specific design: XLA compiles one executable per distinct shape, so ragged
+SQL batch sizes are padded up to power-of-two buckets. This bounds the number
+of compilations at log2(max_rows) per (operator, schema) while wasting at most
+2x FLOPs/bandwidth on the padded tail. The reference never needed this because
+cuDF kernels take dynamic sizes; on TPU this bucketing IS the dynamic-shape
+story (SURVEY.md 'hardest parts' #2).
+"""
+from __future__ import annotations
+
+
+def round_up_pow2(n: int) -> int:
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def bucket_rows(n: int, min_bucket: int = 128) -> int:
+    """Capacity bucket for a logical row count."""
+    return max(min_bucket, round_up_pow2(n))

@@ -1,0 +1,16 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+Multi-chip hardware is not available in CI; sharding/collective paths are
+validated on a virtual device mesh exactly as the driver's dryrun does.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,103 @@
+"""Columnar core unit tests (reference tier-1 analog: GpuBatchUtilsSuite etc.)."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import (
+    ColumnarBatch,
+    DeviceColumn,
+    HostColumn,
+    batch_from_rows,
+    column_from_pylist,
+    schema_of,
+)
+from spark_rapids_tpu.utils import bucket_rows, round_up_pow2
+
+
+def test_bucketing():
+    assert round_up_pow2(1) == 1
+    assert round_up_pow2(2) == 2
+    assert round_up_pow2(3) == 4
+    assert round_up_pow2(1000) == 1024
+    assert bucket_rows(5) == 128
+    assert bucket_rows(300) == 512
+
+
+@pytest.mark.parametrize(
+    "dtype,values",
+    [
+        (T.INT, [1, None, 3, -7]),
+        (T.LONG, [2**40, None, -(2**40)]),
+        (T.DOUBLE, [1.5, None, float("inf"), -0.0]),
+        (T.FLOAT, [1.25, None, 3.5]),
+        (T.BOOLEAN, [True, False, None]),
+        (T.BYTE, [1, -128, None]),
+        (T.SHORT, [300, None, -300]),
+        (T.DATE, [18000, None]),
+        (T.TIMESTAMP, [1_600_000_000_000_000, None]),
+    ],
+)
+def test_fixed_width_roundtrip(dtype, values):
+    col = column_from_pylist(values, dtype)
+    assert col.to_pylist() == values
+    assert col.capacity >= len(values)
+    assert col.null_count() == sum(1 for v in values if v is None)
+
+
+def test_string_roundtrip():
+    values = ["hello", None, "", "wörld", "a" * 300]
+    col = column_from_pylist(values, T.STRING)
+    assert col.to_pylist() == values
+    assert col.is_string
+    assert col.null_count() == 1
+
+
+def test_binary_roundtrip():
+    values = [b"\x00\x01", None, b""]
+    col = column_from_pylist(values, T.BINARY)
+    assert col.to_pylist() == values
+
+
+def test_decimal_storage():
+    dt = T.DecimalType(10, 2)
+    col = column_from_pylist([12345, None, -99], dt)  # unscaled int64 values
+    assert col.to_pylist() == [12345, None, -99]
+    assert col.data.dtype == np.int64
+
+
+def test_batch_pydict_roundtrip():
+    schema = schema_of(a=T.INT, b=T.STRING, c=T.DOUBLE)
+    data = {"a": [1, 2, None], "b": ["x", None, "z"], "c": [0.5, 1.5, None]}
+    batch = ColumnarBatch.from_pydict(data, schema)
+    assert batch.num_rows == 3
+    assert batch.num_columns == 3
+    assert batch.to_pydict() == data
+    assert batch.to_rows() == [(1, "x", 0.5), (2, None, 1.5), (None, "z", None)]
+
+
+def test_batch_from_rows():
+    schema = schema_of(x=T.LONG, y=T.STRING)
+    rows = [(1, "a"), (None, "b"), (3, None)]
+    batch = batch_from_rows(rows, schema)
+    assert batch.to_rows() == rows
+
+
+def test_select():
+    schema = schema_of(a=T.INT, b=T.INT, c=T.INT)
+    batch = ColumnarBatch.from_pydict({"a": [1], "b": [2], "c": [3]}, schema)
+    sel = batch.select([2, 0])
+    assert sel.schema.names == ["c", "a"]
+    assert sel.to_rows() == [(3, 1)]
+
+
+def test_memory_size_accounting():
+    col = column_from_pylist(list(range(100)), T.INT)
+    assert col.device_memory_size() >= 100 * 4
+
+
+def test_padding_is_invalid_and_zero():
+    col = column_from_pylist([1, 2, 3], T.INT)
+    full_validity = np.asarray(col.validity)
+    assert not full_validity[3:].any()
+    full_data = np.asarray(col.data)
+    assert (full_data[3:] == 0).all()

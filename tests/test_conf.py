@@ -1,0 +1,81 @@
+import pytest
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.conf import RapidsConf
+
+
+def test_defaults():
+    rc = RapidsConf()
+    assert rc.is_sql_enabled
+    assert rc.explain == "NONE"
+    assert rc.concurrent_tpu_tasks == 1
+
+
+def test_typed_parsing():
+    rc = RapidsConf({
+        "spark.rapids.tpu.sql.enabled": "false",
+        "spark.rapids.tpu.sql.concurrentTpuTasks": "4",
+        "spark.rapids.tpu.memory.hbm.allocFraction": "0.5",
+    })
+    assert rc.is_sql_enabled is False
+    assert rc.concurrent_tpu_tasks == 4
+    assert rc.get(C.HBM_POOL_FRACTION) == 0.5
+
+
+def test_unknown_rapids_key_rejected():
+    with pytest.raises(ValueError):
+        RapidsConf({"spark.rapids.tpu.sql.doesNotExist": "1"})
+
+
+def test_foreign_keys_ignored():
+    rc = RapidsConf({"spark.executor.cores": "8"})
+    assert rc.is_sql_enabled
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        RapidsConf({"spark.rapids.tpu.sql.explain": "SOMETIMES"})
+    with pytest.raises(ValueError):
+        RapidsConf({"spark.rapids.tpu.sql.concurrentTpuTasks": "0"})
+    with pytest.raises(ValueError):
+        RapidsConf({"spark.rapids.tpu.memory.hbm.allocFraction": "1.5"})
+
+
+def test_help_generates_docs():
+    doc = RapidsConf.help()
+    assert "spark.rapids.tpu.sql.enabled" in doc
+    assert doc.startswith("# TPU RAPIDS Configuration")
+    # internal test keys hidden by default
+    assert "test.allowedNonTpu" not in doc
+    assert "test.allowedNonTpu" in RapidsConf.help(include_internal=True)
+
+
+def test_arm_idiom():
+    from spark_rapids_tpu.utils import close_on_except, safe_close, with_resource
+
+    class R:
+        def __init__(self):
+            self.closed = False
+
+        def close(self):
+            self.closed = True
+
+    r = R()
+    with with_resource(r):
+        pass
+    assert r.closed
+
+    r2 = R()
+    try:
+        with close_on_except(r2):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert r2.closed
+
+    r3 = R()
+    with close_on_except(r3):
+        pass
+    assert not r3.closed
+    safe_close([r3, None, R()])
+    assert r3.closed
