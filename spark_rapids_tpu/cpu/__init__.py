@@ -1,0 +1,1 @@
+from .interpreter import eval_expression_rows  # noqa: F401

@@ -1,0 +1,461 @@
+"""Row-at-a-time CPU interpreter for expression trees.
+
+Dual role, mirroring the reference architecture:
+ 1. the CPU *fallback* execution path — operators the planner can't place on
+    TPU run here (the reference falls back to stock Spark per operator:
+    docs/index.md:23-30);
+ 2. the *differential-test oracle* — the reference's core correctness idea is
+    running every query on CPU and GPU and diffing results
+    (tests/.../SparkQueryCompareTestSuite.scala:731, integration_tests
+    asserts.py:330). This interpreter is deliberately implemented
+    independently (pure Python over rows, no JAX/numpy vectorization) so a
+    shared bug can't hide in both engines.
+
+Semantics implemented to match Spark/Java: 3-valued logic, null on
+divide-by-zero, Java wrapping/saturating casts, HALF_UP rounding.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Sequence
+
+from .. import types as T
+from ..expr import expressions as E
+
+_INT_RANGES = {
+    "tinyint": (-(2**7), 2**7 - 1, 2**8),
+    "smallint": (-(2**15), 2**15 - 1, 2**16),
+    "int": (-(2**31), 2**31 - 1, 2**32),
+    "bigint": (-(2**63), 2**63 - 1, 2**64),
+}
+
+
+def _wrap_int(v: int, name: str) -> int:
+    lo, hi, mod = _INT_RANGES[name]
+    v = v % mod
+    return v - mod if v > hi else v
+
+
+def _java_cast(v: Any, frm: T.DataType, to: T.DataType) -> Any:
+    if v is None:
+        return None
+    if frm == to:
+        return v
+    if isinstance(to, T.BooleanType):
+        return v != 0
+    if isinstance(frm, T.BooleanType):
+        v = 1 if v else 0
+        frm = T.INT
+    if to.name in _INT_RANGES:
+        if frm.is_floating:
+            # Java: NaN -> 0; saturate at int32 (int64 for bigint); byte/short
+            # wrap-narrow from the saturated int32 value.
+            if math.isnan(v):
+                return 0
+            wide = "bigint" if to.name == "bigint" else "int"
+            lo, hi, _ = _INT_RANGES[wide]
+            w = hi if v >= hi else (lo if v <= lo else int(v))
+            return _wrap_int(w, to.name)
+        return _wrap_int(int(v), to.name)
+    if to.is_floating:
+        f = float(v)
+        if isinstance(to, T.FloatType):
+            import struct
+
+            f = struct.unpack("f", struct.pack("f", f))[0]
+        return f
+    raise NotImplementedError(f"cpu cast {frm} -> {to}")
+
+
+def _f32(v: float) -> float:
+    import struct
+
+    return struct.unpack("f", struct.pack("f", v))[0]
+
+
+def _narrow(v, out: T.DataType):
+    """Post-arithmetic narrowing: int wraparound / float32 rounding."""
+    if out.name in _INT_RANGES:
+        return _wrap_int(v, out.name)
+    if isinstance(out, T.FloatType):
+        return _f32(v)
+    return v
+
+
+def _trunc_div(l: int, r: int) -> int:
+    q = abs(l) // abs(r)
+    return q if (l < 0) == (r < 0) else -q
+
+
+def _java_rem(l, r):
+    if isinstance(l, float) or isinstance(r, float):
+        # Java %: NaN if divisor is 0 or dividend is infinite; x % inf == x
+        if math.isnan(l) or math.isnan(r) or r == 0 or math.isinf(l):
+            return float("nan")
+        if math.isinf(r):
+            return float(l)
+        return math.fmod(l, r)
+    return l - _trunc_div(l, r) * r
+
+
+def _spark_compare(expr: E.Expression, l, r):
+    """Spark SQL ordering: NaN == NaN is true, NaN sorts largest."""
+    ln = isinstance(l, float) and math.isnan(l)
+    rn = isinstance(r, float) and math.isnan(r)
+    if ln or rn:
+        eq = ln and rn
+        lt = (not ln) and rn
+        gt = ln and (not rn)
+        if isinstance(expr, (E.EqualTo, E.EqualNullSafe)):
+            return eq
+        if isinstance(expr, E.LessThan):
+            return lt
+        if isinstance(expr, E.LessThanOrEqual):
+            return lt or eq
+        if isinstance(expr, E.GreaterThan):
+            return gt
+        return gt or eq
+    if isinstance(expr, (E.EqualTo, E.EqualNullSafe)):
+        return l == r
+    if isinstance(expr, E.LessThan):
+        return l < r
+    if isinstance(expr, E.LessThanOrEqual):
+        return l <= r
+    if isinstance(expr, E.GreaterThan):
+        return l > r
+    return l >= r
+
+
+def eval_row(expr: E.Expression, row: Sequence[Any]) -> Any:
+    """Evaluate one bound expression against one row (values may be None)."""
+    ev = lambda e: eval_row(e, row)  # noqa: E731
+
+    if isinstance(expr, E.Alias):
+        return ev(expr.child)
+    if isinstance(expr, E.Literal):
+        return expr.value
+    if isinstance(expr, E.BoundReference):
+        return row[expr.ordinal]
+
+    if isinstance(expr, (E.Add, E.Subtract, E.Multiply)):
+        l, r = ev(expr.left), ev(expr.right)
+        if l is None or r is None:
+            return None
+        out = expr.dtype
+        l = _java_cast(l, expr.left.dtype, out)
+        r = _java_cast(r, expr.right.dtype, out)
+        v = l + r if isinstance(expr, E.Add) else (l - r if isinstance(expr, E.Subtract) else l * r)
+        return _narrow(v, out)
+
+    if isinstance(expr, E.Divide):
+        l, r = ev(expr.left), ev(expr.right)
+        if l is None or r is None:
+            return None
+        l, r = _java_cast(l, expr.left.dtype, T.DOUBLE), _java_cast(r, expr.right.dtype, T.DOUBLE)
+        if r == 0:
+            return None
+        return l / r
+
+    if isinstance(expr, E.IntegralDivide):
+        l, r = ev(expr.left), ev(expr.right)
+        if l is None or r is None:
+            return None
+        l, r = _java_cast(l, expr.left.dtype, T.LONG), _java_cast(r, expr.right.dtype, T.LONG)
+        if r == 0:
+            return None
+        return _wrap_int(_trunc_div(l, r), "bigint")
+
+    if isinstance(expr, E.Remainder):
+        l, r = ev(expr.left), ev(expr.right)
+        if l is None or r is None:
+            return None
+        out = expr.dtype
+        l, r = _java_cast(l, expr.left.dtype, out), _java_cast(r, expr.right.dtype, out)
+        if not out.is_floating and r == 0:
+            return None
+        return _narrow(_java_rem(l, r), out)
+
+    if isinstance(expr, E.Pmod):
+        l, r = ev(expr.left), ev(expr.right)
+        if l is None or r is None:
+            return None
+        out = expr.dtype
+        l, r = _java_cast(l, expr.left.dtype, out), _java_cast(r, expr.right.dtype, out)
+        if not out.is_floating and r == 0:
+            return None
+        m = _java_rem(l, r)
+        if (isinstance(m, float) and m != 0 and m < 0) or (not isinstance(m, float) and m < 0):
+            m = _java_rem(m + r, r)
+        return _narrow(m, out)
+
+    if isinstance(expr, E.UnaryMinus):
+        v = ev(expr.child)
+        if v is None:
+            return None
+        dt = expr.child.dtype
+        return _wrap_int(-v, dt.name) if dt.name in _INT_RANGES else -v
+
+    if isinstance(expr, E.UnaryPositive):
+        return ev(expr.child)
+
+    if isinstance(expr, E.Abs):
+        v = ev(expr.child)
+        if v is None:
+            return None
+        dt = expr.child.dtype
+        return _wrap_int(abs(v), dt.name) if dt.name in _INT_RANGES else abs(v)
+
+    if isinstance(expr, E.EqualNullSafe):
+        l, r = ev(expr.left), ev(expr.right)
+        if l is None and r is None:
+            return True
+        if l is None or r is None:
+            return False
+        return _spark_compare(expr, l, r)
+
+    if isinstance(expr, E._BinaryComparison):
+        l, r = ev(expr.left), ev(expr.right)
+        if l is None or r is None:
+            return None
+        return _spark_compare(expr, l, r)
+
+    if isinstance(expr, E.In):
+        v = ev(expr.child)
+        if v is None:
+            return None
+        non_null = [x for x in expr.values if x is not None]
+        if v in non_null:
+            return True
+        return None if len(non_null) != len(expr.values) else False
+
+    if isinstance(expr, E.And):
+        l, r = ev(expr.left), ev(expr.right)
+        if l is False or r is False:
+            return False
+        if l is None or r is None:
+            return None
+        return l and r
+
+    if isinstance(expr, E.Or):
+        l, r = ev(expr.left), ev(expr.right)
+        if l is True or r is True:
+            return True
+        if l is None or r is None:
+            return None
+        return l or r
+
+    if isinstance(expr, E.Not):
+        v = ev(expr.child)
+        return None if v is None else not v
+
+    if isinstance(expr, E.IsNull):
+        return ev(expr.child) is None
+
+    if isinstance(expr, E.IsNotNull):
+        return ev(expr.child) is not None
+
+    if isinstance(expr, E.IsNan):
+        v = ev(expr.child)
+        return v is not None and isinstance(v, float) and math.isnan(v)
+
+    if isinstance(expr, E.Coalesce):
+        out = expr.dtype
+        for e in expr.exprs:
+            v = ev(e)
+            if v is not None:
+                return _java_cast(v, e.dtype, out) if e.dtype != out and out.is_numeric else v
+        return None
+
+    if isinstance(expr, E.NaNvl):
+        l = ev(expr.left)
+        out = expr.dtype
+        if l is not None and isinstance(l, float) and math.isnan(l):
+            r = ev(expr.right)
+            return None if r is None else _java_cast(r, expr.right.dtype, out)
+        return None if l is None else _java_cast(l, expr.left.dtype, out)
+
+    if isinstance(expr, E.If):
+        p = ev(expr.predicate)
+        out = expr.dtype
+        if p is True:
+            v = ev(expr.true_value)
+            src = expr.true_value.dtype
+        else:
+            v = ev(expr.false_value)
+            src = expr.false_value.dtype
+        if v is None:
+            return None
+        return _java_cast(v, src, out) if src != out and out.is_numeric and src != T.NULL else v
+
+    if isinstance(expr, E.CaseWhen):
+        out = expr.dtype
+        for cond, val in expr.branches:
+            if ev(cond) is True:
+                v = ev(val)
+                if v is None:
+                    return None
+                src = val.dtype
+                return _java_cast(v, src, out) if src != out and out.is_numeric and src != T.NULL else v
+        if expr.else_value is not None:
+            v = ev(expr.else_value)
+            if v is None:
+                return None
+            src = expr.else_value.dtype
+            return _java_cast(v, src, out) if src != out and out.is_numeric and src != T.NULL else v
+        return None
+
+    if isinstance(expr, E.Cast):
+        return _java_cast(ev(expr.child), expr.child.dtype, expr.to)
+
+    if isinstance(expr, E._UnaryMathDouble):
+        v = ev(expr.child)
+        if v is None:
+            return None
+        x = _java_cast(v, expr.child.dtype, T.DOUBLE)
+        kind = type(expr)
+        if kind in (E.Log, E.Log10, E.Log2, E.Log1p):
+            t = -1.0 if kind is E.Log1p else 0.0
+            if x <= t:  # NaN fails this comparison, like Java
+                return None
+            return {E.Log: math.log, E.Log10: math.log10, E.Log2: math.log2,
+                    E.Log1p: math.log1p}[kind](x)
+        try:
+            return {
+                E.Sqrt: lambda v: math.sqrt(v) if v >= 0 else float("nan"),
+                E.Exp: math.exp,
+                E.Sin: math.sin, E.Cos: math.cos, E.Tan: math.tan,
+                E.Asin: lambda v: math.asin(v) if -1 <= v <= 1 else float("nan"),
+                E.Acos: lambda v: math.acos(v) if -1 <= v <= 1 else float("nan"),
+                E.Atan: math.atan,
+                E.Sinh: math.sinh, E.Cosh: math.cosh, E.Tanh: math.tanh,
+                E.Cbrt: lambda v: math.copysign(abs(v) ** (1 / 3), v),
+                E.Expm1: math.expm1, E.Log1p: math.log1p,
+                E.ToDegrees: math.degrees, E.ToRadians: math.radians,
+            }[kind](x)
+        except OverflowError:
+            # Java overflows to infinity (math.exp(1e6) == inf, not error)
+            if kind is E.Sinh:
+                return math.copysign(float("inf"), x)
+            return float("inf")
+        except ValueError:
+            return float("nan")
+
+    if isinstance(expr, (E.Floor, E.Ceil)):
+        v = ev(expr.child)
+        if v is None:
+            return None
+        if not expr.child.dtype.is_floating:
+            return v
+        if math.isinf(v) or math.isnan(v):
+            return _java_cast(v, T.DOUBLE, T.LONG)
+        return int(math.floor(v) if isinstance(expr, E.Floor) else math.ceil(v))
+
+    if isinstance(expr, E.Round):
+        v = ev(expr.child)
+        if v is None:
+            return None
+        dt = expr.child.dtype
+        s = expr.scale
+        if dt.is_floating:
+            if math.isnan(v) or math.isinf(v):
+                return v  # Spark returns NaN/inf unchanged from round()
+            f = 10.0 ** s
+            return math.copysign(math.floor(abs(v) * f + 0.5) / f, v)
+        if s >= 0:
+            return v
+        f = int(10 ** (-s))
+        sign = -1 if v < 0 else 1
+        # Scala BigDecimal.toInt/toLong wrap on overflow
+        return _wrap_int(sign * ((abs(v) + f // 2) // f) * f, dt.name)
+
+    if isinstance(expr, E.Rint):
+        v = ev(expr.child)
+        if v is None:
+            return None
+        x = float(v)
+        if math.isnan(x) or math.isinf(x):
+            return x
+        # Java Math.rint: round half to even
+        fl = math.floor(x)
+        diff = x - fl
+        if diff < 0.5:
+            return float(fl)
+        if diff > 0.5:
+            return float(fl + 1)
+        return float(fl if fl % 2 == 0 else fl + 1)
+
+    if isinstance(expr, E.Pow):
+        l, r = ev(expr.left), ev(expr.right)
+        if l is None or r is None:
+            return None
+        try:
+            return float(
+                math.pow(
+                    _java_cast(l, expr.left.dtype, T.DOUBLE),
+                    _java_cast(r, expr.right.dtype, T.DOUBLE),
+                )
+            )
+        except (ValueError, OverflowError):
+            return float("nan")
+
+    if isinstance(expr, E.Atan2):
+        l, r = ev(expr.left), ev(expr.right)
+        if l is None or r is None:
+            return None
+        return math.atan2(
+            _java_cast(l, expr.left.dtype, T.DOUBLE),
+            _java_cast(r, expr.right.dtype, T.DOUBLE),
+        )
+
+    if isinstance(expr, E.Signum):
+        v = ev(expr.child)
+        if v is None:
+            return None
+        x = _java_cast(v, expr.child.dtype, T.DOUBLE)
+        if math.isnan(x):
+            return x
+        return 0.0 if x == 0 else math.copysign(1.0, x)
+
+    if isinstance(expr, (E.BitwiseAnd, E.BitwiseOr, E.BitwiseXor)):
+        l, r = ev(expr.left), ev(expr.right)
+        if l is None or r is None:
+            return None
+        out = expr.dtype
+        l = _java_cast(l, expr.left.dtype, out)
+        r = _java_cast(r, expr.right.dtype, out)
+        v = l & r if isinstance(expr, E.BitwiseAnd) else (l | r if isinstance(expr, E.BitwiseOr) else l ^ r)
+        return _wrap_int(v, out.name)
+
+    if isinstance(expr, E.BitwiseNot):
+        v = ev(expr.child)
+        if v is None:
+            return None
+        return _wrap_int(~v, expr.dtype.name)
+
+    if isinstance(expr, (E.ShiftLeft, E.ShiftRight, E.ShiftRightUnsigned)):
+        l, r = ev(expr.left), ev(expr.right)
+        if l is None or r is None:
+            return None
+        name = expr.left.dtype.name
+        bits = 64 if name == "bigint" else 32
+        sh = r & (bits - 1)
+        if isinstance(expr, E.ShiftLeft):
+            return _wrap_int(l << sh, name)
+        if isinstance(expr, E.ShiftRight):
+            return l >> sh  # python >> is arithmetic for negative ints
+        u = l & ((1 << bits) - 1)
+        return _wrap_int(u >> sh, name)
+
+    if isinstance(expr, E.Length):
+        v = ev(expr.child)
+        if v is None:
+            return None
+        return len(v)
+
+    raise NotImplementedError(f"cpu interpreter: {type(expr).__name__}")
+
+
+def eval_expression_rows(
+    bound: E.Expression, rows: Sequence[Sequence[Any]]
+) -> List[Any]:
+    return [eval_row(bound, row) for row in rows]

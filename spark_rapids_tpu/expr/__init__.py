@@ -1,0 +1,10 @@
+from . import expressions  # noqa: F401
+from .expressions import Expression, bind_references, col, lit  # noqa: F401
+from .eval import (  # noqa: F401
+    ColV,
+    StrV,
+    UnsupportedExpressionError,
+    evaluate_projection,
+    lower,
+    tpu_supports,
+)

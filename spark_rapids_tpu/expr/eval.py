@@ -1,0 +1,556 @@
+"""Lowering bound expression trees to fused XLA computations.
+
+Reference analog: GpuExpression.columnarEval (GpuExpressions.scala:380) where
+each node launches a cudf kernel. TPU re-design: `compile_projection` traces
+the WHOLE bound tree once per (expressions, schema, capacity-bucket) into a
+single jitted function, letting XLA fuse every elementwise op into one HBM
+pass. The executable cache is keyed structurally (frozen dataclass hashing),
+the TPU analog of the reference's per-op kernel dispatch being amortized by
+cudf's own compiled kernels.
+
+Value representation inside a trace:
+  ColV(data, validity)            fixed-width column piece
+  StrV(offsets, chars, validity)  string column piece (Arrow layout)
+
+Null semantics follow Spark exactly (three-valued logic, null-on-divide-by-
+zero, Java cast saturation); differential tests in tests/test_expressions.py
+pin this against the independent CPU interpreter.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..columnar import ColumnarBatch, DeviceColumn
+from ..types import DataType
+from . import expressions as E
+
+
+class ColV(NamedTuple):
+    data: jax.Array
+    validity: jax.Array
+
+
+class StrV(NamedTuple):
+    offsets: jax.Array
+    chars: jax.Array
+    validity: jax.Array
+
+
+Val = Union[ColV, StrV]
+
+
+class UnsupportedExpressionError(Exception):
+    """Raised when a tree can't lower to TPU; planner uses this to fall back
+    (reference: RapidsMeta.willNotWorkOnGpu)."""
+
+
+_INT_INFO = {
+    "tinyint": (np.int8, -(2**7), 2**7 - 1),
+    "smallint": (np.int16, -(2**15), 2**15 - 1),
+    "int": (np.int32, -(2**31), 2**31 - 1),
+    "bigint": (np.int64, -(2**63), 2**63 - 1),
+}
+
+
+def _storage(dt: DataType):
+    return jnp.dtype(dt.to_numpy()) if not isinstance(dt, (T.StringType, T.BinaryType)) else None
+
+
+def _cast_data(data: jax.Array, frm: DataType, to: DataType) -> jax.Array:
+    """Value cast with Java/Spark numeric semantics (reference: GpuCast.scala)."""
+    if frm == to:
+        return data
+    if isinstance(to, T.BooleanType):
+        return data != 0
+    if isinstance(frm, T.BooleanType):
+        return data.astype(to.to_numpy())
+    if to.name in _INT_INFO and (frm.is_floating):
+        # Java narrowing: NaN -> 0; saturate at int32 (or int64 for bigint)
+        # range; byte/short then wrap-narrow from int32 (so (byte)inf == -1).
+        npdt, _, _ = _INT_INFO[to.name]
+        wide = "bigint" if to.name == "bigint" else "int"
+        wdt, lo, hi = _INT_INFO[wide]
+        d = jnp.where(jnp.isnan(data), 0.0, data)
+        t = jnp.trunc(d)
+        # avoid jnp.clip: inf propagates to nan on some backends
+        sat = jnp.where(t >= float(hi), hi, 0).astype(wdt)
+        mid = jnp.where((t > float(lo)) & (t < float(hi)), t, 0.0).astype(wdt)
+        low = jnp.where(t <= float(lo), lo, 0).astype(wdt)
+        w = sat + mid + low
+        return w.astype(npdt)
+    # int->int wraps (Java), int/float->float exact-ish, decimal passthrough
+    return data.astype(to.to_numpy())
+
+
+def _promote2(l: ColV, ldt, r: ColV, rdt, target: DataType) -> Tuple[jax.Array, jax.Array]:
+    return _cast_data(l.data, ldt, target), _cast_data(r.data, rdt, target)
+
+
+def _trunc_div(l: jax.Array, r: jax.Array) -> jax.Array:
+    """Java integer division: truncation toward zero (numpy // floors)."""
+    rs = jnp.where(r == 0, 1, r)
+    q = l // rs
+    rem = l - q * rs
+    fix = (rem != 0) & ((l < 0) != (rs < 0))
+    return jnp.where(fix, q + 1, q)
+
+
+def _java_rem(l: jax.Array, r: jax.Array) -> jax.Array:
+    if jnp.issubdtype(l.dtype, jnp.floating):
+        # C fmod == Java %: NaN for zero divisor/inf dividend, x % inf == x
+        return jnp.fmod(l, r)
+    rs = jnp.where(r == 0, 1, r)
+    return l - _trunc_div(l, rs) * rs
+
+
+def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
+    """Recursively lower a bound expression to traced jnp ops."""
+    ev = lambda e: lower(e, cols, cap)  # noqa: E731
+
+    if isinstance(expr, E.Alias):
+        return ev(expr.child)
+
+    if isinstance(expr, E.BoundReference):
+        return cols[expr.ordinal]
+
+    if isinstance(expr, E.Literal):
+        if isinstance(expr.data_type, (T.StringType, T.BinaryType)):
+            raw = (
+                expr.value.encode("utf-8")
+                if isinstance(expr.value, str)
+                else (expr.value or b"")
+            )
+            nb = np.frombuffer(raw, dtype=np.uint8)
+            # Arrow offsets must be monotonic, so the literal bytes are tiled
+            # per row; XLA constant-folds the broadcast.
+            if len(nb):
+                chars = jnp.tile(jnp.asarray(nb), cap)
+            else:
+                chars = jnp.zeros(1, jnp.uint8)
+            offsets = (jnp.arange(cap + 1, dtype=jnp.int32)) * len(nb)
+            valid = jnp.full((cap,), expr.value is not None)
+            return StrV(offsets, chars, valid)
+        if isinstance(expr.data_type, T.NullType):
+            return ColV(jnp.zeros(cap, jnp.bool_), jnp.zeros(cap, jnp.bool_))
+        dt = _storage(expr.data_type)
+        v = expr.value
+        data = jnp.full((cap,), v if v is not None else 0, dtype=dt)
+        valid = jnp.full((cap,), v is not None)
+        return ColV(data, valid)
+
+    # ----- arithmetic -----------------------------------------------------
+    if isinstance(expr, (E.Add, E.Subtract, E.Multiply)):
+        out = expr.dtype
+        l, r = ev(expr.left), ev(expr.right)
+        ld, rd = _promote2(l, expr.left.dtype, r, expr.right.dtype, out)
+        op = {E.Add: jnp.add, E.Subtract: jnp.subtract, E.Multiply: jnp.multiply}[type(expr)]
+        return ColV(op(ld, rd), l.validity & r.validity)
+
+    if isinstance(expr, E.Divide):
+        l, r = ev(expr.left), ev(expr.right)
+        ld = _cast_data(l.data, expr.left.dtype, T.DOUBLE)
+        rd = _cast_data(r.data, expr.right.dtype, T.DOUBLE)
+        valid = l.validity & r.validity & (rd != 0)
+        return ColV(ld / jnp.where(rd == 0, 1.0, rd), valid)
+
+    if isinstance(expr, E.IntegralDivide):
+        l, r = ev(expr.left), ev(expr.right)
+        ld = _cast_data(l.data, expr.left.dtype, T.LONG)
+        rd = _cast_data(r.data, expr.right.dtype, T.LONG)
+        valid = l.validity & r.validity & (rd != 0)
+        return ColV(_trunc_div(ld, rd), valid)
+
+    if isinstance(expr, E.Remainder):
+        out = expr.dtype
+        l, r = ev(expr.left), ev(expr.right)
+        ld, rd = _promote2(l, expr.left.dtype, r, expr.right.dtype, out)
+        valid = l.validity & r.validity
+        if not out.is_floating:
+            valid = valid & (rd != 0)
+        return ColV(_java_rem(ld, rd), valid)
+
+    if isinstance(expr, E.Pmod):
+        out = expr.dtype
+        l, r = ev(expr.left), ev(expr.right)
+        ld, rd = _promote2(l, expr.left.dtype, r, expr.right.dtype, out)
+        valid = l.validity & r.validity
+        if not out.is_floating:
+            valid = valid & (rd != 0)
+        m = _java_rem(ld, rd)
+        m = jnp.where(m < 0, _java_rem(m + rd, rd), m)
+        return ColV(m, valid)
+
+    if isinstance(expr, E.UnaryMinus):
+        c = ev(expr.child)
+        return ColV(-c.data, c.validity)
+
+    if isinstance(expr, E.UnaryPositive):
+        return ev(expr.child)
+
+    if isinstance(expr, E.Abs):
+        c = ev(expr.child)
+        return ColV(jnp.abs(c.data), c.validity)
+
+    # ----- comparisons ----------------------------------------------------
+    if isinstance(expr, E._BinaryComparison):
+        l, r = ev(expr.left), ev(expr.right)
+        if isinstance(l, StrV) or isinstance(r, StrV):
+            raise UnsupportedExpressionError("string comparison not yet on TPU")
+        tgt = (
+            T.promote(expr.left.dtype, expr.right.dtype)
+            if expr.left.dtype != expr.right.dtype
+            else expr.left.dtype
+        )
+        ld, rd = _promote2(l, expr.left.dtype, r, expr.right.dtype, tgt)
+        if tgt.is_floating:
+            # Spark SQL ordering: NaN == NaN is TRUE and NaN sorts largest
+            # (unlike IEEE; reference handles this via hasNans configs)
+            nl, nr = jnp.isnan(ld), jnp.isnan(rd)
+            eq = (ld == rd) | (nl & nr)
+            lt = (ld < rd) | (nr & ~nl)
+            gt = (rd < ld) | (nl & ~nr)
+            res = {
+                E.EqualTo: eq, E.EqualNullSafe: eq,
+                E.LessThan: lt, E.LessThanOrEqual: lt | eq,
+                E.GreaterThan: gt, E.GreaterThanOrEqual: gt | eq,
+            }[type(expr)]
+        else:
+            ops = {
+                E.EqualTo: jnp.equal,
+                E.EqualNullSafe: jnp.equal,
+                E.LessThan: jnp.less,
+                E.LessThanOrEqual: jnp.less_equal,
+                E.GreaterThan: jnp.greater,
+                E.GreaterThanOrEqual: jnp.greater_equal,
+            }
+            res = ops[type(expr)](ld, rd)
+        if isinstance(expr, E.EqualNullSafe):
+            both_null = ~l.validity & ~r.validity
+            val = (l.validity & r.validity & res) | both_null
+            return ColV(val, jnp.ones(cap, jnp.bool_))
+        return ColV(res, l.validity & r.validity)
+
+    if isinstance(expr, E.In):
+        c = ev(expr.child)
+        if isinstance(c, StrV):
+            raise UnsupportedExpressionError("string IN not yet on TPU")
+        child_dt = expr.child.dtype
+        non_null = [v for v in expr.values if v is not None]
+        has_null_value = len(non_null) != len(expr.values)
+        # pick a comparison dtype host-side so out-of-range literals widen
+        # instead of crashing/truncating in jnp.asarray
+        cmp_dt = child_dt
+        if child_dt.is_floating or any(isinstance(v, float) for v in non_null):
+            cmp_dt = T.DOUBLE if child_dt != T.FLOAT or any(
+                isinstance(v, float) for v in non_null) else T.FLOAT
+        elif child_dt.name in _INT_INFO:
+            _, lo, hi = _INT_INFO[child_dt.name]
+            if any(not (lo <= v <= hi) for v in non_null):
+                cmp_dt = T.LONG
+                # literals beyond int64 can never match an integral column
+                non_null = [v for v in non_null if -(2**63) <= v < 2**63]
+        cd = _cast_data(c.data, child_dt, cmp_dt)
+        match = jnp.zeros(cap, jnp.bool_)
+        for v in non_null:
+            match = match | (cd == jnp.asarray(v, dtype=cd.dtype))
+        valid = c.validity & (match | (not has_null_value))
+        return ColV(match, valid)
+
+    # ----- boolean logic (3-valued) --------------------------------------
+    if isinstance(expr, E.And):
+        # Kleene AND: false dominates null (F AND NULL = F, T AND NULL = NULL)
+        l, r = ev(expr.left), ev(expr.right)
+        valid = (l.validity & r.validity) | (l.validity & ~l.data) | (r.validity & ~r.data)
+        return ColV(
+            jnp.where(valid, jnp.where(l.validity, l.data, True) & jnp.where(r.validity, r.data, True), False),
+            valid,
+        )
+
+    if isinstance(expr, E.Or):
+        # Kleene OR: true dominates null
+        l, r = ev(expr.left), ev(expr.right)
+        valid = (l.validity & r.validity) | (l.validity & l.data) | (r.validity & r.data)
+        return ColV(
+            jnp.where(valid, (jnp.where(l.validity, l.data, False) | jnp.where(r.validity, r.data, False)), False),
+            valid,
+        )
+
+    if isinstance(expr, E.Not):
+        c = ev(expr.child)
+        return ColV(~c.data, c.validity)
+
+    # ----- null ops -------------------------------------------------------
+    if isinstance(expr, E.IsNull):
+        c = ev(expr.child)
+        return ColV(~c.validity, jnp.ones(cap, jnp.bool_))
+
+    if isinstance(expr, E.IsNotNull):
+        c = ev(expr.child)
+        return ColV(jnp.asarray(c.validity), jnp.ones(cap, jnp.bool_))
+
+    if isinstance(expr, E.IsNan):
+        c = ev(expr.child)
+        d = c.data
+        isnan = jnp.isnan(d) if jnp.issubdtype(d.dtype, jnp.floating) else jnp.zeros(cap, jnp.bool_)
+        return ColV(isnan & c.validity, jnp.ones(cap, jnp.bool_))
+
+    if isinstance(expr, E.Coalesce):
+        out = expr.dtype
+        if isinstance(out, (T.StringType, T.BinaryType)):
+            raise UnsupportedExpressionError("string coalesce not yet on TPU")
+        acc = None
+        for e in expr.exprs:
+            v = ev(e)
+            d = _cast_data(v.data, e.dtype if e.dtype != T.NULL else out, out)
+            if acc is None:
+                acc = ColV(d, v.validity)
+            else:
+                take_new = ~acc.validity & v.validity
+                acc = ColV(jnp.where(take_new, d, acc.data), acc.validity | v.validity)
+        return acc
+
+    if isinstance(expr, E.NaNvl):
+        l, r = ev(expr.left), ev(expr.right)
+        out = expr.dtype
+        ld = _cast_data(l.data, expr.left.dtype, out)
+        rd = _cast_data(r.data, expr.right.dtype, out)
+        use_r = l.validity & jnp.isnan(ld)
+        data = jnp.where(use_r, rd, ld)
+        valid = jnp.where(use_r, r.validity, l.validity)
+        return ColV(data, valid)
+
+    # ----- conditionals ---------------------------------------------------
+    if isinstance(expr, E.If):
+        out = expr.dtype
+        if isinstance(out, (T.StringType, T.BinaryType)):
+            raise UnsupportedExpressionError("string if/case not yet on TPU")
+        p = ev(expr.predicate)
+        t, f = ev(expr.true_value), ev(expr.false_value)
+        td = _cast_data(t.data, expr.true_value.dtype if expr.true_value.dtype != T.NULL else out, out)
+        fd = _cast_data(f.data, expr.false_value.dtype if expr.false_value.dtype != T.NULL else out, out)
+        cond = p.validity & p.data
+        return ColV(jnp.where(cond, td, fd), jnp.where(cond, t.validity, f.validity))
+
+    if isinstance(expr, E.CaseWhen):
+        out = expr.dtype
+        if isinstance(out, (T.StringType, T.BinaryType)):
+            raise UnsupportedExpressionError("string if/case not yet on TPU")
+        if expr.else_value is not None:
+            e = ev(expr.else_value)
+            edt = expr.else_value.dtype
+            data = _cast_data(e.data, edt if edt != T.NULL else out, out)
+            valid = e.validity
+        else:
+            data = jnp.zeros(cap, dtype=out.to_numpy())
+            valid = jnp.zeros(cap, jnp.bool_)
+        taken = jnp.zeros(cap, jnp.bool_)
+        for cond_e, val_e in expr.branches:
+            c = ev(cond_e)
+            v = ev(val_e)
+            vdt = val_e.dtype
+            vd = _cast_data(v.data, vdt if vdt != T.NULL else out, out)
+            fire = ~taken & c.validity & c.data
+            data = jnp.where(fire, vd, data)
+            valid = jnp.where(fire, v.validity, valid)
+            taken = taken | fire
+        return ColV(data, valid)
+
+    if isinstance(expr, E.Cast):
+        frm, to = expr.child.dtype, expr.to
+        c = ev(expr.child)
+        if isinstance(c, StrV) or isinstance(to, (T.StringType, T.BinaryType)):
+            raise UnsupportedExpressionError("string casts not yet on TPU")
+        return ColV(_cast_data(c.data, frm, to), c.validity)
+
+    # ----- math -----------------------------------------------------------
+    if isinstance(expr, E._UnaryMathDouble):
+        c = ev(expr.child)
+        x = _cast_data(c.data, expr.child.dtype, T.DOUBLE)
+        fns = {
+            E.Sqrt: jnp.sqrt, E.Exp: jnp.exp, E.Sin: jnp.sin, E.Cos: jnp.cos,
+            E.Tan: jnp.tan, E.Asin: jnp.arcsin, E.Acos: jnp.arccos,
+            E.Atan: jnp.arctan, E.Sinh: jnp.sinh, E.Cosh: jnp.cosh,
+            E.Tanh: jnp.tanh, E.Cbrt: jnp.cbrt, E.Expm1: jnp.expm1,
+            E.Log1p: jnp.log1p,
+            E.ToDegrees: jnp.degrees, E.ToRadians: jnp.radians,
+        }
+        kind = type(expr)
+        if kind in (E.Log, E.Log10, E.Log2, E.Log1p):
+            # Spark: null when x <= 0 (or <= -1 for log1p); NaN passes the
+            # guard (NaN <= 0 is false in Java) and yields NaN
+            t = -1.0 if kind is E.Log1p else 0.0
+            bad = x <= t
+            safe = jnp.where(bad, 1.0 - t, x)
+            base = {E.Log: jnp.log, E.Log10: jnp.log10, E.Log2: jnp.log2,
+                    E.Log1p: jnp.log1p}[kind]
+            return ColV(base(safe), c.validity & ~bad)
+        return ColV(fns[kind](x), c.validity)
+
+    if isinstance(expr, (E.Floor, E.Ceil)):
+        c = ev(expr.child)
+        if not expr.child.dtype.is_floating:
+            return c
+        fn = jnp.floor if isinstance(expr, E.Floor) else jnp.ceil
+        return ColV(_cast_data(fn(c.data), T.DOUBLE, T.LONG), c.validity)
+
+    if isinstance(expr, E.Round):
+        c = ev(expr.child)
+        dt = expr.child.dtype
+        s = expr.scale
+        if dt.is_floating:
+            f = 10.0 ** s
+            x = c.data.astype(jnp.float64)
+            r = jnp.sign(x) * jnp.floor(jnp.abs(x) * f + 0.5) / f
+            return ColV(r.astype(dt.to_numpy()), c.validity)
+        if s >= 0:
+            return c
+        f = int(10 ** (-s))
+        x = c.data.astype(jnp.int64)
+        r = jnp.sign(x) * ((jnp.abs(x) + f // 2) // f) * f
+        return ColV(r.astype(dt.to_numpy()), c.validity)
+
+    if isinstance(expr, E.Rint):
+        c = ev(expr.child)
+        return ColV(jnp.round(_cast_data(c.data, expr.child.dtype, T.DOUBLE)), c.validity)
+
+    if isinstance(expr, E.Pow):
+        l, r = ev(expr.left), ev(expr.right)
+        ld = _cast_data(l.data, expr.left.dtype, T.DOUBLE)
+        rd = _cast_data(r.data, expr.right.dtype, T.DOUBLE)
+        return ColV(jnp.power(ld, rd), l.validity & r.validity)
+
+    if isinstance(expr, E.Atan2):
+        l, r = ev(expr.left), ev(expr.right)
+        ld = _cast_data(l.data, expr.left.dtype, T.DOUBLE)
+        rd = _cast_data(r.data, expr.right.dtype, T.DOUBLE)
+        return ColV(jnp.arctan2(ld, rd), l.validity & r.validity)
+
+    if isinstance(expr, E.Signum):
+        c = ev(expr.child)
+        return ColV(jnp.sign(_cast_data(c.data, expr.child.dtype, T.DOUBLE)), c.validity)
+
+    # ----- bitwise --------------------------------------------------------
+    if isinstance(expr, (E.BitwiseAnd, E.BitwiseOr, E.BitwiseXor)):
+        out = expr.dtype
+        l, r = ev(expr.left), ev(expr.right)
+        ld, rd = _promote2(l, expr.left.dtype, r, expr.right.dtype, out)
+        op = {
+            E.BitwiseAnd: jnp.bitwise_and,
+            E.BitwiseOr: jnp.bitwise_or,
+            E.BitwiseXor: jnp.bitwise_xor,
+        }[type(expr)]
+        return ColV(op(ld, rd), l.validity & r.validity)
+
+    if isinstance(expr, E.BitwiseNot):
+        c = ev(expr.child)
+        return ColV(~c.data, c.validity)
+
+    if isinstance(expr, (E.ShiftLeft, E.ShiftRight, E.ShiftRightUnsigned)):
+        l, r = ev(expr.left), ev(expr.right)
+        bits = l.data.dtype.itemsize * 8
+        sh = (r.data & (bits - 1)).astype(l.data.dtype)
+        if isinstance(expr, E.ShiftLeft):
+            res = l.data << sh
+        elif isinstance(expr, E.ShiftRight):
+            res = l.data >> sh
+        else:
+            u = l.data.astype(jnp.uint32 if bits == 32 else jnp.uint64)
+            res = (u >> sh.astype(u.dtype)).astype(l.data.dtype)
+        return ColV(res, l.validity & r.validity)
+
+    # ----- strings (minimal) ----------------------------------------------
+    if isinstance(expr, E.Length):
+        c = ev(expr.child)
+        if not isinstance(c, StrV):
+            raise UnsupportedExpressionError("length() on non-string")
+        cont = ((c.chars & 0xC0) == 0x80).astype(jnp.int32)
+        cs = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cont)])
+        byte_len = c.offsets[1:] - c.offsets[:-1]
+        cont_in_row = cs[c.offsets[1:]] - cs[c.offsets[:-1]]
+        return ColV((byte_len - cont_in_row).astype(jnp.int32), c.validity)
+
+    raise UnsupportedExpressionError(f"no TPU lowering for {type(expr).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# Compile cache + public entry points
+# ---------------------------------------------------------------------------
+def _col_to_vals(col: DeviceColumn) -> Val:
+    if col.is_string:
+        return StrV(col.offsets, col.chars, col.validity)
+    return ColV(col.data, col.validity)
+
+
+@functools.lru_cache(maxsize=512)
+def _compiled(exprs: Tuple[E.Expression, ...], cap: int, schema_sig: tuple):
+    """One XLA executable per (bound exprs, capacity bucket, input layout)."""
+
+    def run(cols):
+        return [lower(e, cols, cap) for e in exprs]
+
+    return jax.jit(run)
+
+
+def tpu_supports(expr: E.Expression, schema: T.StructType) -> Tuple[bool, str]:
+    """Static supportability probe used by the planner: trace with abstract
+    values; UnsupportedExpressionError means fallback."""
+    import jax.numpy as _jnp  # noqa: F401
+
+    try:
+        bound = E.bind_references(expr, schema)
+        cap = 8
+        cols = []
+        for f in schema.fields:
+            if isinstance(f.dataType, (T.StringType, T.BinaryType)):
+                cols.append(
+                    StrV(
+                        jnp.zeros(cap + 1, jnp.int32),
+                        jnp.zeros(1, jnp.uint8),
+                        jnp.zeros(cap, jnp.bool_),
+                    )
+                )
+            else:
+                cols.append(
+                    ColV(
+                        jnp.zeros(cap, dtype=f.dataType.to_numpy()),
+                        jnp.zeros(cap, jnp.bool_),
+                    )
+                )
+        jax.eval_shape(lambda cs: lower(bound, cs, cap), cols)
+        return True, ""
+    except UnsupportedExpressionError as e:
+        return False, str(e)
+    except TypeError as e:
+        return False, str(e)
+
+
+def evaluate_projection(
+    bound_exprs: Sequence[E.Expression], batch: ColumnarBatch
+) -> List[DeviceColumn]:
+    """Evaluate bound expressions against a batch, one fused XLA call.
+
+    Reference analog: GpuProjectExec.project (basicPhysicalOperators.scala:48)
+    doing per-expression columnarEval; here it is a single executable.
+    """
+    cap = batch.columns[0].capacity if batch.columns else 128
+    schema_sig = tuple(
+        (f.dataType, c.capacity, None if not c.is_string else int(c.chars.shape[0]))
+        for f, c in zip(batch.schema.fields, batch.columns)
+    )
+    fn = _compiled(tuple(bound_exprs), cap, schema_sig)
+    vals = fn([_col_to_vals(c) for c in batch.columns])
+    out = []
+    for e, v in zip(bound_exprs, vals):
+        if isinstance(v, StrV):
+            out.append(
+                DeviceColumn(e.dtype, batch.num_rows, None, v.validity, v.offsets, v.chars)
+            )
+        else:
+            out.append(DeviceColumn(e.dtype, batch.num_rows, v.data, v.validity))
+    return out

@@ -1,0 +1,701 @@
+"""Expression trees.
+
+Reference analog: the GpuExpression hierarchy
+(sql-plugin/.../GpuExpressions.scala:380 `columnarEval` contract, plus the
+per-area files arithmetic.scala / predicates.scala / conditionalExpressions.scala
+/ nullExpressions.scala / mathExpressions.scala / GpuCast.scala).
+
+TPU-first difference: the reference lowers each expression node to one cudf
+kernel launch; here a *whole bound tree* traces into a single jitted XLA
+computation (spark_rapids_tpu/expr/eval.py), so XLA fuses every elementwise op
+into one pass over HBM — strictly better than kernel-per-op on a
+bandwidth-bound chip.
+
+Expressions are frozen dataclasses: structural equality/hash give us
+canonicalization and the executable cache key for free
+(reference: GpuCanonicalize.scala).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Tuple
+
+from .. import types as T
+from ..types import DataType
+
+
+class Expression:
+    """Base node. Subclasses are frozen dataclasses; `children` is derived."""
+
+    @property
+    def children(self) -> Tuple["Expression", ...]:
+        return tuple(
+            v
+            for f in dataclasses.fields(self)  # type: ignore[arg-type]
+            for v in _as_children(getattr(self, f.name))
+        )
+
+    @property
+    def dtype(self) -> DataType:
+        raise NotImplementedError(type(self).__name__)
+
+    @property
+    def nullable(self) -> bool:
+        # conservative default; nodes that can prove non-nullability override
+        return True
+
+    @property
+    def pretty_name(self) -> str:
+        return type(self).__name__
+
+    def transform(self, fn):
+        """Bottom-up rewrite: rebuild this node with transformed children."""
+        if not dataclasses.is_dataclass(self):
+            return fn(self)
+        changes = {}
+        for f in dataclasses.fields(self):  # type: ignore[arg-type]
+            v = getattr(self, f.name)
+            nv = _transform_value(v, fn)
+            if nv is not v:
+                changes[f.name] = nv
+        node = dataclasses.replace(self, **changes) if changes else self
+        return fn(node)
+
+    def __str__(self):
+        return repr(self)
+
+
+def _as_children(v):
+    if isinstance(v, Expression):
+        yield v
+    elif isinstance(v, tuple):
+        for x in v:
+            if isinstance(x, Expression):
+                yield x
+            elif isinstance(x, tuple):
+                yield from _as_children(x)
+
+
+def _transform_value(v, fn):
+    if isinstance(v, Expression):
+        return v.transform(fn)
+    if isinstance(v, tuple):
+        new = tuple(_transform_value(x, fn) for x in v)
+        return new if any(n is not o for n, o in zip(new, v)) else v
+    return v
+
+
+# ---------------------------------------------------------------------------
+# Leaves
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Literal(Expression):
+    """reference: literals.scala GpuLiteral/GpuScalar.from"""
+
+    value: Any
+    data_type: DataType
+
+    @property
+    def dtype(self):
+        return self.data_type
+
+    @property
+    def nullable(self):
+        return self.value is None
+
+    @staticmethod
+    def of(value: Any) -> "Literal":
+        if value is None:
+            return Literal(None, T.NULL)
+        if isinstance(value, bool):
+            return Literal(value, T.BOOLEAN)
+        if isinstance(value, int):
+            return Literal(value, T.INT if -(2**31) <= value < 2**31 else T.LONG)
+        if isinstance(value, float):
+            return Literal(value, T.DOUBLE)
+        if isinstance(value, str):
+            return Literal(value, T.STRING)
+        if isinstance(value, bytes):
+            return Literal(value, T.BINARY)
+        raise TypeError(f"cannot make literal from {type(value)}")
+
+
+@dataclasses.dataclass(frozen=True)
+class UnresolvedAttribute(Expression):
+    name: str
+
+    @property
+    def dtype(self):
+        raise ValueError(f"unresolved attribute {self.name}")
+
+
+@dataclasses.dataclass(frozen=True)
+class BoundReference(Expression):
+    """reference: GpuBoundAttribute.scala GpuBindReferences.bindGpuReferences"""
+
+    ordinal: int
+    data_type: DataType
+    is_nullable: bool = True
+
+    @property
+    def dtype(self):
+        return self.data_type
+
+    @property
+    def nullable(self):
+        return self.is_nullable
+
+
+@dataclasses.dataclass(frozen=True)
+class Alias(Expression):
+    child: Expression
+    name: str
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def nullable(self):
+        return self.child.nullable
+
+
+# ---------------------------------------------------------------------------
+# Arithmetic (reference: sql/rapids/arithmetic.scala)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class _BinaryNumeric(Expression):
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self):
+        return T.promote(self.left.dtype, self.right.dtype)
+
+
+class Add(_BinaryNumeric):
+    symbol = "+"
+
+
+class Subtract(_BinaryNumeric):
+    symbol = "-"
+
+
+class Multiply(_BinaryNumeric):
+    symbol = "*"
+
+
+@dataclasses.dataclass(frozen=True)
+class Divide(Expression):
+    """Spark `/` is always floating point; x/0 -> NULL (non-ANSI)."""
+
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+
+@dataclasses.dataclass(frozen=True)
+class IntegralDivide(Expression):
+    """Spark `div`: long division, x div 0 -> NULL."""
+
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+
+class Remainder(_BinaryNumeric):
+    """Spark %: sign follows dividend (Java), x % 0 -> NULL."""
+
+    symbol = "%"
+
+
+class Pmod(_BinaryNumeric):
+    """Positive modulo."""
+
+
+@dataclasses.dataclass(frozen=True)
+class UnaryMinus(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class UnaryPositive(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class Abs(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+# ---------------------------------------------------------------------------
+# Comparison predicates (reference: sql/rapids/predicates.scala)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class _BinaryComparison(Expression):
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+class EqualTo(_BinaryComparison):
+    symbol = "="
+
+
+class EqualNullSafe(_BinaryComparison):
+    """<=>: nulls compare equal, never returns null."""
+
+    symbol = "<=>"
+
+    @property
+    def nullable(self):
+        return False
+
+
+class LessThan(_BinaryComparison):
+    symbol = "<"
+
+
+class LessThanOrEqual(_BinaryComparison):
+    symbol = "<="
+
+
+class GreaterThan(_BinaryComparison):
+    symbol = ">"
+
+
+class GreaterThanOrEqual(_BinaryComparison):
+    symbol = ">="
+
+
+@dataclasses.dataclass(frozen=True)
+class In(Expression):
+    child: Expression
+    values: Tuple[Any, ...]  # python scalar values (may include None)
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+# ---------------------------------------------------------------------------
+# Three-valued logic (reference: predicates.scala GpuAnd/GpuOr/GpuNot)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class And(Expression):
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+@dataclasses.dataclass(frozen=True)
+class Or(Expression):
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+@dataclasses.dataclass(frozen=True)
+class Not(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+# ---------------------------------------------------------------------------
+# Null expressions (reference: sql/rapids/nullExpressions.scala)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class IsNull(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNotNull(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNan(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class Coalesce(Expression):
+    exprs: Tuple[Expression, ...]
+
+    @property
+    def dtype(self):
+        dt = self.exprs[0].dtype
+        for e in self.exprs[1:]:
+            if e.dtype != T.NULL:
+                if dt == T.NULL:
+                    dt = e.dtype
+                elif e.dtype != dt and dt.is_numeric and e.dtype.is_numeric:
+                    dt = T.promote(dt, e.dtype)
+        return dt
+
+
+@dataclasses.dataclass(frozen=True)
+class NaNvl(Expression):
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+
+# ---------------------------------------------------------------------------
+# Conditionals (reference: sql/rapids/conditionalExpressions.scala)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class If(Expression):
+    predicate: Expression
+    true_value: Expression
+    false_value: Expression
+
+    @property
+    def dtype(self):
+        dt = self.true_value.dtype
+        if dt == T.NULL:
+            return self.false_value.dtype
+        o = self.false_value.dtype
+        if o != T.NULL and o != dt:
+            return T.promote(dt, o)
+        return dt
+
+
+@dataclasses.dataclass(frozen=True)
+class CaseWhen(Expression):
+    branches: Tuple[Tuple[Expression, Expression], ...]
+    else_value: Optional[Expression] = None
+
+    @property
+    def dtype(self):
+        dt = T.NULL
+        vals = [v for _, v in self.branches]
+        if self.else_value is not None:
+            vals.append(self.else_value)
+        for v in vals:
+            if v.dtype != T.NULL:
+                dt = v.dtype if dt == T.NULL else (
+                    T.promote(dt, v.dtype) if v.dtype != dt else dt)
+        return dt
+
+
+# ---------------------------------------------------------------------------
+# Cast (reference: GpuCast.scala — every cast pair, ANSI variants gated)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Cast(Expression):
+    child: Expression
+    to: DataType
+    ansi: bool = False
+
+    @property
+    def dtype(self):
+        return self.to
+
+
+# ---------------------------------------------------------------------------
+# Math (reference: sql/rapids/mathExpressions.scala)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class _UnaryMathDouble(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+
+class Sqrt(_UnaryMathDouble):
+    pass
+
+
+class Exp(_UnaryMathDouble):
+    pass
+
+
+class Log(_UnaryMathDouble):
+    """Natural log; log(x<=0) -> NULL (Spark)."""
+
+
+class Log10(_UnaryMathDouble):
+    pass
+
+
+class Log2(_UnaryMathDouble):
+    pass
+
+
+class Log1p(_UnaryMathDouble):
+    pass
+
+
+class Sin(_UnaryMathDouble):
+    pass
+
+
+class Cos(_UnaryMathDouble):
+    pass
+
+
+class Tan(_UnaryMathDouble):
+    pass
+
+
+class Asin(_UnaryMathDouble):
+    pass
+
+
+class Acos(_UnaryMathDouble):
+    pass
+
+
+class Atan(_UnaryMathDouble):
+    pass
+
+
+class Sinh(_UnaryMathDouble):
+    pass
+
+
+class Cosh(_UnaryMathDouble):
+    pass
+
+
+class Tanh(_UnaryMathDouble):
+    pass
+
+
+class Cbrt(_UnaryMathDouble):
+    pass
+
+
+class Expm1(_UnaryMathDouble):
+    pass
+
+
+class ToDegrees(_UnaryMathDouble):
+    pass
+
+
+class ToRadians(_UnaryMathDouble):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Floor(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return T.LONG if self.child.dtype.is_floating else self.child.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class Ceil(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return T.LONG if self.child.dtype.is_floating else self.child.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class Round(Expression):
+    """HALF_UP rounding, matching Spark's BigDecimal semantics on doubles."""
+
+    child: Expression
+    scale: int = 0
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class Pow(Expression):
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+
+@dataclasses.dataclass(frozen=True)
+class Atan2(Expression):
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+
+@dataclasses.dataclass(frozen=True)
+class Signum(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+
+@dataclasses.dataclass(frozen=True)
+class Rint(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+
+# ---------------------------------------------------------------------------
+# Bitwise (reference: sql/rapids/bitwise.scala)
+# ---------------------------------------------------------------------------
+class BitwiseAnd(_BinaryNumeric):
+    symbol = "&"
+
+
+class BitwiseOr(_BinaryNumeric):
+    symbol = "|"
+
+
+class BitwiseXor(_BinaryNumeric):
+    symbol = "^"
+
+
+@dataclasses.dataclass(frozen=True)
+class BitwiseNot(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class ShiftLeft(Expression):
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class ShiftRight(Expression):
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class ShiftRightUnsigned(Expression):
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+
+# ---------------------------------------------------------------------------
+# Strings — minimal slice here; full set in ops/strings (M10)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Length(Expression):
+    """Character length (reference: stringFunctions.scala GpuLength)."""
+
+    child: Expression
+
+    @property
+    def dtype(self):
+        return T.INT
+
+
+# ---------------------------------------------------------------------------
+# Binding / resolution
+# ---------------------------------------------------------------------------
+def bind_references(expr: Expression, schema: T.StructType) -> Expression:
+    """Replace UnresolvedAttribute with BoundReference by schema position
+    (reference: GpuBindReferences.bindGpuReferences)."""
+
+    def rewrite(node):
+        if isinstance(node, UnresolvedAttribute):
+            i = schema.field_index(node.name)
+            f = schema.fields[i]
+            return BoundReference(i, f.dataType, f.nullable)
+        return node
+
+    return expr.transform(rewrite)
+
+
+def col(name: str) -> UnresolvedAttribute:
+    return UnresolvedAttribute(name)
+
+
+def lit(value: Any) -> Literal:
+    return Literal.of(value)

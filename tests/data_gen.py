@@ -1,0 +1,91 @@
+"""Randomized typed data generators with special-value injection.
+
+Analog of the reference's integration_tests data_gen.py:27-304 (seeded RNG,
+null injection, special values like NaN/inf/min/max woven into every column).
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, List, Optional
+
+from spark_rapids_tpu import types as T
+
+_SPECIALS = {
+    "tinyint": [0, 1, -1, 127, -128],
+    "smallint": [0, 1, -1, 32767, -32768],
+    "int": [0, 1, -1, 2**31 - 1, -(2**31)],
+    "bigint": [0, 1, -1, 2**63 - 1, -(2**63)],
+    "float": [0.0, -0.0, 1.0, -1.0, float("nan"), float("inf"), float("-inf")],
+    "double": [0.0, -0.0, 1.0, -1.0, float("nan"), float("inf"), float("-inf")],
+    "boolean": [True, False],
+    "string": ["", "a", "tpu", "NULL", "ñ→", "x" * 50],
+}
+
+_RANGES = {
+    "tinyint": (-128, 127),
+    "smallint": (-32768, 32767),
+    "int": (-(2**31), 2**31 - 1),
+    "bigint": (-(2**63), 2**63 - 1),
+}
+
+
+def gen_column(
+    dtype: T.DataType,
+    n: int,
+    rng: random.Random,
+    null_prob: float = 0.15,
+    special_prob: float = 0.2,
+) -> List[Any]:
+    name = dtype.name if not isinstance(dtype, T.DecimalType) else "bigint"
+    out: List[Any] = []
+    for _ in range(n):
+        if null_prob and rng.random() < null_prob:
+            out.append(None)
+            continue
+        if name in _SPECIALS and rng.random() < special_prob:
+            out.append(rng.choice(_SPECIALS[name]))
+            continue
+        if name in _RANGES:
+            lo, hi = _RANGES[name]
+            # mix of small and full-range values
+            if rng.random() < 0.7:
+                out.append(rng.randint(-100, 100))
+            else:
+                out.append(rng.randint(lo, hi))
+        elif name in ("float", "double"):
+            v = rng.uniform(-1e6, 1e6)
+            if name == "float":
+                import struct
+
+                v = struct.unpack("f", struct.pack("f", v))[0]
+            out.append(v)
+        elif name == "boolean":
+            out.append(rng.random() < 0.5)
+        elif name == "string":
+            k = rng.randint(0, 12)
+            out.append("".join(rng.choice("abcdefg \t0123ü") for _ in range(k)))
+        elif name == "date":
+            out.append(rng.randint(-30000, 30000))
+        elif name == "timestamp":
+            out.append(rng.randint(-(2**50), 2**50))
+        else:
+            raise NotImplementedError(name)
+    return out
+
+
+def approx_equal(a: Any, b: Any, rel: float = 1e-12) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) or isinstance(b, float):
+        fa, fb = float(a), float(b)
+        if math.isnan(fa) or math.isnan(fb):
+            return math.isnan(fa) and math.isnan(fb)
+        if math.isinf(fa) or math.isinf(fb):
+            return fa == fb
+        if fa == fb:
+            return True
+        return abs(fa - fb) <= rel * max(abs(fa), abs(fb), 1e-300)
+    if isinstance(a, bool) or isinstance(b, bool):
+        return bool(a) == bool(b)
+    return a == b

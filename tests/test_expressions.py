@@ -1,0 +1,316 @@
+"""Differential expression tests: TPU lowering vs independent CPU interpreter.
+
+The reference's core correctness idea (SparkQueryCompareTestSuite:
+testSparkResultsAreEqual, asserts.assert_gpu_and_cpu_are_equal_collect)
+applied at the expression layer: evaluate the same bound tree via the fused
+XLA path and the row interpreter, diff per row.
+"""
+import random
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import ColumnarBatch, schema_of
+from spark_rapids_tpu.cpu import eval_expression_rows
+from spark_rapids_tpu.expr import bind_references, col, evaluate_projection, lit
+from spark_rapids_tpu.expr import expressions as E
+
+from data_gen import approx_equal, gen_column
+
+N = 64
+
+
+def make_batch(schema, seed=0, null_prob=0.15):
+    rng = random.Random(seed)
+    data = {
+        f.name: gen_column(f.dataType, N, rng, null_prob=null_prob)
+        for f in schema.fields
+    }
+    return ColumnarBatch.from_pydict(data, schema), data
+
+
+def check(expr, schema, seed=0, rel=1e-12, null_prob=0.15):
+    batch, data = make_batch(schema, seed, null_prob)
+    bound = bind_references(expr, schema)
+    [tpu_col] = evaluate_projection([bound], batch)
+    tpu_vals = tpu_col.to_pylist()
+    rows = list(zip(*(data[f.name] for f in schema.fields)))
+    cpu_vals = eval_expression_rows(bound, rows)
+    assert len(tpu_vals) == len(cpu_vals)
+    for i, (tv, cv) in enumerate(zip(tpu_vals, cpu_vals)):
+        assert approx_equal(tv, cv, rel), (
+            f"row {i}: tpu={tv!r} cpu={cv!r} expr={expr} inputs={rows[i]}"
+        )
+
+
+NUM_SCHEMA = schema_of(a=T.INT, b=T.INT, c=T.LONG, d=T.DOUBLE, e=T.DOUBLE, f=T.FLOAT)
+BOOL_SCHEMA = schema_of(p=T.BOOLEAN, q=T.BOOLEAN, x=T.INT)
+
+
+@pytest.mark.parametrize("op", [E.Add, E.Subtract, E.Multiply])
+@pytest.mark.parametrize("pair", [("a", "b"), ("a", "c"), ("d", "e"), ("a", "d"), ("f", "f")])
+def test_arithmetic(op, pair):
+    check(op(col(pair[0]), col(pair[1])), NUM_SCHEMA, seed=hash((op.__name__, pair)) & 0xFFFF)
+
+
+def test_divide_null_on_zero():
+    schema = schema_of(a=T.INT, b=T.INT)
+    check(E.Divide(col("a"), col("b")), schema, seed=3)
+    # force zeros in denominator
+    batch = ColumnarBatch.from_pydict({"a": [1, 2, None, 5], "b": [0, 2, 2, 0]}, schema)
+    bound = bind_references(E.Divide(col("a"), col("b")), schema)
+    [r] = evaluate_projection([bound], batch)
+    assert r.to_pylist() == [None, 1.0, None, None]
+
+
+def test_integral_divide_and_remainder():
+    schema = schema_of(a=T.LONG, b=T.LONG)
+    check(E.IntegralDivide(col("a"), col("b")), schema, seed=5)
+    check(E.Remainder(col("a"), col("b")), schema, seed=6)
+    check(E.Pmod(col("a"), col("b")), schema, seed=7)
+    batch = ColumnarBatch.from_pydict({"a": [7, -7, 7, -7], "b": [2, 2, -2, -2]}, schema)
+    bound = bind_references(E.Remainder(col("a"), col("b")), schema)
+    [r] = evaluate_projection([bound], batch)
+    assert r.to_pylist() == [1, -1, 1, -1]  # Java: sign follows dividend
+    bound = bind_references(E.IntegralDivide(col("a"), col("b")), schema)
+    [r] = evaluate_projection([bound], batch)
+    assert r.to_pylist() == [3, -3, -3, 3]  # truncation toward zero
+
+
+@pytest.mark.parametrize(
+    "op", [E.EqualTo, E.LessThan, E.LessThanOrEqual, E.GreaterThan, E.GreaterThanOrEqual, E.EqualNullSafe]
+)
+def test_comparisons(op):
+    check(op(col("a"), col("b")), NUM_SCHEMA, seed=11)
+    check(op(col("d"), col("e")), NUM_SCHEMA, seed=12)
+    check(op(col("a"), col("c")), NUM_SCHEMA, seed=13)
+
+
+def test_three_valued_logic():
+    check(E.And(col("p"), col("q")), BOOL_SCHEMA, seed=21, null_prob=0.4)
+    check(E.Or(col("p"), col("q")), BOOL_SCHEMA, seed=22, null_prob=0.4)
+    check(E.Not(col("p")), BOOL_SCHEMA, seed=23, null_prob=0.4)
+    # exhaustive truth table
+    schema = schema_of(p=T.BOOLEAN, q=T.BOOLEAN)
+    vals = [True, False, None]
+    rows = [(x, y) for x in vals for y in vals]
+    batch = ColumnarBatch.from_pydict(
+        {"p": [r[0] for r in rows], "q": [r[1] for r in rows]}, schema
+    )
+    for op, expect in [
+        (E.And, [True, False, None, False, False, False, None, False, None]),
+        (E.Or, [True, True, True, True, False, None, True, None, None]),
+    ]:
+        bound = bind_references(op(col("p"), col("q")), schema)
+        [r] = evaluate_projection([bound], batch)
+        assert r.to_pylist() == expect, op.__name__
+
+
+def test_null_ops():
+    check(E.IsNull(col("a")), NUM_SCHEMA, seed=31, null_prob=0.5)
+    check(E.IsNotNull(col("d")), NUM_SCHEMA, seed=32, null_prob=0.5)
+    check(E.IsNan(col("d")), NUM_SCHEMA, seed=33)
+    check(E.Coalesce((col("a"), col("b"), lit(42))), NUM_SCHEMA, seed=34, null_prob=0.6)
+    check(E.NaNvl(col("d"), col("e")), NUM_SCHEMA, seed=35)
+
+
+def test_conditionals():
+    pred = E.GreaterThan(col("a"), lit(0))
+    check(E.If(pred, col("b"), col("a")), NUM_SCHEMA, seed=41)
+    case = E.CaseWhen(
+        branches=(
+            (E.GreaterThan(col("a"), lit(50)), lit(1)),
+            (E.GreaterThan(col("a"), lit(0)), lit(2)),
+        ),
+        else_value=lit(3),
+    )
+    check(case, NUM_SCHEMA, seed=42)
+    case_no_else = E.CaseWhen(branches=((E.LessThan(col("a"), lit(0)), col("b")),))
+    check(case_no_else, NUM_SCHEMA, seed=43)
+
+
+@pytest.mark.parametrize(
+    "to",
+    [T.BYTE, T.SHORT, T.INT, T.LONG, T.FLOAT, T.DOUBLE, T.BOOLEAN],
+)
+@pytest.mark.parametrize("frm", ["a", "c", "d", "f"])
+def test_casts(to, frm):
+    # float32 intermediate rounding differs; compare loosely for FLOAT target
+    rel = 1e-6 if to == T.FLOAT or frm == "f" else 1e-12
+    check(E.Cast(col(frm), to), NUM_SCHEMA, seed=51, rel=rel)
+
+
+def test_cast_saturation():
+    schema = schema_of(d=T.DOUBLE)
+    batch = ColumnarBatch.from_pydict(
+        {"d": [1e20, -1e20, float("nan"), 1.9, -1.9]}, schema
+    )
+    bound = bind_references(E.Cast(col("d"), T.INT), schema)
+    [r] = evaluate_projection([bound], batch)
+    assert r.to_pylist() == [2**31 - 1, -(2**31), 0, 1, -1]
+
+
+@pytest.mark.parametrize(
+    "op",
+    [E.Sqrt, E.Exp, E.Log, E.Log10, E.Log2, E.Log1p, E.Sin, E.Cos, E.Tan,
+     E.Asin, E.Acos, E.Atan, E.Sinh, E.Cosh, E.Tanh, E.Cbrt, E.Expm1,
+     E.ToDegrees, E.ToRadians],
+)
+def test_unary_math(op):
+    check(op(col("d")), NUM_SCHEMA, seed=61, rel=1e-9)
+    check(op(col("a")), NUM_SCHEMA, seed=62, rel=1e-9)
+
+
+def test_floor_ceil_round():
+    check(E.Floor(col("d")), NUM_SCHEMA, seed=71)
+    check(E.Ceil(col("d")), NUM_SCHEMA, seed=72)
+    check(E.Floor(col("a")), NUM_SCHEMA, seed=73)
+    check(E.Round(col("d"), 2), NUM_SCHEMA, seed=74, rel=1e-9)
+    check(E.Round(col("a"), -1), NUM_SCHEMA, seed=75)
+    check(E.Signum(col("d")), NUM_SCHEMA, seed=76)
+    check(E.Rint(col("d")), NUM_SCHEMA, seed=77)
+    schema = schema_of(d=T.DOUBLE)
+    batch = ColumnarBatch.from_pydict({"d": [2.5, -2.5, 3.5, 0.5]}, schema)
+    bound = bind_references(E.Round(col("d"), 0), schema)
+    [r] = evaluate_projection([bound], batch)
+    assert r.to_pylist() == [3.0, -3.0, 4.0, 1.0]  # HALF_UP, away from zero
+
+
+def test_pow_atan2():
+    check(E.Pow(col("a"), lit(2)), NUM_SCHEMA, seed=81, rel=1e-9)
+    check(E.Atan2(col("d"), col("e")), NUM_SCHEMA, seed=82, rel=1e-9)
+
+
+@pytest.mark.parametrize("op", [E.BitwiseAnd, E.BitwiseOr, E.BitwiseXor])
+def test_bitwise(op):
+    check(op(col("a"), col("b")), NUM_SCHEMA, seed=91)
+    check(op(col("c"), col("c")), NUM_SCHEMA, seed=92)
+
+
+def test_bitwise_not_and_shifts():
+    check(E.BitwiseNot(col("a")), NUM_SCHEMA, seed=93)
+    schema = schema_of(a=T.INT, s=T.INT)
+    rng_vals = {"a": [1, -1, 2**31 - 1, -(2**31), 255, None], "s": [1, 31, 33, 0, 4, 2]}
+    batch = ColumnarBatch.from_pydict(rng_vals, schema)
+    rows = list(zip(rng_vals["a"], rng_vals["s"]))
+    for op in (E.ShiftLeft, E.ShiftRight, E.ShiftRightUnsigned):
+        bound = bind_references(op(col("a"), col("s")), schema)
+        [r] = evaluate_projection([bound], batch)
+        assert r.to_pylist() == eval_expression_rows(bound, rows), op.__name__
+
+
+def test_in():
+    check(E.In(col("a"), (1, 2, 50)), NUM_SCHEMA, seed=95)
+    check(E.In(col("a"), (1, None, 50)), NUM_SCHEMA, seed=96)
+
+
+def test_string_passthrough_and_length():
+    schema = schema_of(s=T.STRING)
+    vals = ["héllo", "", None, "abc", "日本語"]
+    batch = ColumnarBatch.from_pydict({"s": vals}, schema)
+    bound = bind_references(col("s"), schema)
+    [r] = evaluate_projection([bound], batch)
+    assert r.to_pylist() == vals
+    bound = bind_references(E.Length(col("s")), schema)
+    [r] = evaluate_projection([bound], batch)
+    assert r.to_pylist() == [5, 0, None, 3, 3]  # character count, not bytes
+
+
+def test_string_literal():
+    schema = schema_of(a=T.INT)
+    batch = ColumnarBatch.from_pydict({"a": [1, 2, 3]}, schema)
+    bound = bind_references(lit("xy"), schema)
+    [r] = evaluate_projection([bound], batch)
+    assert r.to_pylist() == ["xy", "xy", "xy"]
+
+
+def test_nested_tree_fuses():
+    # (a + b) * 2 > c AND NOT isnull(d) — one fused executable
+    expr = E.And(
+        E.GreaterThan(E.Multiply(E.Add(col("a"), col("b")), lit(2)), col("c")),
+        E.Not(E.IsNull(col("d"))),
+    )
+    check(expr, NUM_SCHEMA, seed=99)
+
+
+def test_compile_cache_hit():
+    from spark_rapids_tpu.expr.eval import _compiled
+
+    _compiled.cache_clear()
+    schema = schema_of(a=T.INT)
+    b1 = ColumnarBatch.from_pydict({"a": list(range(10))}, schema)
+    b2 = ColumnarBatch.from_pydict({"a": list(range(90))}, schema)  # same bucket (128)
+    bound = bind_references(E.Add(col("a"), lit(1)), schema)
+    evaluate_projection([bound], b1)
+    evaluate_projection([bound], b2)
+    info = _compiled.cache_info()
+    assert info.misses == 1 and info.hits == 1
+
+
+def test_tpu_supports_probe():
+    from spark_rapids_tpu.expr import tpu_supports
+
+    schema = schema_of(a=T.INT, s=T.STRING)
+    ok, _ = tpu_supports(E.Add(col("a"), lit(1)), schema)
+    assert ok
+    ok, reason = tpu_supports(E.EqualTo(col("s"), lit("x")), schema)
+    assert not ok and "string" in reason
+
+
+def test_float_remainder_specials():
+    schema = schema_of(d=T.DOUBLE, e=T.DOUBLE)
+    check(E.Remainder(col("d"), col("e")), schema, seed=101)
+    check(E.Pmod(col("d"), col("e")), schema, seed=102)
+    inf = float("inf")
+    batch = ColumnarBatch.from_pydict(
+        {"d": [1.0, inf, 5.5, 7.0], "e": [0.0, 2.0, inf, 2.5]}, schema
+    )
+    bound = bind_references(E.Remainder(col("d"), col("e")), schema)
+    [r] = evaluate_projection([bound], batch)
+    vals = r.to_pylist()
+    import math as m
+
+    assert m.isnan(vals[0]) and m.isnan(vals[1])  # x%0, inf%y -> NaN
+    assert vals[2] == 5.5 and vals[3] == 2.0  # x%inf == x
+
+
+def test_in_literal_coercion():
+    schema = schema_of(a=T.INT)
+    batch = ColumnarBatch.from_pydict({"a": [1, 2, None]}, schema)
+    # out-of-int32-range literal widens instead of crashing
+    bound = bind_references(E.In(col("a"), (1, 2**32 + 1)), schema)
+    [r] = evaluate_projection([bound], batch)
+    assert r.to_pylist() == [True, False, None]
+    # beyond-int64 literal can never match
+    bound = bind_references(E.In(col("a"), (2**70,)), schema)
+    [r] = evaluate_projection([bound], batch)
+    assert r.to_pylist() == [False, False, None]
+    # float literal compares exactly, no truncation
+    bound = bind_references(E.In(col("a"), (1.5,)), schema)
+    [r] = evaluate_projection([bound], batch)
+    assert r.to_pylist() == [False, False, None]
+    bound = bind_references(E.In(col("a"), (2.0,)), schema)
+    [r] = evaluate_projection([bound], batch)
+    assert r.to_pylist() == [False, True, None]
+
+
+def test_nan_comparison_semantics():
+    nan = float("nan")
+    schema = schema_of(d=T.DOUBLE, e=T.DOUBLE)
+    batch = ColumnarBatch.from_pydict(
+        {"d": [nan, nan, 1.0, nan], "e": [nan, 1.0, nan, None]}, schema
+    )
+    cases = {
+        E.EqualTo: [True, False, False, None],
+        E.EqualNullSafe: [True, False, False, False],
+        E.LessThan: [False, False, True, None],
+        E.LessThanOrEqual: [True, False, True, None],
+        E.GreaterThan: [False, True, False, None],
+        E.GreaterThanOrEqual: [True, True, False, None],
+    }
+    for op, expect in cases.items():
+        bound = bind_references(op(col("d"), col("e")), schema)
+        [r] = evaluate_projection([bound], batch)
+        assert r.to_pylist() == expect, op.__name__
+        rows = list(zip(batch.to_pydict()["d"], batch.to_pydict()["e"]))
+        assert eval_expression_rows(bound, rows) == expect, f"cpu {op.__name__}"
