@@ -1,0 +1,12 @@
+"""TPU kernel layer: the surface cudf provided to the reference plugin
+(SURVEY.md §2.12 item 1), re-designed as jit-compiled XLA computations over
+fixed-capacity column arrays.
+
+Modules:
+  filter_gather — mask compaction + row gather (cudf table.filter/gather)
+  sort          — multi-key stable sort with Spark null/NaN ordering
+  groupby       — sort-based segment-reduce aggregation (cudf groupBy.aggregate)
+  hashing       — murmur3 (Spark-compatible) for hash partitioning & hash exprs
+  join          — sort + searchsorted join expansion (cudf join family)
+"""
+from . import filter_gather, groupby, hashing, sort  # noqa: F401

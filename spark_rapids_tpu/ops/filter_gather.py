@@ -1,0 +1,118 @@
+"""Row selection kernels: mask compaction and row gather.
+
+Reference analog: cudf ``table.filter(mask)`` (used by GpuFilter,
+basicPhysicalOperators.scala:113-129) and ``table.gather`` — C++ kernels with
+dynamic output sizes. TPU re-design: output stays at a *static* capacity
+(selected rows compacted to the front, tail slots zeroed with validity=False)
+so one XLA executable serves every batch in a capacity bucket. The logical
+row count comes back as a device scalar; callers materialize it only at batch
+boundaries, mirroring where cudf syncs for the output row count.
+
+All functions are pure and trace-safe (usable under jit/shard_map).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..expr.eval import ColV, StrV, Val
+
+
+def compaction_indices(mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Destination-order gather indices for selected rows.
+
+    Returns (indices, count): ``indices[j]`` = row of the j-th selected row
+    for j < count; tail entries point at row 0 (callers mask them out).
+    """
+    cap = mask.shape[0]
+    # position of each output slot among selected rows: a stable
+    # "selected-first" permutation via argsort of the inverted mask.
+    order = jnp.argsort(~mask, stable=True)
+    count = jnp.sum(mask.astype(jnp.int32))
+    return order.astype(jnp.int32), count
+
+
+def gather_fixed(col: ColV, indices: jax.Array, valid_slot: jax.Array) -> ColV:
+    """Gather rows of a fixed-width column; ``valid_slot`` marks live outputs."""
+    data = jnp.take(col.data, indices, mode="clip")
+    validity = jnp.take(col.validity, indices, mode="clip") & valid_slot
+    data = jnp.where(validity, data, jnp.zeros((), dtype=data.dtype))
+    return ColV(data, validity)
+
+
+def gather_string(
+    col: StrV, indices: jax.Array, valid_slot: jax.Array, out_char_cap: int
+) -> StrV:
+    """Gather rows of a string column (Arrow offsets+bytes layout).
+
+    Two-pass like cudf's strings gather: sizes first (new offsets by prefix
+    sum), then a byte-level gather computed from the inverse offset map. All
+    shapes static: output rows = len(indices), bytes = out_char_cap.
+    """
+    m = indices.shape[0]
+    lens = col.offsets[1:] - col.offsets[:-1]
+    validity = jnp.take(col.validity, indices, mode="clip") & valid_slot
+    sel_lens = jnp.where(validity, jnp.take(lens, indices, mode="clip"), 0)
+    new_offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(sel_lens).astype(jnp.int32)]
+    )
+    total = new_offsets[m]
+    j = jnp.arange(out_char_cap, dtype=jnp.int32)
+    # output byte j belongs to output row r
+    r = jnp.clip(
+        jnp.searchsorted(new_offsets, j, side="right").astype(jnp.int32) - 1,
+        0,
+        m - 1,
+    )
+    src_row = jnp.take(indices, r, mode="clip")
+    src_byte = jnp.take(col.offsets, src_row, mode="clip") + (
+        j - jnp.take(new_offsets, r, mode="clip")
+    )
+    in_range = j < total
+    nchars = col.chars.shape[0]
+    chars = jnp.where(
+        in_range,
+        jnp.take(col.chars, jnp.clip(src_byte, 0, nchars - 1), mode="clip"),
+        jnp.zeros((), jnp.uint8),
+    )
+    return StrV(new_offsets, chars, validity)
+
+
+def gather(
+    cols: Sequence[Val], indices: jax.Array, valid_slot: jax.Array
+) -> List[Val]:
+    """Gather each column by row ``indices`` (same output rows for all)."""
+    out: List[Val] = []
+    for c in cols:
+        if isinstance(c, StrV):
+            out.append(gather_string(c, indices, valid_slot, int(c.chars.shape[0])))
+        else:
+            out.append(gather_fixed(c, indices, valid_slot))
+    return out
+
+
+def filter_cols(
+    cols: Sequence[Val], mask: jax.Array, num_rows: Union[int, jax.Array]
+) -> Tuple[List[Val], jax.Array]:
+    """Compact rows where ``mask`` holds to the front of each column.
+
+    ``mask`` must already be False in padding slots (>= num_rows). Returns
+    (new columns, new logical row count as a device scalar).
+    """
+    del num_rows  # the mask already excludes padding
+    indices, count = compaction_indices(mask)
+    cap = mask.shape[0]
+    valid_slot = jnp.arange(cap, dtype=jnp.int32) < count
+    return gather(cols, indices, valid_slot), count
+
+
+def slice_cols(
+    cols: Sequence[Val], start: int, length_cap: int, num_rows: jax.Array
+) -> Tuple[List[Val], jax.Array]:
+    """Static-shape row slice [start, start+length_cap) of a column set."""
+    indices = jnp.arange(length_cap, dtype=jnp.int32) + start
+    count = jnp.clip(num_rows - start, 0, length_cap)
+    valid_slot = indices < num_rows
+    return gather(cols, indices, valid_slot), count

@@ -1,0 +1,230 @@
+"""Sort-based group-by aggregation kernels.
+
+Reference analog: cudf ``table.groupBy(...).aggregate(...)`` as called from
+GpuHashAggregateExec (aggregate.scala:806). cudf hash-aggregates; on TPU a
+hash table of dynamic size fights XLA, so the design is the classic
+sort-compatible alternative the same exec supports: stable-sort rows by the
+grouping keys (ops/sort.py), derive segment ids from key-change boundaries,
+and reduce each segment with ``jax.ops.segment_*`` — one fused XLA program,
+fully static shapes (worst case: every row its own group, so num_segments =
+capacity). Null keys form their own group (Spark semantics); aggregate
+inputs skip nulls; NaN groups as equal to NaN.
+
+Reductions provided: count_star, count, sum, min, max, first/last (+
+ignore-null variants). Average is decomposed by the exec layer into
+sum+count partials, mirroring Spark's update/merge model.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..expr.eval import ColV, StrV, Val
+from .filter_gather import gather
+from .sort import SortOrder, sort_with_radix_keys
+
+
+def segment_ids_from_radix_keys(
+    sorted_radix_keys: Sequence[jax.Array],
+    num_rows: Union[int, jax.Array],
+) -> Tuple[jax.Array, jax.Array]:
+    """(segment_ids, num_segments) from the co-sorted radix key arrays.
+
+    Two adjacent rows belong to the same group iff every radix key matches
+    — the radix encoding already folds Spark's equality rules in
+    (null==null via the null-rank key, NaN canonicalized, -0.0 -> 0.0,
+    strings as byte chunks). Padding rows get an out-of-range id so every
+    segment_* scatter drops them.
+    """
+    cap = sorted_radix_keys[0].shape[0]
+    eq = jnp.ones(cap, jnp.bool_)
+    for k in sorted_radix_keys:
+        eq = eq & (k == jnp.roll(k, 1))
+    live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+    new_seg = live & (~eq | (jnp.arange(cap) == 0))
+    seg = jnp.cumsum(new_seg.astype(jnp.int32)) - 1
+    num_segments = jnp.max(jnp.where(live, seg, -1)) + 1
+    seg = jnp.where(live, seg, cap)  # out-of-range for padding
+    return seg, num_segments
+
+
+_INT_MIN_MAX = {
+    jnp.dtype(jnp.int8): (-(2**7), 2**7 - 1),
+    jnp.dtype(jnp.int16): (-(2**15), 2**15 - 1),
+    jnp.dtype(jnp.int32): (-(2**31), 2**31 - 1),
+    jnp.dtype(jnp.int64): (-(2**63), 2**63 - 1),
+}
+
+
+def _segment_count(valid: jax.Array, seg: jax.Array, ncap: int) -> jax.Array:
+    return jax.ops.segment_sum(valid.astype(jnp.int64), seg, num_segments=ncap)
+
+
+def segment_reduce(
+    op: str,
+    col: Optional[ColV],
+    seg: jax.Array,
+    ncap: int,
+    live: jax.Array,
+) -> ColV:
+    """One aggregation over segments. Returns (ncap,)-shaped ColV."""
+    if op == "count_star":
+        cnt = jax.ops.segment_sum(live.astype(jnp.int64), seg, num_segments=ncap)
+        return ColV(cnt, jnp.ones(ncap, jnp.bool_))
+    assert col is not None
+    valid = col.validity & live
+    data = col.data
+    if op == "count":
+        cnt = _segment_count(valid, seg, ncap)
+        return ColV(cnt, jnp.ones(ncap, jnp.bool_))
+    cnt = _segment_count(valid, seg, ncap)
+    has = cnt > 0
+    if op == "sum":
+        z = jnp.zeros((), data.dtype)
+        s = jax.ops.segment_sum(jnp.where(valid, data, z), seg, num_segments=ncap)
+        return ColV(s, has)
+    if op in ("min", "max"):
+        isfloat = jnp.issubdtype(data.dtype, jnp.floating)
+        if isfloat:
+            if op == "max":
+                # Spark: NaN is the largest double; IEEE max propagates NaN,
+                # which is exactly the desired result, so plain masking works
+                fill = jnp.array(-jnp.inf, data.dtype)
+                d = jnp.where(valid, data, fill)
+                r = jax.ops.segment_max(d, seg, num_segments=ncap)
+            else:
+                # min must *skip* NaN unless the group is all-NaN
+                nan_as_inf = jnp.where(jnp.isnan(data), jnp.inf, data)
+                d = jnp.where(valid, nan_as_inf, jnp.inf).astype(data.dtype)
+                r = jax.ops.segment_min(d, seg, num_segments=ncap)
+                non_nan = _segment_count(valid & ~jnp.isnan(data), seg, ncap)
+                r = jnp.where((non_nan == 0) & has, jnp.nan, r)
+        else:
+            lo, hi = _INT_MIN_MAX.get(
+                jnp.dtype(data.dtype), (0, 1)
+            )
+            if data.dtype == jnp.bool_:
+                fill = jnp.array(op == "min", jnp.bool_)
+                d = jnp.where(valid, data, fill)
+                r = (
+                    jax.ops.segment_max(d, seg, num_segments=ncap)
+                    if op == "max"
+                    else jax.ops.segment_min(d, seg, num_segments=ncap)
+                )
+            else:
+                fill = jnp.array(lo if op == "max" else hi, data.dtype)
+                d = jnp.where(valid, data, fill)
+                r = (
+                    jax.ops.segment_max(d, seg, num_segments=ncap)
+                    if op == "max"
+                    else jax.ops.segment_min(d, seg, num_segments=ncap)
+                )
+        z = jnp.zeros((), r.dtype)
+        return ColV(jnp.where(has, r, z), has)
+    if op in ("first", "last", "first_ignorenulls", "last_ignorenulls"):
+        cap = data.shape[0]
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        consider = valid if op.endswith("ignorenulls") else live
+        big = jnp.int32(cap)
+        if op.startswith("first"):
+            pos = jax.ops.segment_min(
+                jnp.where(consider, idx, big), seg, num_segments=ncap
+            )
+        else:
+            pos = jax.ops.segment_max(
+                jnp.where(consider, idx, jnp.int32(-1)), seg, num_segments=ncap
+            )
+        found = (pos >= 0) & (pos < cap)
+        safe = jnp.clip(pos, 0, cap - 1)
+        vals = jnp.take(data, safe, mode="clip")
+        val_valid = jnp.take(col.validity, safe, mode="clip") & found
+        z = jnp.zeros((), vals.dtype)
+        return ColV(jnp.where(val_valid, vals, z), val_valid)
+    raise ValueError(f"unknown aggregation op {op!r}")
+
+
+def sort_groupby(
+    key_cols: Sequence[Val],
+    key_dtypes: Sequence[T.DataType],
+    value_cols: Sequence[Optional[ColV]],
+    agg_ops: Sequence[str],
+    num_rows: Union[int, jax.Array],
+    str_max_lens: Sequence[int] = (),
+) -> Tuple[List[Val], List[ColV], jax.Array]:
+    """Full groupby: sort by keys, segment, reduce.
+
+    ``value_cols[i]`` is the (pre-cast) input for ``agg_ops[i]`` (None for
+    count_star). Returns (group key columns, aggregate columns, num_groups);
+    outputs are compacted to the front at the input capacity.
+    """
+    cap = (
+        key_cols[0].offsets.shape[0] - 1
+        if isinstance(key_cols[0], StrV)
+        else key_cols[0].validity.shape[0]
+    )
+    orders = [SortOrder(True, True) for _ in key_cols]
+    perm, radix = sort_with_radix_keys(
+        key_cols, key_dtypes, orders, num_rows, str_max_lens
+    )
+    live_in = jnp.arange(cap, dtype=jnp.int32) < num_rows
+    live = jnp.take(live_in, perm, mode="clip")
+    sorted_keys = gather(key_cols, perm, live)
+    sorted_vals: List[Optional[ColV]] = []
+    for v in value_cols:
+        if v is None:
+            sorted_vals.append(None)
+        else:
+            g = gather([v], perm, live)[0]
+            assert isinstance(g, ColV)
+            sorted_vals.append(g)
+    seg, nseg = segment_ids_from_radix_keys(radix, num_rows)
+
+    # representative row (first) of each segment, for key output
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    first_row = jax.ops.segment_min(
+        jnp.where(live, idx, jnp.int32(cap)), seg, num_segments=cap
+    )
+    out_live = jnp.arange(cap, dtype=jnp.int32) < nseg
+    first_row = jnp.clip(first_row, 0, cap - 1)
+    out_keys = gather(sorted_keys, first_row, out_live)
+    out_aggs = [
+        segment_reduce(op, v, seg, cap, live)
+        for op, v in zip(agg_ops, sorted_vals)
+    ]
+    # aggregate outputs: zero validity in dead slots
+    out_aggs = [
+        ColV(jnp.where(out_live, a.data, jnp.zeros((), a.data.dtype)),
+             a.validity & out_live)
+        for a in out_aggs
+    ]
+    return out_keys, out_aggs, nseg
+
+
+def reduce_no_keys(
+    value_cols: Sequence[Optional[ColV]],
+    agg_ops: Sequence[str],
+    num_rows: Union[int, jax.Array],
+) -> List[ColV]:
+    """Grand aggregate (no grouping keys): one output row.
+
+    Reference analog: cudf reduce path in aggregate.scala:806.
+    """
+    if not value_cols:
+        return []
+    cap = next(
+        v.validity.shape[0] for v in value_cols if v is not None
+    ) if any(v is not None for v in value_cols) else 0
+    if cap == 0:
+        # only count(*) over an implicit capacity — caller supplies rows
+        cnt = jnp.asarray(num_rows, jnp.int64).reshape(1)
+        return [ColV(cnt, jnp.ones(1, jnp.bool_)) for _ in agg_ops]
+    live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+    seg = jnp.where(live, 0, 1)
+    outs = []
+    for op, v in zip(agg_ops, value_cols):
+        r = segment_reduce(op, v, seg, 1, live)
+        outs.append(r)
+    return outs

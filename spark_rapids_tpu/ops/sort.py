@@ -1,0 +1,209 @@
+"""Multi-key stable sort with Spark SQL ordering semantics.
+
+Reference analog: cudf ``table.orderBy`` used by GpuSortExec
+(GpuSortExec.scala:51, SortUtils.scala). TPU re-design: every key column is
+bijected into order-preserving unsigned "radix keys" (int sign-flip trick,
+IEEE-754 total-order trick with NaN/-0.0 canonicalized to Spark semantics,
+4-byte big-endian chunks for strings), then one ``lax.sort`` call over
+[padding_rank, k1_nulls, k1_value..., row_id] yields the permutation. XLA
+lowers this to the TPU's bitonic sort; gathering the permuted rows afterwards
+reuses the filter_gather kernels.
+
+Spark ordering rules implemented here:
+  * ASC defaults to NULLS FIRST, DESC to NULLS LAST (explicit here).
+  * NaN compares equal to NaN and greater than any other double.
+  * -0.0 == 0.0.
+  * Strings compare as unsigned UTF-8 bytes (UTF8String.compareTo).
+  * Padding slots (row >= num_rows) always sort last.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import types as T
+from ..expr.eval import ColV, StrV, Val
+from .filter_gather import gather
+
+
+@dataclasses.dataclass(frozen=True)
+class SortOrder:
+    """One sort key: column index + direction (reference: SortUtils.scala)."""
+
+    ascending: bool = True
+    nulls_first: bool = None  # type: ignore[assignment]  # None = Spark default
+
+    @property
+    def nulls_first_resolved(self) -> bool:
+        if self.nulls_first is None:
+            return self.ascending
+        return self.nulls_first
+
+
+def _flip(k: jax.Array) -> jax.Array:
+    return ~k
+
+
+def _int_radix(data: jax.Array) -> jax.Array:
+    """Order-preserving signed->unsigned bijection (sign-bit flip)."""
+    nbits = data.dtype.itemsize * 8
+    if nbits <= 32:
+        u = data.astype(jnp.int32).astype(jnp.uint32)
+        return u ^ jnp.uint32(1 << 31)
+    u = data.astype(jnp.uint64)
+    return u ^ jnp.uint64(1 << 63)
+
+
+def _float_radix(data: jax.Array) -> jax.Array:
+    """IEEE total-order trick with Spark's NaN-largest / -0.0==0.0 rules."""
+    if data.dtype == jnp.float32:
+        canon_nan = jnp.float32(jnp.nan)
+        zero = jnp.float32(0.0)
+        d = jnp.where(jnp.isnan(data), canon_nan, data)
+        d = jnp.where(d == zero, zero, d)  # folds -0.0 to +0.0
+        bits = lax.bitcast_convert_type(d, jnp.uint32)
+        sign = jnp.uint32(1 << 31)
+        return jnp.where(bits & sign != 0, ~bits, bits ^ sign)
+    canon_nan64 = jnp.float64(jnp.nan)
+    zero64 = jnp.float64(0.0)
+    d = jnp.where(jnp.isnan(data), canon_nan64, data)
+    d = jnp.where(d == zero64, zero64, d)
+    bits = lax.bitcast_convert_type(d, jnp.uint64)
+    sign64 = jnp.uint64(1 << 63)
+    return jnp.where(bits & sign64 != 0, ~bits, bits ^ sign64)
+
+
+def fixed_radix_keys(col: ColV, dtype: T.DataType, order: SortOrder) -> List[jax.Array]:
+    """[null_rank, value_key] for a fixed-width column."""
+    if dtype.is_floating:
+        k = _float_radix(col.data)
+    elif isinstance(dtype, T.BooleanType):
+        k = col.data.astype(jnp.uint32)
+    else:  # integral / date / timestamp / decimal(int64)
+        k = _int_radix(col.data)
+    if not order.ascending:
+        k = _flip(k)
+    null_rank = jnp.where(
+        col.validity,
+        jnp.uint32(1),
+        jnp.uint32(0) if order.nulls_first_resolved else jnp.uint32(2),
+    )
+    # zero the key for nulls so null rows compare equal regardless of padding
+    k = jnp.where(col.validity, k, jnp.zeros((), k.dtype))
+    return [null_rank, k]
+
+
+def string_chunk_keys(
+    col: StrV, order: SortOrder, max_len: int
+) -> List[jax.Array]:
+    """[null_rank, chunk0, chunk1, ...]: 4-byte big-endian uint32 chunks.
+
+    Lexicographic comparison over the chunk sequence equals unsigned byte
+    comparison (shorter strings zero-padded, and a zero chunk sorts before
+    any longer content — matching UTF8String binary order).
+    ``max_len`` must be a static bound on byte length (bucketed by caller).
+    """
+    cap = col.offsets.shape[0] - 1
+    nchunks = max(1, (max_len + 3) // 4)
+    starts = col.offsets[:-1]
+    ends = col.offsets[1:]
+    nchars = col.chars.shape[0]
+    keys: List[jax.Array] = []
+    null_rank = jnp.where(
+        col.validity,
+        jnp.uint32(1),
+        jnp.uint32(0) if order.nulls_first_resolved else jnp.uint32(2),
+    )
+    keys.append(null_rank)
+    for c in range(nchunks):
+        chunk = jnp.zeros(cap, jnp.uint32)
+        for b in range(4):
+            pos = starts + (4 * c + b)
+            byte = jnp.where(
+                pos < ends,
+                jnp.take(col.chars, jnp.clip(pos, 0, nchars - 1), mode="clip"),
+                jnp.zeros((), jnp.uint8),
+            ).astype(jnp.uint32)
+            chunk = (chunk << 8) | byte
+        if not order.ascending:
+            chunk = _flip(chunk)
+        chunk = jnp.where(col.validity, chunk, jnp.zeros((), jnp.uint32))
+        keys.append(chunk)
+    return keys
+
+
+def sort_with_radix_keys(
+    key_cols: Sequence[Val],
+    key_dtypes: Sequence[T.DataType],
+    orders: Sequence[SortOrder],
+    num_rows: Union[int, jax.Array],
+    str_max_lens: Sequence[int] = (),
+) -> Tuple[jax.Array, List[jax.Array]]:
+    """(permutation, sorted radix key arrays); padding rows sort last.
+
+    The returned key arrays are already in sorted order (``lax.sort``
+    co-sorts every operand), letting group-by derive segment boundaries by
+    comparing adjacent radix keys instead of re-comparing raw columns —
+    string equality in particular falls out of the chunk keys for free.
+    ``str_max_lens`` supplies the static byte-length bound for each string
+    key, in order of appearance.
+    """
+    cap = (
+        key_cols[0].offsets.shape[0] - 1
+        if isinstance(key_cols[0], StrV)
+        else key_cols[0].validity.shape[0]
+    )
+    pad_rank = (jnp.arange(cap, dtype=jnp.int32) >= num_rows).astype(jnp.uint32)
+    operands: List[jax.Array] = [pad_rank]
+    si = 0
+    for colv, dtype, order in zip(key_cols, key_dtypes, orders):
+        if isinstance(colv, StrV):
+            ml = str_max_lens[si] if si < len(str_max_lens) else 64
+            si += 1
+            operands.extend(string_chunk_keys(colv, order, ml))
+        else:
+            operands.extend(fixed_radix_keys(colv, dtype, order))
+    row_id = jnp.arange(cap, dtype=jnp.int32)
+    operands.append(row_id)
+    sorted_ops = lax.sort(operands, num_keys=len(operands) - 1, is_stable=True)
+    return sorted_ops[-1], sorted_ops[1:-1]
+
+
+def sort_permutation(
+    key_cols: Sequence[Val],
+    key_dtypes: Sequence[T.DataType],
+    orders: Sequence[SortOrder],
+    num_rows: Union[int, jax.Array],
+    str_max_lens: Sequence[int] = (),
+) -> jax.Array:
+    """Stable sort permutation over the given keys; padding rows go last."""
+    perm, _ = sort_with_radix_keys(
+        key_cols, key_dtypes, orders, num_rows, str_max_lens
+    )
+    return perm
+
+
+def sort_cols(
+    cols: Sequence[Val],
+    key_indices: Sequence[int],
+    key_dtypes: Sequence[T.DataType],
+    orders: Sequence[SortOrder],
+    num_rows: Union[int, jax.Array],
+    str_max_lens: Sequence[int] = (),
+) -> List[Val]:
+    """Sort all columns by the keys at ``key_indices``."""
+    cap = cols[0].validity.shape[0] if not isinstance(cols[0], StrV) else cols[0].offsets.shape[0] - 1
+    perm = sort_permutation(
+        [cols[i] for i in key_indices], key_dtypes, orders, num_rows, str_max_lens
+    )
+    valid_slot = jnp.arange(cap, dtype=jnp.int32) < num_rows
+    return gather(cols, perm, valid_slot)
+
+
+def max_string_len(col: StrV) -> jax.Array:
+    """Device scalar max byte length (callers bucket it host-side)."""
+    return jnp.max(col.offsets[1:] - col.offsets[:-1])

@@ -1,0 +1,350 @@
+"""Unit tests for the ops kernel layer against numpy/pure-python oracles.
+
+Mirrors the reference's pure unit-test tier (SURVEY.md §4 tier 1/2):
+kernels validated independently of the exec layer.
+"""
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import column_from_pylist
+from spark_rapids_tpu.expr.eval import ColV, StrV
+from spark_rapids_tpu.ops import filter_gather, groupby, hashing, sort
+
+import jax.numpy as jnp
+
+
+def colv_of(values, dtype):
+    c = column_from_pylist(values, dtype)
+    if c.is_string:
+        return StrV(c.offsets, c.chars, c.validity), c
+    return ColV(c.data, c.validity), c
+
+
+def read_fixed(v: ColV, n):
+    data = np.asarray(v.data)[:n]
+    valid = np.asarray(v.validity)[:n]
+    return [data[i].item() if valid[i] else None for i in range(n)]
+
+
+def read_str(v: StrV, n):
+    off = np.asarray(v.offsets)
+    chars = np.asarray(v.chars).tobytes()
+    valid = np.asarray(v.validity)[:n]
+    return [
+        chars[off[i]: off[i + 1]].decode() if valid[i] else None
+        for i in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# filter / gather
+# ---------------------------------------------------------------------------
+class TestFilterGather:
+    def test_filter_compacts_front(self):
+        vals = [1, None, 3, 4, None, 6]
+        v, col = colv_of(vals, T.INT)
+        cap = col.capacity
+        mask = np.zeros(cap, dtype=bool)
+        mask[:6] = [True, False, True, False, False, True]
+        out, count = filter_gather.filter_cols([v], jnp.asarray(mask), 6)
+        assert int(count) == 3
+        assert read_fixed(out[0], 3) == [1, 3, 6]
+
+    def test_filter_keeps_nulls_when_selected(self):
+        vals = [1, None, 3]
+        v, col = colv_of(vals, T.INT)
+        mask = np.zeros(col.capacity, dtype=bool)
+        mask[:3] = [True, True, False]
+        out, count = filter_gather.filter_cols([v], jnp.asarray(mask), 3)
+        assert int(count) == 2
+        assert read_fixed(out[0], 2) == [1, None]
+
+    def test_string_gather(self):
+        vals = ["hello", None, "spark", "", "tpu!"]
+        v, col = colv_of(vals, T.STRING)
+        idx = jnp.asarray(np.array([4, 2, 0, 1], dtype=np.int32))
+        valid_slot = jnp.asarray(np.array([True, True, True, True]))
+        out = filter_gather.gather_string(v, idx, valid_slot, int(v.chars.shape[0]))
+        assert read_str(out, 4) == ["tpu!", "spark", "hello", None]
+
+    def test_slice(self):
+        vals = list(range(10))
+        v, col = colv_of(vals, T.LONG)
+        out, count = filter_gather.slice_cols([v], 3, 4, jnp.asarray(10))
+        assert int(count) == 4
+        assert read_fixed(out[0], 4) == [3, 4, 5, 6]
+
+    def test_slice_past_end(self):
+        vals = list(range(5))
+        v, col = colv_of(vals, T.INT)
+        out, count = filter_gather.slice_cols([v], 3, 4, jnp.asarray(5))
+        assert int(count) == 2
+        assert read_fixed(out[0], 2) == [3, 4]
+
+
+# ---------------------------------------------------------------------------
+# sort
+# ---------------------------------------------------------------------------
+class TestSort:
+    def _sort(self, values, dtype, ascending=True, nulls_first=None, n=None):
+        v, col = colv_of(values, dtype)
+        n = n or len(values)
+        out = sort.sort_cols(
+            [v], [0], [dtype], [sort.SortOrder(ascending, nulls_first)], n,
+            str_max_lens=[64],
+        )
+        if isinstance(out[0], StrV):
+            return read_str(out[0], n)
+        return read_fixed(out[0], n)
+
+    def test_int_asc_nulls_first(self):
+        got = self._sort([5, None, 3, -7, None, 0], T.INT)
+        assert got == [None, None, -7, 0, 3, 5]
+
+    def test_int_desc_nulls_last(self):
+        got = self._sort([5, None, 3, -7, None, 0], T.INT, ascending=False)
+        assert got == [5, 3, 0, -7, None, None]
+
+    def test_float_nan_sorts_largest(self):
+        got = self._sort([1.5, float("nan"), -2.0, float("inf"), None], T.DOUBLE)
+        assert got[0] is None
+        assert got[1] == -2.0 and got[2] == 1.5 and got[3] == float("inf")
+        assert np.isnan(got[4])
+
+    def test_negative_zero_equals_zero_stable(self):
+        # -0.0 and 0.0 compare equal; stable sort keeps input order
+        got = self._sort([0.0, -0.0, 1.0, -1.0], T.DOUBLE)
+        assert got == [-1.0, 0.0, -0.0, 1.0] or got == [-1.0, 0.0, 0.0, 1.0]
+
+    def test_string_binary_order(self):
+        vals = ["pear", "Pear", "apple", None, "app", "", "applesauce"]
+        got = self._sort(vals, T.STRING)
+        assert got == [None, "", "Pear", "app", "apple", "applesauce", "pear"]
+
+    def test_string_desc(self):
+        vals = ["b", "a", None, "c"]
+        got = self._sort(vals, T.STRING, ascending=False)
+        assert got == ["c", "b", "a", None]
+
+    def test_multi_key(self):
+        a_vals = [1, 1, 2, 2, 1]
+        b_vals = [9.0, 1.0, 5.0, None, 4.0]
+        va, _ = colv_of(a_vals, T.INT)
+        vb, _ = colv_of(b_vals, T.DOUBLE)
+        out = sort.sort_cols(
+            [va, vb], [0, 1], [T.INT, T.DOUBLE],
+            [sort.SortOrder(True), sort.SortOrder(False)], 5,
+        )
+        assert read_fixed(out[0], 5) == [1, 1, 1, 2, 2]
+        assert read_fixed(out[1], 5) == [9.0, 4.0, 1.0, 5.0, None]
+
+    def test_int64_extremes(self):
+        vals = [2**62, -(2**62), 0, None, -1]
+        got = self._sort(vals, T.LONG)
+        assert got == [None, -(2**62), -1, 0, 2**62]
+
+
+# ---------------------------------------------------------------------------
+# groupby
+# ---------------------------------------------------------------------------
+class TestGroupBy:
+    def test_sum_count_by_int_key(self):
+        keys = [1, 2, 1, None, 2, 1, None]
+        vals = [10, 20, 30, 40, None, 50, 60]
+        kv, _ = colv_of(keys, T.INT)
+        vv, _ = colv_of(vals, T.LONG)
+        out_keys, out_aggs, n = groupby.sort_groupby(
+            [kv], [T.INT], [vv, vv, None], ["sum", "count", "count_star"], 7
+        )
+        ng = int(n)
+        assert ng == 3
+        k = read_fixed(out_keys[0], ng)
+        s = read_fixed(out_aggs[0], ng)
+        c = read_fixed(out_aggs[1], ng)
+        cs = read_fixed(out_aggs[2], ng)
+        by_key = dict(zip(k, zip(s, c, cs)))
+        assert by_key[None] == (100, 2, 2)
+        assert by_key[1] == (90, 3, 3)
+        assert by_key[2] == (20, 1, 2)
+
+    def test_min_max_with_nan(self):
+        keys = [1, 1, 1, 2, 2]
+        vals = [float("nan"), 3.0, 1.0, float("nan"), None]
+        kv, _ = colv_of(keys, T.INT)
+        vv, _ = colv_of(vals, T.DOUBLE)
+        out_keys, out_aggs, n = groupby.sort_groupby(
+            [kv], [T.INT], [vv, vv], ["min", "max"], 5
+        )
+        ng = int(n)
+        k = read_fixed(out_keys[0], ng)
+        mn = read_fixed(out_aggs[0], ng)
+        mx = read_fixed(out_aggs[1], ng)
+        d = dict(zip(k, zip(mn, mx)))
+        # group 1: min skips NaN -> 1.0, max -> NaN (NaN is largest)
+        assert d[1][0] == 1.0 and np.isnan(d[1][1])
+        # group 2: only NaN (null skipped) -> min = max = NaN
+        assert np.isnan(d[2][0]) and np.isnan(d[2][1])
+
+    def test_all_null_group_sum_is_null(self):
+        keys = [1, 1, 2]
+        vals = [None, None, 5]
+        kv, _ = colv_of(keys, T.INT)
+        vv, _ = colv_of(vals, T.INT)
+        out_keys, out_aggs, n = groupby.sort_groupby(
+            [kv], [T.INT], [vv], ["sum"], 3
+        )
+        ng = int(n)
+        d = dict(zip(read_fixed(out_keys[0], ng), read_fixed(out_aggs[0], ng)))
+        assert d[1] is None and d[2] == 5
+
+    def test_string_keys(self):
+        keys = ["a", "b", "a", None, "b", "ab"]
+        vals = [1, 2, 3, 4, 5, 6]
+        kv, _ = colv_of(keys, T.STRING)
+        vv, _ = colv_of(vals, T.LONG)
+        out_keys, out_aggs, n = groupby.sort_groupby(
+            [kv], [T.STRING], [vv], ["sum"], 6, str_max_lens=[8]
+        )
+        ng = int(n)
+        assert ng == 4
+        d = dict(zip(read_str(out_keys[0], ng), read_fixed(out_aggs[0], ng)))
+        assert d == {None: 4, "a": 4, "b": 7, "ab": 6}
+
+    def test_first_last(self):
+        keys = [1, 1, 1, 2]
+        vals = [None, 7, 8, 9]
+        kv, _ = colv_of(keys, T.INT)
+        vv, _ = colv_of(vals, T.INT)
+        out_keys, out_aggs, n = groupby.sort_groupby(
+            [kv], [T.INT],
+            [vv, vv, vv, vv],
+            ["first", "last", "first_ignorenulls", "last_ignorenulls"], 4
+        )
+        ng = int(n)
+        k = read_fixed(out_keys[0], ng)
+        rows = {
+            k[i]: tuple(read_fixed(a, ng)[i] for a in out_aggs)
+            for i in range(ng)
+        }
+        assert rows[1] == (None, 8, 7, 8)
+        assert rows[2] == (9, 9, 9, 9)
+
+    def test_reduce_no_keys(self):
+        vals = [1.0, None, 3.0]
+        vv, _ = colv_of(vals, T.DOUBLE)
+        outs = groupby.reduce_no_keys([vv, vv, None], ["sum", "count", "count_star"], 3)
+        assert read_fixed(outs[0], 1) == [4.0]
+        assert read_fixed(outs[1], 1) == [2]
+        assert read_fixed(outs[2], 1) == [3]
+
+
+# ---------------------------------------------------------------------------
+# murmur3 — oracle is a straight transcription of Spark's Murmur3_x86_32
+# ---------------------------------------------------------------------------
+M32 = 0xFFFFFFFF
+
+
+def _rotl(x, r):
+    return ((x << r) | (x >> (32 - r))) & M32
+
+
+def _mixk1(k1):
+    k1 = (k1 * 0xCC9E2D51) & M32
+    k1 = _rotl(k1, 15)
+    return (k1 * 0x1B873593) & M32
+
+
+def _mixh1(h1, k1):
+    h1 = (h1 ^ k1) & M32
+    h1 = _rotl(h1, 13)
+    return (h1 * 5 + 0xE6546B64) & M32
+
+
+def _fmix(h1, length):
+    h1 = (h1 ^ length) & M32
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & M32
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & M32
+    h1 ^= h1 >> 16
+    return h1
+
+
+def oracle_hash_int(x, seed):
+    return _fmix(_mixh1(seed, _mixk1(x & M32)), 4)
+
+
+def oracle_hash_long(x, seed):
+    x &= 0xFFFFFFFFFFFFFFFF
+    h1 = _mixh1(seed, _mixk1(x & M32))
+    h1 = _mixh1(h1, _mixk1((x >> 32) & M32))
+    return _fmix(h1, 8)
+
+
+def oracle_hash_bytes(b, seed):
+    h1 = seed
+    n = len(b) - len(b) % 4
+    for i in range(0, n, 4):
+        word = int.from_bytes(b[i: i + 4], "little")
+        h1 = _mixh1(h1, _mixk1(word))
+    for i in range(n, len(b)):
+        sbyte = b[i] - 256 if b[i] >= 128 else b[i]
+        h1 = _mixh1(h1, _mixk1(sbyte & M32))
+    return _fmix(h1, len(b))
+
+
+def as_i32(u):
+    return u - (1 << 32) if u >= (1 << 31) else u
+
+
+class TestMurmur3:
+    def test_int_column(self):
+        vals = [0, 1, -1, 2**31 - 1, -(2**31), 42, None]
+        v, _ = colv_of(vals, T.INT)
+        got = np.asarray(hashing.murmur3([v], [T.INT]))[:7]
+        for i, x in enumerate(vals):
+            exp = 42 if x is None else as_i32(oracle_hash_int(x, 42))
+            assert got[i] == exp, (i, x)
+
+    def test_long_column(self):
+        vals = [0, 1, -1, 2**63 - 1, -(2**63), 123456789012345]
+        v, _ = colv_of(vals, T.LONG)
+        got = np.asarray(hashing.murmur3([v], [T.LONG]))[:6]
+        for i, x in enumerate(vals):
+            assert got[i] == as_i32(oracle_hash_long(x, 42)), (i, x)
+
+    def test_double_column(self):
+        import struct
+        vals = [0.0, -0.0, 1.5, -2.25, float("nan")]
+        v, _ = colv_of(vals, T.DOUBLE)
+        got = np.asarray(hashing.murmur3([v], [T.DOUBLE]))[:5]
+        for i, x in enumerate(vals):
+            if x == 0.0:
+                x = 0.0  # -0.0 normalized
+            bits = struct.unpack("<q", struct.pack("<d", x))[0]
+            assert got[i] == as_i32(oracle_hash_long(bits, 42)), (i, x)
+
+    def test_string_column(self):
+        vals = ["", "a", "ab", "abc", "abcd", "abcde", "hello world!", None]
+        v, _ = colv_of(vals, T.STRING)
+        got = np.asarray(hashing.murmur3([v], [T.STRING], str_max_lens=[16]))[:8]
+        for i, x in enumerate(vals):
+            exp = 42 if x is None else as_i32(oracle_hash_bytes(x.encode(), 42))
+            assert got[i] == exp, (i, x)
+
+    def test_multi_column_seed_chain(self):
+        a, _ = colv_of([1, None], T.INT)
+        b, _ = colv_of([5, 6], T.LONG)
+        got = np.asarray(hashing.murmur3([a, b], [T.INT, T.LONG]))[:2]
+        e0 = oracle_hash_long(5, oracle_hash_int(1, 42))
+        e1 = oracle_hash_long(6, 42)  # null int leaves seed untouched
+        assert got[0] == as_i32(e0)
+        assert got[1] == as_i32(e1)
+
+    def test_partition_ids_nonnegative(self):
+        v, _ = colv_of(list(range(100)), T.INT)
+        h = hashing.murmur3([v], [T.INT])
+        p = np.asarray(hashing.partition_ids(h, 7))
+        assert p.min() >= 0 and p.max() < 7
