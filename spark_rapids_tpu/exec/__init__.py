@@ -1,0 +1,20 @@
+"""Physical operator (exec) layer.
+
+Reference analog: the GpuExec hierarchy (GpuExec.scala:68,
+basicPhysicalOperators.scala, aggregate.scala, GpuSortExec.scala, joins under
+sql/rapids/execution/). Execs produce per-partition iterators of
+ColumnarBatch; the partition is the data-parallel unit exactly as Spark's
+RDD[ColumnarBatch] partitions are in the reference.
+"""
+from .base import Metric, TpuExec, batch_from_vals, vals_of_batch  # noqa: F401
+from .basic import (  # noqa: F401
+    TpuCoalesceBatchesExec,
+    TpuExpandExec,
+    TpuFilterExec,
+    TpuLocalLimitExec,
+    TpuProjectExec,
+    TpuRangeExec,
+    TpuUnionExec,
+    InMemoryScanExec,
+)
+from .aggregate import TpuHashAggregateExec  # noqa: F401

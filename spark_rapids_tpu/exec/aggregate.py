@@ -1,0 +1,314 @@
+"""Hash-aggregate exec (sort-compatible implementation on TPU).
+
+Reference analog: GpuHashAggregateExec (aggregate.scala:341-806): per-batch
+partial aggregation, a concat+merge loop across batches, then the final
+projection. The cudf hash groupby is replaced by ops/groupby's
+sort+segment-reduce (one fused XLA program per batch); the merge loop reuses
+the same kernel with each function's merge ops, exactly mirroring Spark's
+update/merge aggregate split so partial results can cross an exchange.
+
+Modes (expr/aggregates.py): COMPLETE (no exchange), PARTIAL (emit buffer
+columns), FINAL (merge buffer columns, evaluate results).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..columnar import ColumnarBatch
+from ..conf import RapidsConf
+from ..expr import aggregates as A
+from ..expr import expressions as E
+from ..expr.eval import ColV, StrV, Val, lower
+from ..ops import concat as concat_ops
+from ..ops import groupby as groupby_ops
+from ..ops.sort import max_string_len
+from ..types import StructField, StructType
+from ..utils.bucketing import bucket_rows
+from .base import (
+    TOTAL_TIME,
+    TpuExec,
+    batch_from_vals,
+    batch_signature,
+    timed,
+    vals_of_batch,
+)
+
+
+@functools.lru_cache(maxsize=256)
+def _agg_pipeline(
+    key_exprs: Tuple[E.Expression, ...],
+    key_dtypes: Tuple[T.DataType, ...],
+    value_exprs: Tuple[Optional[E.Expression], ...],
+    ops: Tuple[str, ...],
+    sig: tuple,
+    cap: int,
+    str_max_lens: Tuple[int, ...],
+):
+    """One fused program: project keys+inputs, sort, segment-reduce."""
+
+    def run(cols, num_rows):
+        keys = [lower(e, cols, cap) for e in key_exprs]
+        vals: List[Optional[ColV]] = []
+        for e in value_exprs:
+            vals.append(None if e is None else lower(e, cols, cap))
+        if key_exprs:
+            return groupby_ops.sort_groupby(
+                keys, list(key_dtypes), vals, list(ops), num_rows, str_max_lens
+            )
+        outs = groupby_ops.reduce_no_keys(vals, list(ops), num_rows)
+        return [], outs, jnp.int32(1)
+
+    return jax.jit(run)
+
+
+class TpuHashAggregateExec(TpuExec):
+    def __init__(
+        self,
+        conf: RapidsConf,
+        group_exprs: Sequence[E.Expression],
+        agg_exprs: Sequence[A.AggregateExpression],
+        child: TpuExec,
+        mode: str = A.COMPLETE,
+    ):
+        super().__init__(conf, [child])
+        self.group_exprs = list(group_exprs)
+        self.agg_exprs = list(agg_exprs)
+        self.mode = mode
+        child_schema = child.output_schema
+
+        # group key output fields
+        self._key_fields: List[StructField] = []
+        self._bound_keys: List[E.Expression] = []
+        for i, g in enumerate(self.group_exprs):
+            name = g.name if isinstance(g, (E.UnresolvedAttribute,)) else (
+                g.name if isinstance(g, E.Alias) else f"key{i}"
+            )
+            b = E.bind_references(g, child_schema)
+            self._key_fields.append(StructField(name, b.dtype, b.nullable))
+            self._bound_keys.append(b)
+
+        # bind each aggregate function's input against the child schema so
+        # dtype/buffer layout resolve (reference: boundInputReferences in
+        # aggregate.scala)
+        import dataclasses as _dc
+
+        nk = len(self.group_exprs)
+        self._bound_funcs: List[A.AggregateFunction] = []
+        bufpos = nk
+        for ae in self.agg_exprs:
+            f = ae.func
+            if f.input is not None:
+                if self.mode == A.FINAL:
+                    # child emits [keys..., buffers...]: bind the function's
+                    # input to its first buffer column so dtype/layout
+                    # resolve from the partial's output types
+                    bf = child_schema.fields[bufpos]
+                    f = _dc.replace(
+                        f, child=E.BoundReference(bufpos, bf.dataType, True)
+                    )
+                else:
+                    f = _dc.replace(
+                        f, child=E.bind_references(f.child, child_schema)
+                    )
+            self._bound_funcs.append(f)
+            bufpos += f.num_buffers
+
+        # per-function buffer layout
+        self._buf_fields: List[StructField] = []
+        self._update_exprs: List[Optional[E.Expression]] = []
+        self._update_ops: List[str] = []
+        self._merge_ops: List[str] = []
+        self._buf_slices: List[Tuple[int, int]] = []  # [start, end) per func
+        pos = 0
+        for ai, f in enumerate(self._bound_funcs):
+            ops = f.update_ops
+            bs = f.buffer_schema
+            self._buf_slices.append((pos, pos + len(ops)))
+            for j, ((op, in_expr), bdt) in enumerate(zip(ops, bs)):
+                self._buf_fields.append(
+                    StructField(f"agg{ai}_buf{j}", bdt, True)
+                )
+                if in_expr is None:
+                    self._update_exprs.append(None)
+                else:
+                    if self.mode == A.FINAL:
+                        # inputs are the buffer columns of the child
+                        self._update_exprs.append(None)  # filled below
+                    else:
+                        self._update_exprs.append(
+                            E.bind_references(in_expr, child_schema)
+                        )
+                self._update_ops.append(op)
+                pos += 1
+            self._merge_ops.extend(f.merge_ops)
+
+        if self.mode == A.FINAL:
+            # child emits [keys..., buffers...]; merge those buffers
+            nk = len(self._key_fields)
+            self._update_exprs = []
+            self._update_ops = list(self._merge_ops)
+            for j, bf in enumerate(self._buf_fields):
+                cf = child_schema.fields[nk + j]
+                self._update_exprs.append(
+                    E.BoundReference(nk + j, cf.dataType, True)
+                )
+            # keys come straight from the child's key columns
+            self._bound_keys = [
+                E.BoundReference(i, f.dataType, f.nullable)
+                for i, f in enumerate(child_schema.fields[:nk])
+            ]
+            self._key_fields = [
+                StructField(kf.name, cf.dataType, cf.nullable)
+                for kf, cf in zip(self._key_fields, child_schema.fields[:nk])
+            ] if self._key_fields else []
+
+        # output schema
+        if self.mode == A.PARTIAL:
+            self._schema = StructType(tuple(self._key_fields + self._buf_fields))
+        else:
+            fields = list(self._key_fields)
+            for ae, f in zip(self.agg_exprs, self._bound_funcs):
+                fields.append(StructField(ae.resolved_name(), f.dtype, True))
+            self._schema = StructType(tuple(fields))
+
+        # the evaluate projection runs over [keys..., buffers...]
+        self._buffer_schema = StructType(tuple(self._key_fields + self._buf_fields))
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def describe(self):
+        keys = ", ".join(str(k) for k in self.group_exprs)
+        aggs = ", ".join(a.resolved_name() for a in self.agg_exprs)
+        return f"TpuHashAggregateExec(mode={self.mode}, keys=[{keys}], aggs=[{aggs}])"
+
+    # -- helpers -----------------------------------------------------------
+    def _key_dtypes(self) -> Tuple[T.DataType, ...]:
+        return tuple(f.dataType for f in self._key_fields)
+
+    def _str_max_lens(self, batch: ColumnarBatch) -> Tuple[int, ...]:
+        """Static byte-length buckets for string group keys (host sync)."""
+        lens = []
+        for b in self._bound_keys:
+            if isinstance(b.dtype, (T.StringType, T.BinaryType)):
+                if isinstance(b, E.BoundReference):
+                    col = batch.columns[b.ordinal]
+                    m = int(max_string_len(StrV(col.offsets, col.chars, col.validity)))
+                else:
+                    m = 64
+                lens.append(max(4, bucket_rows(max(1, m), 4)))
+        return tuple(lens)
+
+    def _run_batch(self, batch: ColumnarBatch, ops: Sequence[str],
+                   value_exprs: Sequence[Optional[E.Expression]]) -> ColumnarBatch:
+        """Aggregate one batch into a [keys..., buffers...] batch."""
+        cap = batch.columns[0].capacity if batch.columns else bucket_rows(
+            batch.num_rows, self.conf.shape_bucket_min)
+        sml = self._str_max_lens(batch)
+        fn = _agg_pipeline(
+            tuple(self._bound_keys), self._key_dtypes(), tuple(value_exprs),
+            tuple(ops), batch_signature(batch), cap, sml,
+        )
+        keys, aggs, nseg = fn(vals_of_batch(batch), jnp.int32(batch.num_rows))
+        n = int(nseg)
+        vals = list(keys) + list(aggs)
+        return batch_from_vals(vals, self._buffer_schema, n)
+
+    def _merge(self, partials: List[ColumnarBatch]) -> ColumnarBatch:
+        """Concat partial batches and re-aggregate with merge ops
+        (reference: concatenateBatches + merge pass, aggregate.scala:451-476)."""
+        while len(partials) > 1:
+            lengths = [b.num_rows for b in partials]
+            total = sum(lengths)
+            out_cap = bucket_rows(total, self.conf.shape_bucket_min)
+            str_cols = [
+                j for j, f in enumerate(self._buffer_schema.fields)
+                if isinstance(f.dataType, (T.StringType, T.BinaryType))
+            ]
+            byte_lengths = [
+                [int(b.columns[j].offsets[b.num_rows]) for j in str_cols]
+                for b in partials
+            ]
+            out_char_caps = [
+                bucket_rows(max(1, sum(bl[k] for bl in byte_lengths)), 128)
+                for k in range(len(str_cols))
+            ]
+            cols, n = concat_ops.concat_batches_cols(
+                [vals_of_batch(b) for b in partials], lengths, byte_lengths,
+                out_cap, out_char_caps,
+            )
+            merged_in = batch_from_vals(cols, self._buffer_schema, n)
+            nk = len(self._key_fields)
+            merge_exprs: List[Optional[E.Expression]] = [
+                E.BoundReference(nk + j, f.dataType, True)
+                for j, f in enumerate(self._buf_fields)
+            ]
+            saved_keys, saved_bound = self._key_fields, self._bound_keys
+            self_bound = [
+                E.BoundReference(i, f.dataType, f.nullable)
+                for i, f in enumerate(self._key_fields)
+            ]
+            self._bound_keys = self_bound
+            try:
+                partials = [
+                    self._run_batch(merged_in, self._merge_ops, merge_exprs)
+                ]
+            finally:
+                self._bound_keys = saved_bound
+                self._key_fields = saved_keys
+        return partials[0]
+
+    def _evaluate(self, buffers: ColumnarBatch) -> ColumnarBatch:
+        """Final projection from [keys..., buffers...] to results."""
+        exprs: List[E.Expression] = [
+            E.BoundReference(i, f.dataType, f.nullable)
+            for i, f in enumerate(self._key_fields)
+        ]
+        nk = len(self._key_fields)
+        for f, (s, e) in zip(self._bound_funcs, self._buf_slices):
+            refs = tuple(
+                E.BoundReference(nk + j, self._buf_fields[j].dataType, True)
+                for j in range(s, e)
+            )
+            exprs.append(f.evaluate(refs))
+        from .basic import _project_pipeline
+
+        cap = buffers.columns[0].capacity if buffers.columns else 1
+        fn = _project_pipeline(tuple(exprs), batch_signature(buffers), cap)
+        vals = fn(vals_of_batch(buffers))
+        return batch_from_vals(vals, self._schema, buffers.num_rows)
+
+    # -- execution ---------------------------------------------------------
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        partials: List[ColumnarBatch] = []
+        ops = self._update_ops
+        exprs = self._update_exprs
+        for batch in self.children[0].execute_partition(index):
+            if batch.num_rows == 0 and self.group_exprs:
+                continue
+            with timed(self.metrics[TOTAL_TIME]):
+                partials.append(self._run_batch(batch, ops, exprs))
+        if not partials:
+            if self.group_exprs:
+                return  # grouped aggregate over empty input -> no rows
+            # grand aggregate over empty input still yields one row
+            # (count=0, sum=null): reduce a zero-row batch
+            child_schema = self.children[0].output_schema
+            zb = ColumnarBatch.from_pydict(
+                {f.name: [] for f in child_schema.fields}, child_schema
+            )
+            with timed(self.metrics[TOTAL_TIME]):
+                partials = [self._run_batch(zb, ops, exprs)]
+        with timed(self.metrics[TOTAL_TIME]):
+            merged = self._merge(partials)
+            if self.mode == A.PARTIAL:
+                out = merged
+            else:
+                out = self._evaluate(merged)
+        yield self.record_batch(out)

@@ -1,0 +1,164 @@
+"""Exec base class, metrics, and batch<->traced-value plumbing.
+
+Reference analog: GpuExec.scala:27-150 — the metric names/builders
+(GpuMetricNames) and the ``doExecuteColumnar(): RDD[ColumnarBatch]``
+contract. Here the unit of data parallelism is the partition index; an exec
+exposes ``num_partitions`` and ``execute_partition(i)`` and the driver (or
+the exchange layer) decides where partitions run.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
+
+import jax
+
+from ..columnar import ColumnarBatch, DeviceColumn
+from ..conf import RapidsConf
+from ..expr.eval import ColV, StrV, Val
+from ..types import StructType
+
+# Standard metric names (reference: GpuMetricNames in GpuExec.scala:27-60)
+NUM_OUTPUT_ROWS = "numOutputRows"
+NUM_OUTPUT_BATCHES = "numOutputBatches"
+TOTAL_TIME = "totalTime"
+PEAK_DEVICE_MEMORY = "peakDevMemory"
+NUM_INPUT_ROWS = "numInputRows"
+NUM_INPUT_BATCHES = "numInputBatches"
+
+
+class Metric:
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def add(self, v: int) -> None:
+        self.value += v
+
+    def set(self, v: int) -> None:
+        self.value = v
+
+    def __repr__(self):
+        return f"{self.name}={self.value}"
+
+
+@contextlib.contextmanager
+def timed(metric: Optional[Metric], trace_name: str = "", trace: bool = False):
+    """Time a hot section into a metric; optionally emit a profiler range
+    (reference: NvtxWithMetrics.scala -> jax.profiler.TraceAnnotation)."""
+    ctx = (
+        jax.profiler.TraceAnnotation(trace_name or (metric.name if metric else "op"))
+        if trace
+        else contextlib.nullcontext()
+    )
+    start = time.perf_counter_ns()
+    with ctx:
+        yield
+    if metric is not None:
+        metric.add(time.perf_counter_ns() - start)
+
+
+class TpuExec:
+    """Base physical operator producing columnar batches on TPU."""
+
+    def __init__(self, conf: RapidsConf, children: Sequence["TpuExec"] = ()):
+        self.conf = conf
+        self.children: List[TpuExec] = list(children)
+        self.metrics: Dict[str, Metric] = {}
+        for name in (NUM_OUTPUT_ROWS, NUM_OUTPUT_BATCHES, TOTAL_TIME):
+            self.metrics[name] = Metric(name)
+
+    # -- contracts ---------------------------------------------------------
+    @property
+    def output_schema(self) -> StructType:
+        raise NotImplementedError(type(self).__name__)
+
+    @property
+    def num_partitions(self) -> int:
+        if self.children:
+            return self.children[0].num_partitions
+        return 1
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        raise NotImplementedError(type(self).__name__)
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        """All partitions, serially (driver-side collect path)."""
+        for p in range(self.num_partitions):
+            yield from self.execute_partition(p)
+
+    # -- conveniences ------------------------------------------------------
+    def metric(self, name: str) -> Metric:
+        if name not in self.metrics:
+            self.metrics[name] = Metric(name)
+        return self.metrics[name]
+
+    def record_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        self.metrics[NUM_OUTPUT_ROWS].add(batch.num_rows)
+        self.metrics[NUM_OUTPUT_BATCHES].add(1)
+        return batch
+
+    def collect(self) -> List[tuple]:
+        """Columnar-to-row boundary for the whole plan
+        (reference: GpuColumnarToRowExec / GpuBringBackToHost)."""
+        rows: List[tuple] = []
+        for batch in self.execute_columnar():
+            rows.extend(batch.to_rows())
+        return rows
+
+    @property
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.describe()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def describe(self) -> str:
+        return self.node_name
+
+    def __repr__(self):
+        return self.tree_string()
+
+
+# ---------------------------------------------------------------------------
+# ColumnarBatch <-> traced value plumbing
+# ---------------------------------------------------------------------------
+def vals_of_batch(batch: ColumnarBatch) -> List[Val]:
+    out: List[Val] = []
+    for c in batch.columns:
+        if c.is_string:
+            out.append(StrV(c.offsets, c.chars, c.validity))
+        else:
+            out.append(ColV(c.data, c.validity))
+    return out
+
+
+def batch_from_vals(
+    vals: Sequence[Val], schema: StructType, num_rows: int
+) -> ColumnarBatch:
+    cols = []
+    for f, v in zip(schema.fields, vals):
+        if isinstance(v, StrV):
+            cols.append(
+                DeviceColumn(f.dataType, num_rows, None, v.validity, v.offsets, v.chars)
+            )
+        else:
+            cols.append(DeviceColumn(f.dataType, num_rows, v.data, v.validity))
+    return ColumnarBatch(cols, schema, num_rows)
+
+
+def batch_signature(batch: ColumnarBatch) -> tuple:
+    """Structural cache key for compiled per-exec pipelines: dtype + shapes."""
+    sig = []
+    for f, c in zip(batch.schema.fields, batch.columns):
+        if c.is_string:
+            sig.append((f.dataType, int(c.offsets.shape[0]), int(c.chars.shape[0])))
+        else:
+            sig.append((f.dataType, int(c.data.shape[0])))
+    return tuple(sig)

@@ -1,0 +1,357 @@
+"""Basic physical operators: scan-from-memory, project, filter, range,
+union, limit, expand, coalesce-batches.
+
+Reference analog: basicPhysicalOperators.scala (GpuProjectExec:48,
+GpuFilter:113-129, GpuRangeExec:187, GpuUnionExec:315, GpuCoalesceExec:353),
+limit.scala:51, GpuExpandExec.scala:67, GpuCoalesceBatches.scala.
+
+TPU re-design notes:
+  * Filter fuses condition evaluation AND row compaction into one jitted
+    program — the cudf path launches a kernel per expression node plus a
+    filter kernel; here XLA sees the whole thing.
+  * Every pipeline is cached per (expression tree, input layout signature)
+    so ragged batch sizes reuse executables via capacity bucketing.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..columnar import ColumnarBatch, DeviceColumn
+from ..columnar.column import column_from_pylist
+from ..conf import ENABLE_TRACE, MAX_READER_BATCH_SIZE_ROWS, RapidsConf
+from ..expr import expressions as E
+from ..expr.eval import ColV, StrV, lower
+from ..ops import concat as concat_ops
+from ..ops import filter_gather
+from ..types import StructField, StructType
+from ..utils.bucketing import bucket_rows
+from .base import (
+    NUM_OUTPUT_BATCHES,
+    NUM_OUTPUT_ROWS,
+    TOTAL_TIME,
+    TpuExec,
+    batch_from_vals,
+    batch_signature,
+    timed,
+    vals_of_batch,
+)
+
+
+def _output_schema_for(exprs: Sequence[E.Expression], child: StructType) -> StructType:
+    fields = []
+    for i, e in enumerate(exprs):
+        name = e.name if isinstance(e, E.Alias) else (
+            e.name if isinstance(e, E.UnresolvedAttribute) else f"col{i}"
+        )
+        bound = E.bind_references(e, child)
+        fields.append(StructField(name, bound.dtype, bound.nullable))
+    return StructType(tuple(fields))
+
+
+class InMemoryScanExec(TpuExec):
+    """Leaf over already-device-resident batches (test/data source seam)."""
+
+    def __init__(self, conf: RapidsConf, partitions: Sequence[Sequence[ColumnarBatch]],
+                 schema: StructType):
+        super().__init__(conf)
+        self._partitions = [list(p) for p in partitions]
+        self._schema = schema
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    @property
+    def num_partitions(self):
+        return len(self._partitions)
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        for b in self._partitions[index]:
+            yield self.record_batch(b)
+
+    @staticmethod
+    def from_pydict(conf: RapidsConf, data, schema: StructType,
+                    num_partitions: int = 1) -> "InMemoryScanExec":
+        batch = ColumnarBatch.from_pydict(data, schema)
+        if num_partitions == 1:
+            return InMemoryScanExec(conf, [[batch]], schema)
+        rows = batch.to_rows()
+        chunks: List[List[ColumnarBatch]] = []
+        n = len(rows)
+        per = (n + num_partitions - 1) // num_partitions
+        from ..columnar.batch import batch_from_rows
+
+        for i in range(num_partitions):
+            part = rows[i * per: (i + 1) * per]
+            chunks.append([batch_from_rows(part, schema)] if part else [])
+        return InMemoryScanExec(conf, chunks, schema)
+
+
+@functools.lru_cache(maxsize=512)
+def _project_pipeline(exprs: Tuple[E.Expression, ...], sig: tuple, cap: int):
+    def run(cols):
+        return [lower(e, cols, cap) for e in exprs]
+
+    return jax.jit(run)
+
+
+class TpuProjectExec(TpuExec):
+    """reference: GpuProjectExec (basicPhysicalOperators.scala:48-61)."""
+
+    def __init__(self, conf: RapidsConf, exprs: Sequence[E.Expression], child: TpuExec):
+        super().__init__(conf, [child])
+        self.exprs = list(exprs)
+        self._schema = _output_schema_for(self.exprs, child.output_schema)
+        self._bound = tuple(
+            E.bind_references(e, child.output_schema) for e in self.exprs
+        )
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"TpuProjectExec [{', '.join(map(str, self.exprs))}]"
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        trace = self.conf.get(ENABLE_TRACE)
+        for batch in self.children[0].execute_partition(index):
+            with timed(self.metrics[TOTAL_TIME], "TpuProject", trace):
+                cap = batch.columns[0].capacity if batch.columns else bucket_rows(batch.num_rows)
+                fn = _project_pipeline(self._bound, batch_signature(batch), cap)
+                vals = fn(vals_of_batch(batch))
+                out = batch_from_vals(vals, self._schema, batch.num_rows)
+            yield self.record_batch(out)
+
+
+@functools.lru_cache(maxsize=512)
+def _filter_pipeline(cond: E.Expression, sig: tuple, cap: int):
+    def run(cols, num_rows):
+        c = lower(cond, cols, cap)
+        live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+        mask = c.data & c.validity & live
+        out, count = filter_gather.filter_cols(cols, mask, num_rows)
+        return out, count
+
+    return jax.jit(run)
+
+
+class TpuFilterExec(TpuExec):
+    """reference: GpuFilterExec/GpuFilter (basicPhysicalOperators.scala:113-172).
+
+    Condition eval + compaction fuse into one XLA program."""
+
+    def __init__(self, conf: RapidsConf, condition: E.Expression, child: TpuExec):
+        super().__init__(conf, [child])
+        self.condition = condition
+        self._bound = E.bind_references(condition, child.output_schema)
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def describe(self):
+        return f"TpuFilterExec [{self.condition}]"
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        for batch in self.children[0].execute_partition(index):
+            with timed(self.metrics[TOTAL_TIME]):
+                cap = batch.columns[0].capacity if batch.columns else bucket_rows(batch.num_rows)
+                fn = _filter_pipeline(self._bound, batch_signature(batch), cap)
+                vals, count = fn(vals_of_batch(batch), jnp.int32(batch.num_rows))
+                n = int(count)  # row-count sync, same boundary cudf has
+                out = batch_from_vals(vals, self.output_schema, n)
+            yield self.record_batch(out)
+
+
+class TpuRangeExec(TpuExec):
+    """reference: GpuRangeExec (basicPhysicalOperators.scala:187)."""
+
+    def __init__(self, conf: RapidsConf, start: int, end: int, step: int = 1,
+                 num_slices: int = 1, name: str = "id"):
+        super().__init__(conf)
+        if step == 0:
+            raise ValueError("step must not be 0")
+        self.start, self.end, self.step = start, end, step
+        self.num_slices = num_slices
+        self._schema = StructType((StructField(name, T.LONG, False),))
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    @property
+    def num_partitions(self):
+        return self.num_slices
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        total = max(0, -(-(self.end - self.start) // self.step))
+        per = (total + self.num_slices - 1) // self.num_slices if total else 0
+        lo = index * per
+        hi = min(total, (index + 1) * per)
+        max_rows = self.conf.get(MAX_READER_BATCH_SIZE_ROWS)
+        pos = lo
+        while pos < hi:
+            n = min(max_rows, hi - pos)
+            cap = bucket_rows(n, self.conf.shape_bucket_min)
+            base = self.start + pos * self.step
+            data = jnp.arange(cap, dtype=jnp.int64) * self.step + base
+            live = jnp.arange(cap, dtype=jnp.int32) < n
+            data = jnp.where(live, data, 0)
+            col = DeviceColumn(T.LONG, n, data, live)
+            yield self.record_batch(ColumnarBatch([col], self._schema, n))
+            pos += n
+
+
+class TpuUnionExec(TpuExec):
+    """reference: GpuUnionExec (basicPhysicalOperators.scala:315)."""
+
+    def __init__(self, conf: RapidsConf, children: Sequence[TpuExec]):
+        super().__init__(conf, children)
+        self._schema = children[0].output_schema
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    @property
+    def num_partitions(self):
+        return sum(c.num_partitions for c in self.children)
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        for c in self.children:
+            if index < c.num_partitions:
+                for b in c.execute_partition(index):
+                    yield self.record_batch(b)
+                return
+            index -= c.num_partitions
+        raise IndexError(index)
+
+
+class TpuLocalLimitExec(TpuExec):
+    """reference: GpuBaseLimitExec (limit.scala:51) — per-partition limit."""
+
+    def __init__(self, conf: RapidsConf, limit: int, child: TpuExec):
+        super().__init__(conf, [child])
+        self.limit = limit
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        remaining = self.limit
+        for batch in self.children[0].execute_partition(index):
+            if remaining <= 0:
+                return
+            if batch.num_rows <= remaining:
+                remaining -= batch.num_rows
+                yield self.record_batch(batch)
+            else:
+                vals, count = filter_gather.slice_cols(
+                    vals_of_batch(batch), 0, bucket_rows(remaining, self.conf.shape_bucket_min),
+                    jnp.int32(min(remaining, batch.num_rows)),
+                )
+                out = batch_from_vals(vals, self.output_schema, remaining)
+                remaining = 0
+                yield self.record_batch(out)
+                return
+
+
+class TpuExpandExec(TpuExec):
+    """reference: GpuExpandExec (GpuExpandExec.scala:67) — each input batch
+    is projected once per projection group (rollup/cube lowering)."""
+
+    def __init__(self, conf: RapidsConf, projections: Sequence[Sequence[E.Expression]],
+                 output_names: Sequence[str], child: TpuExec):
+        super().__init__(conf, [child])
+        self.projections = [list(p) for p in projections]
+        child_schema = child.output_schema
+        first = [E.bind_references(e, child_schema) for e in self.projections[0]]
+        self._schema = StructType(tuple(
+            StructField(n, e.dtype, True) for n, e in zip(output_names, first)
+        ))
+        self._bound = [
+            tuple(E.bind_references(e, child_schema) for e in p)
+            for p in self.projections
+        ]
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        for batch in self.children[0].execute_partition(index):
+            cap = batch.columns[0].capacity if batch.columns else bucket_rows(batch.num_rows)
+            sig = batch_signature(batch)
+            vals_in = vals_of_batch(batch)
+            for bound in self._bound:
+                with timed(self.metrics[TOTAL_TIME]):
+                    fn = _project_pipeline(bound, sig, cap)
+                    vals = fn(vals_in)
+                    out = batch_from_vals(vals, self._schema, batch.num_rows)
+                yield self.record_batch(out)
+
+
+class TpuCoalesceBatchesExec(TpuExec):
+    """reference: GpuCoalesceBatches (GpuCoalesceBatches.scala:398-571) —
+    concatenate small batches up to a target size before heavy operators."""
+
+    def __init__(self, conf: RapidsConf, child: TpuExec,
+                 target_rows: Optional[int] = None):
+        super().__init__(conf, [child])
+        self.target_rows = target_rows or conf.get(MAX_READER_BATCH_SIZE_ROWS)
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def _flush(self, pending: List[ColumnarBatch]) -> Optional[ColumnarBatch]:
+        if not pending:
+            return None
+        if len(pending) == 1:
+            return pending[0]
+        lengths = [b.num_rows for b in pending]
+        total = sum(lengths)
+        out_cap = bucket_rows(total, self.conf.shape_bucket_min)
+        str_cols = [
+            j for j, f in enumerate(self.output_schema.fields)
+            if isinstance(f.dataType, (T.StringType, T.BinaryType))
+        ]
+        byte_lengths = []
+        for b in pending:
+            bl = [int(b.columns[j].offsets[b.num_rows]) for j in str_cols]
+            byte_lengths.append(bl)
+        out_char_caps = [
+            bucket_rows(max(1, sum(byte_lengths[i][k] for i in range(len(pending)))), 128)
+            for k in range(len(str_cols))
+        ]
+        cols, n = concat_ops.concat_batches_cols(
+            [vals_of_batch(b) for b in pending], lengths, byte_lengths,
+            out_cap, out_char_caps,
+        )
+        return batch_from_vals(cols, self.output_schema, n)
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        pending: List[ColumnarBatch] = []
+        rows = 0
+        for batch in self.children[0].execute_partition(index):
+            if batch.num_rows == 0:
+                continue
+            pending.append(batch)
+            rows += batch.num_rows
+            if rows >= self.target_rows:
+                with timed(self.metrics[TOTAL_TIME]):
+                    out = self._flush(pending)
+                pending, rows = [], 0
+                if out is not None:
+                    yield self.record_batch(out)
+        with timed(self.metrics[TOTAL_TIME]):
+            out = self._flush(pending)
+        if out is not None:
+            yield self.record_batch(out)

@@ -1,0 +1,277 @@
+"""Declarative aggregate functions.
+
+Reference analog: AggregateFunctions.scala:531 — GpuDeclarativeAggregate
+with Count/Sum/Min/Max/Average/First/Last, split into partial (update) and
+final (merge + evaluate) halves mirroring Spark's two-phase aggregation so
+partial aggregates can cross an exchange.
+
+Each function declares:
+  * ``update_ops``  — [(kernel_op, input expr)] producing buffer columns
+  * ``merge_ops``   — [kernel_op] merging buffer columns of the same layout
+  * ``buffer_schema`` — storage types of the buffer columns
+  * ``evaluate``    — expression over buffer columns producing the result
+
+The kernel ops are the names understood by ops/groupby.segment_reduce.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from .. import types as T
+from ..types import DataType
+from . import expressions as E
+
+
+# aggregation modes (Spark: Partial / PartialMerge / Final / Complete)
+PARTIAL = "partial"
+FINAL = "final"
+COMPLETE = "complete"
+
+
+class AggregateFunction(E.Expression):
+    """Base class; subclasses are frozen dataclasses with a child expr."""
+
+    #: number of buffer columns (static per class so FINAL-mode execs can
+    #: recover the layout from a partial exec's output schema positionally)
+    num_buffers: int = 1
+
+    @property
+    def input(self) -> Optional[E.Expression]:
+        return getattr(self, "child", None)
+
+    # -- declarative pieces ------------------------------------------------
+    @property
+    def buffer_schema(self) -> Tuple[DataType, ...]:
+        raise NotImplementedError
+
+    @property
+    def update_ops(self) -> Tuple[Tuple[str, Optional[E.Expression]], ...]:
+        """(kernel op, pre-cast input expression or None for count_star)."""
+        raise NotImplementedError
+
+    @property
+    def merge_ops(self) -> Tuple[str, ...]:
+        raise NotImplementedError
+
+    def evaluate(self, buffer_refs: Tuple[E.Expression, ...]) -> E.Expression:
+        """Final projection from buffer columns to the result value."""
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class Count(AggregateFunction):
+    """count(expr) / count(*) -> bigint, never null."""
+
+    child: Optional[E.Expression] = None  # None = count(*)
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def buffer_schema(self):
+        return (T.LONG,)
+
+    @property
+    def update_ops(self):
+        if self.child is None:
+            return (("count_star", None),)
+        return (("count", self.child),)
+
+    @property
+    def merge_ops(self):
+        return ("sum",)
+
+    def evaluate(self, refs):
+        return E.Coalesce((refs[0], E.Literal(0, T.LONG)))
+
+
+def _sum_result_type(dt: DataType) -> DataType:
+    if isinstance(dt, T.DecimalType):
+        return T.DecimalType(min(dt.precision + 10, T.DecimalType.MAX_PRECISION), dt.scale)
+    if dt.is_integral or isinstance(dt, T.BooleanType):
+        return T.LONG
+    return T.DOUBLE
+
+
+@dataclasses.dataclass(frozen=True)
+class Sum(AggregateFunction):
+    """sum(expr): long for integral input, double for floating (Spark)."""
+
+    child: E.Expression = None  # type: ignore[assignment]
+
+    @property
+    def dtype(self):
+        return _sum_result_type(self.child.dtype)
+
+    @property
+    def buffer_schema(self):
+        return (self.dtype,)
+
+    @property
+    def update_ops(self):
+        return (("sum", E.Cast(self.child, self.dtype)),)
+
+    @property
+    def merge_ops(self):
+        return ("sum",)
+
+    def evaluate(self, refs):
+        return refs[0]
+
+
+@dataclasses.dataclass(frozen=True)
+class Min(AggregateFunction):
+    child: E.Expression = None  # type: ignore[assignment]
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def buffer_schema(self):
+        return (self.dtype,)
+
+    @property
+    def update_ops(self):
+        return (("min", self.child),)
+
+    @property
+    def merge_ops(self):
+        return ("min",)
+
+    def evaluate(self, refs):
+        return refs[0]
+
+
+@dataclasses.dataclass(frozen=True)
+class Max(AggregateFunction):
+    child: E.Expression = None  # type: ignore[assignment]
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def buffer_schema(self):
+        return (self.dtype,)
+
+    @property
+    def update_ops(self):
+        return (("max", self.child),)
+
+    @property
+    def merge_ops(self):
+        return ("max",)
+
+    def evaluate(self, refs):
+        return refs[0]
+
+
+@dataclasses.dataclass(frozen=True)
+class Average(AggregateFunction):
+    """avg(expr) -> double; buffer = (sum: double, count: long) like Spark."""
+
+    child: E.Expression = None  # type: ignore[assignment]
+    num_buffers = 2
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def buffer_schema(self):
+        return (T.DOUBLE, T.LONG)
+
+    @property
+    def update_ops(self):
+        return (("sum", E.Cast(self.child, T.DOUBLE)), ("count", self.child))
+
+    @property
+    def merge_ops(self):
+        return ("sum", "sum")
+
+    def evaluate(self, refs):
+        # sum/count with count==0 -> null (Divide already nulls on 0)
+        return E.Divide(refs[0], refs[1])
+
+
+@dataclasses.dataclass(frozen=True)
+class First(AggregateFunction):
+    child: E.Expression = None  # type: ignore[assignment]
+    ignore_nulls: bool = False
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def buffer_schema(self):
+        return (self.dtype,)
+
+    @property
+    def update_ops(self):
+        op = "first_ignorenulls" if self.ignore_nulls else "first"
+        return ((op, self.child),)
+
+    @property
+    def merge_ops(self):
+        return ("first_ignorenulls" if self.ignore_nulls else "first",)
+
+    def evaluate(self, refs):
+        return refs[0]
+
+
+@dataclasses.dataclass(frozen=True)
+class Last(AggregateFunction):
+    child: E.Expression = None  # type: ignore[assignment]
+    ignore_nulls: bool = False
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def buffer_schema(self):
+        return (self.dtype,)
+
+    @property
+    def update_ops(self):
+        op = "last_ignorenulls" if self.ignore_nulls else "last"
+        return ((op, self.child),)
+
+    @property
+    def merge_ops(self):
+        return ("last_ignorenulls" if self.ignore_nulls else "last",)
+
+    def evaluate(self, refs):
+        return refs[0]
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregateExpression(E.Expression):
+    """An aggregate function + mode + output name (Spark AggregateExpression)."""
+
+    func: AggregateFunction
+    mode: str = COMPLETE
+    name: str = ""
+
+    @property
+    def dtype(self):
+        return self.func.dtype
+
+    def resolved_name(self) -> str:
+        if self.name:
+            return self.name
+        fn = type(self.func).__name__.lower()
+        c = self.func.input
+        return f"{fn}({getattr(c, 'name', '*') if c is not None else '*'})"
+
+
+def agg(func: AggregateFunction, name: str = "") -> AggregateExpression:
+    return AggregateExpression(func, COMPLETE, name)

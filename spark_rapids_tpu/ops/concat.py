@@ -1,0 +1,89 @@
+"""Batch concatenation kernels.
+
+Reference analog: cudf ``Table.concatenate`` as used by GpuCoalesceBatches
+(GpuCoalesceBatches.scala:398-571) and GpuShuffleCoalesceExec. Lengths are
+host ints at batch boundaries (the reference syncs for row counts there
+too), so each part placement is a static ``dynamic_update_slice`` and XLA
+fuses the whole stitch into one program.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..expr.eval import ColV, StrV, Val
+
+
+def concat_fixed(parts: Sequence[ColV], lengths: Sequence[int], out_cap: int) -> ColV:
+    dtype = parts[0].data.dtype
+    data = jnp.zeros(out_cap, dtype)
+    validity = jnp.zeros(out_cap, jnp.bool_)
+    off = 0
+    for p, n in zip(parts, lengths):
+        if n == 0:
+            continue
+        data = lax.dynamic_update_slice(data, p.data[:n], (off,))
+        validity = lax.dynamic_update_slice(validity, p.validity[:n], (off,))
+        off += n
+    return ColV(data, validity)
+
+
+def concat_string(
+    parts: Sequence[StrV],
+    lengths: Sequence[int],
+    byte_lengths: Sequence[int],
+    out_cap: int,
+    out_char_cap: int,
+) -> StrV:
+    offsets = jnp.zeros(out_cap + 1, jnp.int32)
+    chars = jnp.zeros(out_char_cap, jnp.uint8)
+    validity = jnp.zeros(out_cap, jnp.bool_)
+    row_off = 0
+    byte_off = 0
+    for p, n, nb in zip(parts, lengths, byte_lengths):
+        if n == 0:
+            continue
+        shifted = p.offsets[: n + 1] + jnp.int32(byte_off)
+        offsets = lax.dynamic_update_slice(offsets, shifted, (row_off,))
+        validity = lax.dynamic_update_slice(validity, p.validity[:n], (row_off,))
+        if nb > 0:
+            chars = lax.dynamic_update_slice(chars, p.chars[:nb], (byte_off,))
+        row_off += n
+        byte_off += nb
+    total_rows, total_bytes = row_off, byte_off
+    # keep offsets monotonic through the padded tail
+    idx = jnp.arange(out_cap + 1, dtype=jnp.int32)
+    offsets = jnp.where(idx <= total_rows, offsets, jnp.int32(total_bytes))
+    return StrV(offsets, chars, validity)
+
+
+def concat_batches_cols(
+    col_parts: Sequence[Sequence[Val]],
+    lengths: Sequence[int],
+    byte_lengths_per_col: Sequence[Sequence[int]],
+    out_cap: int,
+    out_char_caps: Sequence[int],
+) -> Tuple[List[Val], int]:
+    """Concatenate N batches column-wise.
+
+    ``col_parts[i]`` = columns of batch i; ``byte_lengths_per_col[i][j]`` =
+    byte length of string column j in batch i (host ints, synced by the
+    caller once per batch like cudf's row-count syncs).
+    """
+    ncols = len(col_parts[0])
+    out: List[Val] = []
+    si = 0
+    for j in range(ncols):
+        parts = [cp[j] for cp in col_parts]
+        if isinstance(parts[0], StrV):
+            bl = [byte_lengths_per_col[i][si] for i in range(len(col_parts))]
+            out.append(
+                concat_string(parts, lengths, bl, out_cap, out_char_caps[si])
+            )
+            si += 1
+        else:
+            out.append(concat_fixed(parts, lengths, out_cap))
+    return out, sum(lengths)

@@ -1,0 +1,240 @@
+"""Exec-layer unit tests (reference tier-2 analog: operator suites)."""
+import math
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import ColumnarBatch
+from spark_rapids_tpu.columnar.batch import schema_of
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.exec import (
+    InMemoryScanExec,
+    TpuCoalesceBatchesExec,
+    TpuExpandExec,
+    TpuFilterExec,
+    TpuHashAggregateExec,
+    TpuLocalLimitExec,
+    TpuProjectExec,
+    TpuRangeExec,
+    TpuUnionExec,
+)
+from spark_rapids_tpu.expr import aggregates as A
+from spark_rapids_tpu.expr import expressions as E
+from spark_rapids_tpu.expr.expressions import col, lit
+
+CONF = RapidsConf()
+
+
+def scan(data, schema, parts=1):
+    return InMemoryScanExec.from_pydict(CONF, data, schema, parts)
+
+
+class TestBasicExecs:
+    def test_project(self):
+        s = schema_of(a=T.INT, b=T.DOUBLE)
+        src = scan({"a": [1, 2, None], "b": [1.5, None, 3.0]}, s)
+        p = TpuProjectExec(CONF, [E.Alias(E.Add(col("a"), lit(10)), "a10"), col("b")], src)
+        rows = p.collect()
+        assert rows == [(11, 1.5), (12, None), (None, 3.0)]
+        assert p.output_schema.names == ["a10", "b"]
+
+    def test_filter(self):
+        s = schema_of(a=T.INT)
+        src = scan({"a": [1, 2, 3, None, 5, 6]}, s)
+        f = TpuFilterExec(CONF, E.GreaterThan(col("a"), lit(2)), src)
+        assert f.collect() == [(3,), (5,), (6,)]
+
+    def test_filter_with_strings_passthrough(self):
+        s = schema_of(a=T.INT, name=T.STRING)
+        src = scan({"a": [1, 2, 3], "name": ["x", None, "zzz"]}, s)
+        f = TpuFilterExec(CONF, E.LessThan(col("a"), lit(3)), src)
+        assert f.collect() == [(1, "x"), (2, None)]
+
+    def test_range(self):
+        r = TpuRangeExec(CONF, 0, 10, 3)
+        assert r.collect() == [(0,), (3,), (6,), (9,)]
+
+    def test_range_partitions(self):
+        r = TpuRangeExec(CONF, 0, 100, 1, num_slices=4)
+        assert r.num_partitions == 4
+        assert sorted(x[0] for x in r.collect()) == list(range(100))
+
+    def test_union(self):
+        s = schema_of(a=T.INT)
+        u = TpuUnionExec(CONF, [scan({"a": [1, 2]}, s), scan({"a": [3]}, s)])
+        assert u.collect() == [(1,), (2,), (3,)]
+        assert u.num_partitions == 2
+
+    def test_limit(self):
+        s = schema_of(a=T.INT)
+        src = scan({"a": list(range(10))}, s)
+        l = TpuLocalLimitExec(CONF, 4, src)
+        assert l.collect() == [(0,), (1,), (2,), (3,)]
+
+    def test_limit_larger_than_input(self):
+        s = schema_of(a=T.INT)
+        src = scan({"a": [1, 2]}, s)
+        assert TpuLocalLimitExec(CONF, 10, src).collect() == [(1,), (2,)]
+
+    def test_expand(self):
+        s = schema_of(a=T.INT)
+        src = scan({"a": [1, 2]}, s)
+        ex = TpuExpandExec(
+            CONF,
+            [[col("a"), lit(0)], [col("a"), lit(1)]],
+            ["a", "tag"],
+            src,
+        )
+        assert sorted(ex.collect()) == [(1, 0), (1, 1), (2, 0), (2, 1)]
+
+    def test_coalesce_batches(self):
+        s = schema_of(a=T.INT, w=T.STRING)
+        b1 = ColumnarBatch.from_pydict({"a": [1, 2], "w": ["x", "yy"]}, s)
+        b2 = ColumnarBatch.from_pydict({"a": [3], "w": [None]}, s)
+        b3 = ColumnarBatch.from_pydict({"a": [4, 5], "w": ["zzz", ""]}, s)
+        src = InMemoryScanExec(CONF, [[b1, b2, b3]], s)
+        co = TpuCoalesceBatchesExec(CONF, src, target_rows=100)
+        out = list(co.execute_columnar())
+        assert len(out) == 1
+        assert out[0].to_rows() == [
+            (1, "x"), (2, "yy"), (3, None), (4, "zzz"), (5, ""),
+        ]
+
+
+class TestAggregate:
+    def test_complete_grouped(self):
+        s = schema_of(k=T.INT, v=T.LONG)
+        src = scan({"k": [1, 2, 1, None, 2, 1], "v": [10, 20, 30, 40, None, 50]}, s)
+        aggp = TpuHashAggregateExec(
+            CONF, [col("k")],
+            [A.agg(A.Sum(col("v")), "s"), A.agg(A.Count(col("v")), "c"),
+             A.agg(A.Count(), "n"), A.agg(A.Average(col("v")), "m")],
+            src,
+        )
+        rows = {r[0]: r[1:] for r in aggp.collect()}
+        assert rows[1] == (90, 3, 3, 30.0)
+        assert rows[2] == (20, 1, 2, 20.0)
+        assert rows[None] == (40, 1, 1, 40.0)
+
+    def test_complete_no_keys(self):
+        s = schema_of(v=T.INT)
+        src = scan({"v": [1, None, 3]}, s)
+        aggp = TpuHashAggregateExec(
+            CONF, [], [A.agg(A.Sum(col("v"))), A.agg(A.Count(col("v"))),
+                       A.agg(A.Min(col("v"))), A.agg(A.Max(col("v")))], src,
+        )
+        assert aggp.collect() == [(4, 2, 1, 3)]
+
+    def test_empty_input_no_keys(self):
+        s = schema_of(v=T.INT)
+        src = scan({"v": []}, s)
+        aggp = TpuHashAggregateExec(
+            CONF, [], [A.agg(A.Count(col("v"))), A.agg(A.Sum(col("v")))], src,
+        )
+        assert aggp.collect() == [(0, None)]
+
+    def test_empty_input_grouped(self):
+        s = schema_of(k=T.INT, v=T.INT)
+        src = scan({"k": [], "v": []}, s)
+        aggp = TpuHashAggregateExec(
+            CONF, [col("k")], [A.agg(A.Sum(col("v")))], src)
+        assert aggp.collect() == []
+
+    def test_partial_final_roundtrip(self):
+        s = schema_of(k=T.INT, v=T.INT)
+        src = scan({"k": [1, 2, 1, 2, 1], "v": [1, 2, 3, 4, 5]}, s)
+        partial = TpuHashAggregateExec(
+            CONF, [col("k")],
+            [A.agg(A.Average(col("v")), "m"), A.agg(A.Count(), "n")],
+            src, mode=A.PARTIAL,
+        )
+        # partial emits buffer columns (sum, count, count_star)
+        assert len(partial.output_schema.fields) == 4
+        final = TpuHashAggregateExec(
+            CONF, [col("k")],
+            [A.agg(A.Average(col("v")), "m"), A.agg(A.Count(), "n")],
+            partial, mode=A.FINAL,
+        )
+        rows = {r[0]: r[1:] for r in final.collect()}
+        assert rows[1] == (3.0, 3)
+        assert rows[2] == (3.0, 2)
+
+    def test_multi_batch_merge(self):
+        s = schema_of(k=T.INT, v=T.LONG)
+        b1 = ColumnarBatch.from_pydict({"k": [1, 2], "v": [1, 2]}, s)
+        b2 = ColumnarBatch.from_pydict({"k": [1, 3], "v": [10, 30]}, s)
+        b3 = ColumnarBatch.from_pydict({"k": [2, 1], "v": [200, 100]}, s)
+        src = InMemoryScanExec(CONF, [[b1, b2, b3]], s)
+        aggp = TpuHashAggregateExec(
+            CONF, [col("k")], [A.agg(A.Sum(col("v")), "s")], src)
+        rows = dict(aggp.collect())
+        assert rows == {1: 111, 2: 202, 3: 30}
+
+    def test_string_keys_aggregate(self):
+        s = schema_of(k=T.STRING, v=T.INT)
+        src = scan({"k": ["a", "b", "a", None, "b"], "v": [1, 2, 3, 4, 5]}, s)
+        aggp = TpuHashAggregateExec(
+            CONF, [col("k")], [A.agg(A.Sum(col("v")), "s")], src)
+        rows = dict(aggp.collect())
+        assert rows == {"a": 4, "b": 7, None: 4}
+
+    def test_first_last(self):
+        s = schema_of(k=T.INT, v=T.INT)
+        src = scan({"k": [1, 1, 1], "v": [None, 5, 7]}, s)
+        aggp = TpuHashAggregateExec(
+            CONF, [col("k")],
+            [A.agg(A.First(col("v"), ignore_nulls=True), "f"),
+             A.agg(A.Last(col("v")), "l")],
+            src,
+        )
+        assert aggp.collect() == [(1, 5, 7)]
+
+    def test_avg_all_null_group(self):
+        s = schema_of(k=T.INT, v=T.INT)
+        src = scan({"k": [1, 1], "v": [None, None]}, s)
+        aggp = TpuHashAggregateExec(
+            CONF, [col("k")], [A.agg(A.Average(col("v")), "m")], src)
+        assert aggp.collect() == [(1, None)]
+
+
+class TestPipeline:
+    def test_scan_filter_project_aggregate(self):
+        """The 'minimum end-to-end slice' shape from SURVEY.md §7 step 4."""
+        s = schema_of(k=T.INT, a=T.LONG, b=T.DOUBLE)
+        n = 1000
+        data = {
+            "k": [i % 7 for i in range(n)],
+            "a": [i for i in range(n)],
+            "b": [float(i) / 3 if i % 11 else None for i in range(n)],
+        }
+        src = scan(data, s, parts=2)
+        f = TpuFilterExec(CONF, E.GreaterThanOrEqual(col("a"), lit(100)), src)
+        p = TpuProjectExec(
+            CONF, [col("k"), E.Alias(E.Multiply(col("a"), lit(2)), "a2"), col("b")], f)
+        aggp = TpuHashAggregateExec(
+            CONF, [col("k")],
+            [A.agg(A.Sum(col("a2")), "s"), A.agg(A.Average(col("b")), "m"),
+             A.agg(A.Count(), "n")],
+            p,
+        )
+        merged = {}
+        for row in aggp.collect():  # two partitions -> merge per-key
+            k, sm, m, c = row
+            if k in merged:
+                os, om, oc = merged[k]
+                merged[k] = (os + sm, None, oc + c)
+            else:
+                merged[k] = (sm, m, c)
+        # oracle
+        import collections
+
+        osum = collections.Counter()
+        ocnt = collections.Counter()
+        for i in range(n):
+            if i >= 100:
+                osum[i % 7] += 2 * i
+                ocnt[i % 7] += 1
+        for k in osum:
+            assert merged[k][0] == osum[k], k
+            assert merged[k][2] == ocnt[k], k
