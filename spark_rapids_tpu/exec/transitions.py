@@ -1,0 +1,107 @@
+"""Row <-> columnar transition execs.
+
+Reference analog: GpuRowToColumnarExec (GpuRowToColumnarExec.scala:37),
+GpuColumnarToRowExec (GpuColumnarToRowExec.scala:38), GpuBringBackToHost.
+The planner inserts these at every CPU/TPU boundary; the transition
+optimizer's job (GpuTransitionOverrides.scala:38) of fusing adjacent
+transitions is done here by construction — the overrides pass only ever
+creates one transition per boundary.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from ..columnar import ColumnarBatch
+from ..columnar.batch import batch_from_rows
+from ..conf import MAX_READER_BATCH_SIZE_ROWS, RapidsConf
+from ..cpu.plan import CpuExec
+from ..types import StructType
+from .base import TpuExec
+
+
+class RowToColumnarExec(TpuExec):
+    """CPU rows -> device batches (host build + single upload per batch)."""
+
+    def __init__(self, conf: RapidsConf, cpu_child: CpuExec):
+        super().__init__(conf)
+        self.cpu_child = cpu_child
+        self._batch_rows = conf.get(MAX_READER_BATCH_SIZE_ROWS)
+
+    @property
+    def output_schema(self) -> StructType:
+        return self.cpu_child.output_schema
+
+    @property
+    def num_partitions(self) -> int:
+        return self.cpu_child.num_partitions
+
+    def describe(self):
+        return "RowToColumnarExec"
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.describe()]
+        lines.append(self.cpu_child.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        buf: List[tuple] = []
+        for row in self.cpu_child.execute_rows_partition(index):
+            buf.append(row)
+            if len(buf) >= self._batch_rows:
+                yield self.record_batch(batch_from_rows(buf, self.output_schema))
+                buf = []
+        if buf:
+            yield self.record_batch(batch_from_rows(buf, self.output_schema))
+
+
+class ColumnarToRowExec(CpuExec):
+    """Device batches -> host rows (the collect boundary)."""
+
+    def __init__(self, conf: RapidsConf, tpu_child: TpuExec):
+        super().__init__(conf)
+        self.tpu_child = tpu_child
+
+    @property
+    def output_schema(self) -> StructType:
+        return self.tpu_child.output_schema
+
+    @property
+    def num_partitions(self) -> int:
+        return self.tpu_child.num_partitions
+
+    def describe(self):
+        return "ColumnarToRowExec"
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.describe()]
+        lines.append(self.tpu_child.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def execute_rows_partition(self, index: int) -> Iterator[tuple]:
+        for batch in self.tpu_child.execute_partition(index):
+            yield from batch.to_rows()
+
+
+class TpuGatherPartitionsExec(TpuExec):
+    """All partitions of the child into one (placeholder single-node
+    exchange; the shuffle layer replaces this with a real exchange exec).
+
+    Reference analog: a ShuffleExchange to a single partition."""
+
+    def __init__(self, conf: RapidsConf, child: TpuExec):
+        super().__init__(conf, [child])
+
+    @property
+    def output_schema(self) -> StructType:
+        return self.children[0].output_schema
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        assert index == 0
+        child = self.children[0]
+        for p in range(child.num_partitions):
+            for b in child.execute_partition(p):
+                yield self.record_batch(b)

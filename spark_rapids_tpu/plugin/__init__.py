@@ -1,0 +1,8 @@
+"""Planning/override layer: wraps a CPU physical plan, tags what can run on
+TPU, converts convertible subtrees, and reports fallbacks.
+
+Reference analog: GpuOverrides.scala + RapidsMeta.scala + TypeChecks.scala
+(SURVEY.md §2.2) — carried over conceptually intact because this layer never
+knew about CUDA in the reference either.
+"""
+from .overrides import TpuOverrides, PlanMeta, explain_plan  # noqa: F401

@@ -1,0 +1,459 @@
+"""The override/tagging pass: CPU physical plan -> mixed CPU/TPU plan.
+
+Reference analog:
+  * GpuOverrides.apply (GpuOverrides.scala:2516-2546) — wrap, tag, explain,
+    convert;
+  * RapidsMeta (RapidsMeta.scala:70-693) — the wrapper tree accumulating
+    "cannot replace because ..." reasons, converting only fully-replaceable
+    subtrees;
+  * TypeChecks (TypeChecks.scala:453) — per-rule allowed-type matrices;
+  * the rule registries (GpuOverrides.scala:661-2492).
+
+Differences by design: there is no separate "partitioning"/"scan" rule space
+yet (exchange and file scans register here as exec rules when those layers
+land), and expression supportability is checked both against the registry
+(docs/gating) and by abstractly tracing the actual lowering
+(eval.tpu_supports) so dtype-level gaps surface at plan time, not run time.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+from .. import types as T
+from ..conf import (
+    DECIMAL_ENABLED,
+    EXPLAIN,
+    RapidsConf,
+    SQL_ENABLED,
+    TEST_ALLOWED_NONTPU,
+    TEST_CONF,
+)
+from ..cpu import plan as C
+from ..exec import aggregate as XA
+from ..exec import basic as XB
+from ..exec.base import TpuExec
+from ..exec.transitions import (
+    ColumnarToRowExec,
+    RowToColumnarExec,
+    TpuGatherPartitionsExec,
+)
+from ..expr import aggregates as A
+from ..expr import expressions as E
+from ..expr.eval import tpu_supports
+from ..types import StructType
+
+
+# ---------------------------------------------------------------------------
+# Expression rules (reference: GpuOverrides.scala:661-2124, 144 rules)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class ExprRule:
+    name: str
+    description: str
+
+
+EXPRESSION_RULES: Dict[Type[E.Expression], ExprRule] = {}
+
+
+def _expr_rule(cls: Type[E.Expression], name: str, desc: str) -> None:
+    EXPRESSION_RULES[cls] = ExprRule(name, desc)
+
+
+for _cls, _name, _desc in [
+    (E.Literal, "Literal", "holds a static value"),
+    (E.UnresolvedAttribute, "AttributeReference", "references an input column"),
+    (E.BoundReference, "BoundReference", "bound input column"),
+    (E.Alias, "Alias", "gives a column a name"),
+    (E.Add, "Add", "addition"),
+    (E.Subtract, "Subtract", "subtraction"),
+    (E.Multiply, "Multiply", "multiplication"),
+    (E.Divide, "Divide", "division"),
+    (E.IntegralDivide, "IntegralDivide", "division with integer result"),
+    (E.Remainder, "Remainder", "remainder (%)"),
+    (E.Pmod, "Pmod", "positive modulo"),
+    (E.UnaryMinus, "UnaryMinus", "negation"),
+    (E.UnaryPositive, "UnaryPositive", "identity +"),
+    (E.Abs, "Abs", "absolute value"),
+    (E.EqualTo, "EqualTo", "equality"),
+    (E.EqualNullSafe, "EqualNullSafe", "null-safe equality (<=>)"),
+    (E.LessThan, "LessThan", "< comparison"),
+    (E.LessThanOrEqual, "LessThanOrEqual", "<= comparison"),
+    (E.GreaterThan, "GreaterThan", "> comparison"),
+    (E.GreaterThanOrEqual, "GreaterThanOrEqual", ">= comparison"),
+    (E.In, "In", "IN list membership"),
+    (E.And, "And", "logical AND (3-valued)"),
+    (E.Or, "Or", "logical OR (3-valued)"),
+    (E.Not, "Not", "logical NOT"),
+    (E.IsNull, "IsNull", "null check"),
+    (E.IsNotNull, "IsNotNull", "non-null check"),
+    (E.IsNan, "IsNan", "NaN check"),
+    (E.Coalesce, "Coalesce", "first non-null"),
+    (E.NaNvl, "NaNvl", "NaN replacement"),
+    (E.If, "If", "if/then/else"),
+    (E.CaseWhen, "CaseWhen", "CASE WHEN"),
+    (E.Cast, "Cast", "type cast"),
+    (E.Sqrt, "Sqrt", "square root"),
+    (E.Exp, "Exp", "e^x"),
+    (E.Log, "Log", "natural log"),
+    (E.Log10, "Log10", "log base 10"),
+    (E.Log2, "Log2", "log base 2"),
+    (E.Log1p, "Log1p", "log(1+x)"),
+    (E.Expm1, "Expm1", "e^x - 1"),
+    (E.Sin, "Sin", "sine"),
+    (E.Cos, "Cos", "cosine"),
+    (E.Tan, "Tan", "tangent"),
+    (E.Asin, "Asin", "arcsine"),
+    (E.Acos, "Acos", "arccosine"),
+    (E.Atan, "Atan", "arctangent"),
+    (E.Sinh, "Sinh", "hyperbolic sine"),
+    (E.Cosh, "Cosh", "hyperbolic cosine"),
+    (E.Tanh, "Tanh", "hyperbolic tangent"),
+    (E.Cbrt, "Cbrt", "cube root"),
+    (E.ToDegrees, "ToDegrees", "radians to degrees"),
+    (E.ToRadians, "ToRadians", "degrees to radians"),
+    (E.Floor, "Floor", "floor"),
+    (E.Ceil, "Ceil", "ceiling"),
+    (E.Round, "Round", "HALF_UP rounding"),
+    (E.Rint, "Rint", "round to even"),
+    (E.Pow, "Pow", "power"),
+    (E.Atan2, "Atan2", "two-argument arctangent"),
+    (E.Signum, "Signum", "sign"),
+    (E.BitwiseAnd, "BitwiseAnd", "bitwise AND"),
+    (E.BitwiseOr, "BitwiseOr", "bitwise OR"),
+    (E.BitwiseXor, "BitwiseXor", "bitwise XOR"),
+    (E.BitwiseNot, "BitwiseNot", "bitwise NOT"),
+    (E.ShiftLeft, "ShiftLeft", "shift left"),
+    (E.ShiftRight, "ShiftRight", "shift right"),
+    (E.ShiftRightUnsigned, "ShiftRightUnsigned", "unsigned shift right"),
+    (E.Length, "Length", "string character length"),
+    (A.AggregateExpression, "AggregateExpression", "aggregate holder"),
+    (A.Count, "Count", "count aggregate"),
+    (A.Sum, "Sum", "sum aggregate"),
+    (A.Min, "Min", "min aggregate"),
+    (A.Max, "Max", "max aggregate"),
+    (A.Average, "Average", "average aggregate"),
+    (A.First, "First", "first value aggregate"),
+    (A.Last, "Last", "last value aggregate"),
+]:
+    _expr_rule(_cls, _name, _desc)
+
+
+def _check_type(dt: T.DataType, conf: RapidsConf) -> Optional[str]:
+    """Allowed-type matrix (reference: isSupportedType GpuOverrides.scala:531)."""
+    if isinstance(dt, (T.ArrayType, T.StructType)):
+        return f"type {dt.simpleString} is not supported on TPU"
+    if isinstance(dt, T.DecimalType):
+        if not conf.get(DECIMAL_ENABLED):
+            return "decimal support is disabled (spark.rapids.tpu.sql.decimalType.enabled)"
+        if dt.precision > T.DecimalType.MAX_PRECISION:
+            return f"decimal precision {dt.precision} > 18 not supported"
+    return None
+
+
+def check_expression(
+    expr: E.Expression, schema: StructType, conf: RapidsConf
+) -> List[str]:
+    """All the reasons this expression can't lower; empty = supported."""
+    reasons: List[str] = []
+
+    def visit(node: E.Expression):
+        if type(node) not in EXPRESSION_RULES:
+            reasons.append(
+                f"expression {type(node).__name__} is not supported on TPU"
+            )
+        for c in node.children:
+            visit(c)
+
+    visit(expr)
+    if reasons:
+        return reasons
+    # dtype-level probe: abstractly trace the real lowering
+    if not isinstance(expr, (A.AggregateExpression, A.AggregateFunction)):
+        ok, why = tpu_supports(expr, schema)
+        if not ok:
+            reasons.append(why or "lowering probe failed")
+        else:
+            try:
+                bound = E.bind_references(expr, schema)
+                err = _check_type(bound.dtype, conf)
+                if err:
+                    reasons.append(err)
+            except (TypeError, ValueError, KeyError) as e:
+                reasons.append(str(e))
+    return reasons
+
+
+def check_aggregate(
+    ae: A.AggregateExpression, schema: StructType, conf: RapidsConf
+) -> List[str]:
+    reasons: List[str] = []
+    f = ae.func
+    if type(f) not in EXPRESSION_RULES:
+        reasons.append(f"aggregate {type(f).__name__} is not supported on TPU")
+        return reasons
+    if f.input is not None:
+        try:
+            bound = E.bind_references(f.child, schema)
+            dt = bound.dtype
+        except (ValueError, KeyError) as e:
+            return [str(e)]
+        if isinstance(dt, (T.StringType, T.BinaryType)):
+            reasons.append(
+                f"{type(f).__name__} over string inputs is not supported on TPU yet"
+            )
+        else:
+            reasons.extend(check_expression(f.child, schema, conf))
+        if isinstance(f, (A.Sum, A.Average)) and isinstance(dt, (T.StringType, T.BinaryType)):
+            reasons.append("sum/avg require numeric input")
+    return reasons
+
+
+# ---------------------------------------------------------------------------
+# Exec rules (reference: commonExecs GpuOverrides.scala:2243-2492)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class ExecRule:
+    name: str
+    description: str
+    tag: Callable[["PlanMeta"], None]
+    convert: Callable[[C.CpuExec, RapidsConf, List[TpuExec]], TpuExec]
+
+
+EXEC_RULES: Dict[Type[C.CpuExec], ExecRule] = {}
+
+
+def _exec_rule(cls, name, desc, tag, convert):
+    EXEC_RULES[cls] = ExecRule(name, desc, tag, convert)
+
+
+def _tag_output_types(meta: "PlanMeta") -> None:
+    for f in meta.wrapped.output_schema.fields:
+        err = _check_type(f.dataType, meta.conf)
+        if err:
+            meta.will_not_work(f"column {f.name}: {err}")
+
+
+def _tag_scan(meta: "PlanMeta") -> None:
+    _tag_output_types(meta)
+
+
+def _convert_scan(cpu: C.CpuScanExec, conf, children):
+    from ..columnar.batch import batch_from_rows
+
+    parts = []
+    for i in range(cpu.num_partitions):
+        rows = list(cpu.execute_rows_partition(i))
+        parts.append([batch_from_rows(rows, cpu.output_schema)] if rows else [])
+    return XB.InMemoryScanExec(conf, parts, cpu.output_schema)
+
+
+def _tag_project(meta: "PlanMeta") -> None:
+    cpu: C.CpuProjectExec = meta.wrapped  # type: ignore[assignment]
+    schema = cpu.children[0].output_schema
+    for e in cpu.exprs:
+        for r in check_expression(e, schema, meta.conf):
+            meta.will_not_work(r)
+    _tag_output_types(meta)
+
+
+def _convert_project(cpu: C.CpuProjectExec, conf, children):
+    return XB.TpuProjectExec(conf, cpu.exprs, children[0])
+
+
+def _tag_filter(meta: "PlanMeta") -> None:
+    cpu: C.CpuFilterExec = meta.wrapped  # type: ignore[assignment]
+    schema = cpu.children[0].output_schema
+    for r in check_expression(cpu.condition, schema, meta.conf):
+        meta.will_not_work(r)
+
+
+def _convert_filter(cpu: C.CpuFilterExec, conf, children):
+    return XB.TpuFilterExec(conf, cpu.condition, children[0])
+
+
+def _tag_range(meta: "PlanMeta") -> None:
+    pass
+
+
+def _convert_range(cpu: C.CpuRangeExec, conf, children):
+    return XB.TpuRangeExec(conf, cpu.start, cpu.end, cpu.step, cpu.num_slices,
+                           cpu.output_schema.fields[0].name)
+
+
+def _tag_union(meta: "PlanMeta") -> None:
+    _tag_output_types(meta)
+
+
+def _convert_union(cpu: C.CpuUnionExec, conf, children):
+    return XB.TpuUnionExec(conf, children)
+
+
+def _tag_limit(meta: "PlanMeta") -> None:
+    pass
+
+
+def _convert_limit(cpu: C.CpuLocalLimitExec, conf, children):
+    return XB.TpuLocalLimitExec(conf, cpu.limit, children[0])
+
+
+def _tag_expand(meta: "PlanMeta") -> None:
+    cpu: C.CpuExpandExec = meta.wrapped  # type: ignore[assignment]
+    schema = cpu.children[0].output_schema
+    for p in cpu.projections:
+        for e in p:
+            for r in check_expression(e, schema, meta.conf):
+                meta.will_not_work(r)
+
+
+def _convert_expand(cpu: C.CpuExpandExec, conf, children):
+    return XB.TpuExpandExec(
+        conf, cpu.projections, [f.name for f in cpu.output_schema.fields],
+        children[0],
+    )
+
+
+def _tag_aggregate(meta: "PlanMeta") -> None:
+    cpu: C.CpuHashAggregateExec = meta.wrapped  # type: ignore[assignment]
+    schema = cpu.children[0].output_schema
+    for g in cpu.group_exprs:
+        for r in check_expression(g, schema, meta.conf):
+            meta.will_not_work(r)
+    for ae in cpu.agg_exprs:
+        for r in check_aggregate(ae, schema, meta.conf):
+            meta.will_not_work(r)
+    _tag_output_types(meta)
+
+
+def _convert_aggregate(cpu: C.CpuHashAggregateExec, conf, children):
+    child = children[0]
+    if child.num_partitions == 1:
+        return XA.TpuHashAggregateExec(
+            conf, cpu.group_exprs, cpu.agg_exprs, child, A.COMPLETE)
+    # partial per partition -> single-partition exchange -> final merge
+    partial = XA.TpuHashAggregateExec(
+        conf, cpu.group_exprs, cpu.agg_exprs, child, A.PARTIAL)
+    gathered = TpuGatherPartitionsExec(conf, partial)
+    return XA.TpuHashAggregateExec(
+        conf, cpu.group_exprs, cpu.agg_exprs, gathered, A.FINAL)
+
+
+_exec_rule(C.CpuScanExec, "ScanExec", "in-memory data source", _tag_scan, _convert_scan)
+_exec_rule(C.CpuRangeExec, "RangeExec", "range of longs", _tag_range, _convert_range)
+_exec_rule(C.CpuProjectExec, "ProjectExec", "column projection", _tag_project, _convert_project)
+_exec_rule(C.CpuFilterExec, "FilterExec", "row filter", _tag_filter, _convert_filter)
+_exec_rule(C.CpuUnionExec, "UnionExec", "union all", _tag_union, _convert_union)
+_exec_rule(C.CpuLocalLimitExec, "LocalLimitExec", "row limit", _tag_limit, _convert_limit)
+_exec_rule(C.CpuExpandExec, "ExpandExec", "expand projections", _tag_expand, _convert_expand)
+_exec_rule(C.CpuHashAggregateExec, "HashAggregateExec", "hash aggregation",
+           _tag_aggregate, _convert_aggregate)
+
+
+# ---------------------------------------------------------------------------
+# Meta / tagging (reference: RapidsMeta.scala)
+# ---------------------------------------------------------------------------
+class PlanMeta:
+    def __init__(self, cpu_exec: C.CpuExec, conf: RapidsConf,
+                 parent: Optional["PlanMeta"] = None):
+        self.wrapped = cpu_exec
+        self.conf = conf
+        self.parent = parent
+        self.child_metas = [PlanMeta(c, conf, self) for c in cpu_exec.children]
+        self.reasons: List[str] = []
+        self.rule = EXEC_RULES.get(type(cpu_exec))
+
+    def will_not_work(self, reason: str) -> None:
+        if reason not in self.reasons:
+            self.reasons.append(reason)
+
+    def tag_for_tpu(self) -> None:
+        if self.rule is None:
+            self.will_not_work(
+                f"no TPU replacement rule for {self.wrapped.node_name}"
+            )
+        else:
+            self.rule.tag(self)
+        for c in self.child_metas:
+            c.tag_for_tpu()
+
+    @property
+    def can_replace(self) -> bool:
+        return not self.reasons
+
+    def convert_if_needed(self):
+        """Returns (exec, is_tpu) inserting transitions at boundaries
+        (reference: RapidsMeta.convertIfNeeded :623)."""
+        converted = [c.convert_if_needed() for c in self.child_metas]
+        if self.can_replace and self.rule is not None:
+            tpu_children = [
+                ex if is_tpu else RowToColumnarExec(self.conf, ex)
+                for ex, is_tpu in converted
+            ]
+            return self.rule.convert(self.wrapped, self.conf, tpu_children), True
+        cpu_children = [
+            ColumnarToRowExec(self.conf, ex) if is_tpu else ex
+            for ex, is_tpu in converted
+        ]
+        self.wrapped.children = cpu_children
+        return self.wrapped, False
+
+    # -- reporting ---------------------------------------------------------
+    def explain_lines(self, indent: int = 0) -> List[str]:
+        name = self.rule.name if self.rule else self.wrapped.node_name
+        pad = "  " * indent
+        if self.can_replace:
+            lines = [f"{pad}*Exec <{name}> will run on TPU"]
+        else:
+            why = "; ".join(self.reasons)
+            lines = [f"{pad}!Exec <{name}> cannot run on TPU because {why}"]
+        for c in self.child_metas:
+            lines.extend(c.explain_lines(indent + 1))
+        return lines
+
+    def fallback_nodes(self) -> List[str]:
+        out = [] if self.can_replace else [self.wrapped.node_name]
+        for c in self.child_metas:
+            out.extend(c.fallback_nodes())
+        return out
+
+
+def explain_plan(meta: PlanMeta, conf: RapidsConf) -> str:
+    mode = conf.get(EXPLAIN)
+    if mode == "NONE":
+        return ""
+    lines = meta.explain_lines()
+    if mode == "NOT_ON_TPU":
+        lines = [l for l in lines if "!Exec" in l]
+    return "\n".join(lines)
+
+
+class TpuOverrides:
+    """The ColumnarRule analog (reference: Plugin.scala:40-47 +
+    GpuOverrides.apply)."""
+
+    def __init__(self, conf: RapidsConf):
+        self.conf = conf
+        self.last_explain = ""
+        self.last_meta: Optional[PlanMeta] = None
+
+    def apply(self, plan: C.CpuExec):
+        """CPU plan -> (executable plan, is_tpu_topmost)."""
+        if not self.conf.get(SQL_ENABLED):
+            return plan, False
+        meta = PlanMeta(plan, self.conf)
+        meta.tag_for_tpu()
+        self.last_meta = meta
+        self.last_explain = explain_plan(meta, self.conf)
+        if self.conf.get(TEST_CONF):
+            allowed = {
+                s.strip()
+                for s in self.conf.get(TEST_ALLOWED_NONTPU).split(",")
+                if s.strip()
+            }
+            bad = [n for n in meta.fallback_nodes() if n not in allowed]
+            if bad:
+                raise AssertionError(
+                    "Part of the plan is not columnar "
+                    f"(fell back to CPU): {bad}\n" + "\n".join(meta.explain_lines())
+                )
+        return meta.convert_if_needed()

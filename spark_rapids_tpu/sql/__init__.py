@@ -1,0 +1,9 @@
+"""User-facing SQL layer: session + DataFrame building CPU physical plans
+that the plugin (plugin/overrides.py) then rewrites onto the TPU.
+
+In the reference the 'user layer' is Spark itself; here a small DataFrame
+API stands in for Catalyst, producing the CPU physical plans the override
+pass consumes — the same seam the reference plugs into
+(Plugin.scala:40-47 ColumnarRule hooks).
+"""
+from .session import DataFrame, TpuSession  # noqa: F401

@@ -1,0 +1,243 @@
+"""TpuSession + DataFrame: the Catalyst stand-in.
+
+A DataFrame is an immutable logical node tree; ``collect()`` lowers it to a
+CPU physical plan (the 'what Spark would hand us' plan), runs the override
+pass, and executes the result. ``last_executed_plan`` and
+``last_explain`` expose what happened for the differential-test harness
+(reference: ExecutionPlanCaptureCallback, Plugin.scala:216-305).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+from ..conf import RapidsConf
+from ..cpu import plan as C
+from ..exec.transitions import ColumnarToRowExec
+from ..expr import aggregates as A
+from ..expr import expressions as E
+from ..plugin.overrides import TpuOverrides
+from ..types import StructType
+
+
+@dataclasses.dataclass(frozen=True)
+class LNode:
+    """Logical node; lowered 1:1 to a CPU physical exec."""
+
+    kind: str
+    args: tuple  # hashable payload
+    children: Tuple["LNode", ...] = ()
+
+
+def _lower(node: LNode, conf: RapidsConf) -> C.CpuExec:
+    kids = [_lower(c, conf) for c in node.children]
+    k = node.kind
+    if k == "scan":
+        rows, schema, nparts = node.args
+        per = (len(rows) + nparts - 1) // nparts if rows else 0
+        parts = [
+            list(rows[i * per: (i + 1) * per]) if per else []
+            for i in range(nparts)
+        ] if nparts > 1 else [list(rows)]
+        return C.CpuScanExec(conf, parts, schema)
+    if k == "range":
+        start, end, step, slices, name = node.args
+        return C.CpuRangeExec(conf, start, end, step, slices, name)
+    if k == "project":
+        (exprs,) = node.args
+        return C.CpuProjectExec(conf, list(exprs), kids[0])
+    if k == "filter":
+        (cond,) = node.args
+        return C.CpuFilterExec(conf, cond, kids[0])
+    if k == "aggregate":
+        keys, aggs = node.args
+        return C.CpuHashAggregateExec(conf, list(keys), list(aggs), kids[0])
+    if k == "sort":
+        exprs, orders = node.args
+        return C.CpuSortExec(conf, list(exprs), list(orders), kids[0])
+    if k == "limit":
+        (n,) = node.args
+        return C.CpuLocalLimitExec(conf, n, kids[0])
+    if k == "union":
+        return C.CpuUnionExec(conf, kids)
+    if k == "expand":
+        projections, names = node.args
+        return C.CpuExpandExec(conf, [list(p) for p in projections], list(names), kids[0])
+    if k == "join":
+        lkeys, rkeys, how, cond = node.args
+        return C.CpuJoinExec(conf, kids[0], kids[1], list(lkeys), list(rkeys), how, cond)
+    raise ValueError(f"unknown logical node {k}")
+
+
+def _as_expr(e: Union[str, E.Expression]) -> E.Expression:
+    return E.col(e) if isinstance(e, str) else e
+
+
+class TpuSession:
+    """reference analog: SparkSession with the plugin installed."""
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self.conf = RapidsConf(settings)
+        self.overrides = TpuOverrides(self.conf)
+        self.last_executed_plan = None
+        self.last_cpu_plan = None
+
+    @property
+    def last_explain(self) -> str:
+        return self.overrides.last_explain
+
+    def create_dataframe(
+        self, data: Dict[str, Sequence[Any]], schema: StructType,
+        num_partitions: int = 1,
+    ) -> "DataFrame":
+        names = schema.names
+        n = len(data[names[0]]) if names else 0
+        rows = tuple(
+            tuple(data[name][i] for name in names) for i in range(n)
+        )
+        return DataFrame(self, LNode("scan", (rows, schema, num_partitions)))
+
+    def from_rows(self, rows: Sequence[tuple], schema: StructType,
+                  num_partitions: int = 1) -> "DataFrame":
+        return DataFrame(
+            self, LNode("scan", (tuple(tuple(r) for r in rows), schema, num_partitions))
+        )
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              num_slices: int = 1) -> "DataFrame":
+        if end is None:
+            start, end = 0, start
+        return DataFrame(self, LNode("range", (start, end, step, num_slices, "id")))
+
+    # -- execution ---------------------------------------------------------
+    def _execute(self, node: LNode) -> C.CpuExec:
+        cpu = _lower(node, self.conf)
+        self.last_cpu_plan = cpu
+        final, is_tpu = self.overrides.apply(cpu)
+        if is_tpu:
+            final = ColumnarToRowExec(self.conf, final)
+        self.last_executed_plan = final
+        return final
+
+
+class GroupedData:
+    def __init__(self, df: "DataFrame", keys: Sequence[E.Expression]):
+        self._df = df
+        self._keys = list(keys)
+
+    def agg(self, *aggs: A.AggregateExpression) -> "DataFrame":
+        return DataFrame(
+            self._df.session,
+            LNode("aggregate", (tuple(self._keys), tuple(aggs)), (self._df.node,)),
+        )
+
+    def count(self) -> "DataFrame":
+        return self.agg(A.agg(A.Count(), "count"))
+
+
+class DataFrame:
+    def __init__(self, session: TpuSession, node: LNode):
+        self.session = session
+        self.node = node
+
+    # -- transformations ---------------------------------------------------
+    def select(self, *exprs: Union[str, E.Expression]) -> "DataFrame":
+        return DataFrame(
+            self.session,
+            LNode("project", (tuple(_as_expr(e) for e in exprs),), (self.node,)),
+        )
+
+    def where(self, cond: E.Expression) -> "DataFrame":
+        return DataFrame(self.session, LNode("filter", (cond,), (self.node,)))
+
+    filter = where
+
+    def with_column(self, name: str, expr: E.Expression) -> "DataFrame":
+        schema = self.schema
+        exprs: List[E.Expression] = []
+        replaced = False
+        for f in schema.fields:
+            if f.name == name:
+                exprs.append(E.Alias(expr, name))
+                replaced = True
+            else:
+                exprs.append(E.col(f.name))
+        if not replaced:
+            exprs.append(E.Alias(expr, name))
+        return self.select(*exprs)
+
+    def group_by(self, *keys: Union[str, E.Expression]) -> GroupedData:
+        return GroupedData(self, [_as_expr(k) for k in keys])
+
+    def agg(self, *aggs: A.AggregateExpression) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs)
+
+    def order_by(self, *exprs: Union[str, E.Expression],
+                 ascending: Union[bool, Sequence[bool]] = True,
+                 nulls_first: Union[None, bool, Sequence[Optional[bool]]] = None,
+                 ) -> "DataFrame":
+        es = [_as_expr(e) for e in exprs]
+        if isinstance(ascending, bool):
+            ascending = [ascending] * len(es)
+        if nulls_first is None or isinstance(nulls_first, bool):
+            nulls_first = [nulls_first] * len(es)
+        orders = tuple(zip(ascending, nulls_first))
+        return DataFrame(self.session, LNode("sort", (tuple(es), orders), (self.node,)))
+
+    sort = order_by
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self.session, LNode("limit", (n,), (self.node,)))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(
+            self.session, LNode("union", (), (self.node, other.node))
+        )
+
+    def join(self, other: "DataFrame", on: Union[str, Sequence[str], Sequence[Tuple[str, str]]],
+             how: str = "inner", condition: Optional[E.Expression] = None) -> "DataFrame":
+        if isinstance(on, str):
+            on = [on]
+        pairs = [
+            (k, k) if isinstance(k, str) else k for k in on
+        ]
+        lkeys = tuple(E.col(a) for a, _ in pairs)
+        rkeys = tuple(E.col(b) for _, b in pairs)
+        return DataFrame(
+            self.session,
+            LNode("join", (lkeys, rkeys, how, condition), (self.node, other.node)),
+        )
+
+    def distinct(self) -> "DataFrame":
+        keys = tuple(E.col(f.name) for f in self.schema.fields)
+        return DataFrame(
+            self.session, LNode("aggregate", (keys, ()), (self.node,))
+        )
+
+    # -- actions -----------------------------------------------------------
+    @property
+    def schema(self) -> StructType:
+        return _lower(self.node, self.session.conf).output_schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self.schema.names
+
+    def collect(self) -> List[tuple]:
+        return self.session._execute(self.node).collect()
+
+    def count(self) -> int:
+        return len(self.collect())
+
+    def to_pydict(self) -> Dict[str, List[Any]]:
+        rows = self.collect()
+        names = self.columns
+        return {n: [r[i] for r in rows] for i, n in enumerate(names)}
+
+    def explain(self) -> str:
+        cpu = _lower(self.node, self.session.conf)
+        from ..plugin.overrides import PlanMeta
+
+        meta = PlanMeta(cpu, self.session.conf)
+        meta.tag_for_tpu()
+        return "\n".join(meta.explain_lines())

@@ -1,0 +1,112 @@
+"""CPU-vs-TPU differential test harness.
+
+Reference analog: SparkQueryCompareTestSuite.testSparkResultsAreEqual
+(tests/.../SparkQueryCompareTestSuite.scala:731) and the pytest
+assert_gpu_and_cpu_are_equal_collect / assert_gpu_fallback_collect
+(integration_tests asserts.py:330/:281): run the same query with the plugin
+disabled and enabled, assert equal results; optionally assert that a named
+operator fell back to CPU.
+"""
+import math
+from typing import Callable, Dict, List, Optional, Sequence
+
+from spark_rapids_tpu.sql import DataFrame, TpuSession
+
+
+def _canon(v, approx: bool):
+    """Total-order sort key: (null_rank, type_tag, (nan_rank, value))."""
+    if v is None:
+        return (0, "", (0, 0))
+    if isinstance(v, bool):
+        return (1, "b", (0, v))
+    if isinstance(v, float):
+        if math.isnan(v):
+            return (1, "f", (1, 0.0))
+        return (1, "f", (0, round(v, 9) if approx else v))
+    if isinstance(v, int):
+        return (1, "f", (0, v))
+    if isinstance(v, bytes):
+        return (1, "y", (0, v))
+    return (1, "s", (0, str(v)))
+
+
+def _sort_key(row, approx):
+    return tuple(_canon(v, approx) for v in row)
+
+
+def compare_rows(cpu_rows: List[tuple], tpu_rows: List[tuple],
+                 ignore_order: bool = True, approx_float: bool = False) -> None:
+    assert len(cpu_rows) == len(tpu_rows), (
+        f"row count mismatch: cpu={len(cpu_rows)} tpu={len(tpu_rows)}\n"
+        f"cpu={cpu_rows[:20]}\ntpu={tpu_rows[:20]}"
+    )
+    if ignore_order:
+        cpu_rows = sorted(cpu_rows, key=lambda r: _sort_key(r, approx_float))
+        tpu_rows = sorted(tpu_rows, key=lambda r: _sort_key(r, approx_float))
+    for i, (cr, tr) in enumerate(zip(cpu_rows, tpu_rows)):
+        assert len(cr) == len(tr), f"row {i} width mismatch: {cr} vs {tr}"
+        for j, (cv, tv) in enumerate(zip(cr, tr)):
+            if cv is None or tv is None:
+                assert cv is None and tv is None, (
+                    f"row {i} col {j}: cpu={cv!r} tpu={tv!r}")
+                continue
+            if isinstance(cv, float) and isinstance(tv, float):
+                if math.isnan(cv) or math.isnan(tv):
+                    assert math.isnan(cv) and math.isnan(tv), (
+                        f"row {i} col {j}: cpu={cv!r} tpu={tv!r}")
+                elif approx_float:
+                    assert cv == tv or math.isclose(cv, tv, rel_tol=1e-9, abs_tol=1e-12), (
+                        f"row {i} col {j}: cpu={cv!r} tpu={tv!r}")
+                else:
+                    assert cv == tv, f"row {i} col {j}: cpu={cv!r} tpu={tv!r}"
+            else:
+                assert cv == tv, f"row {i} col {j}: cpu={cv!r} tpu={tv!r}"
+
+
+def assert_tpu_and_cpu_equal(
+    build: Callable[[TpuSession], DataFrame],
+    conf: Optional[Dict] = None,
+    ignore_order: bool = True,
+    approx_float: bool = False,
+    allow_non_tpu: Sequence[str] = (),
+):
+    """Run the query twice (plugin off/on) and diff the results.
+
+    Unless ``allow_non_tpu`` names CPU operators, the TPU run asserts that
+    the WHOLE plan was replaced (reference: 'test.enabled' RapidsConf key).
+    """
+    conf = dict(conf or {})
+    cpu_sess = TpuSession({**conf, "spark.rapids.tpu.sql.enabled": False})
+    tpu_conf = {
+        **conf,
+        "spark.rapids.tpu.sql.enabled": True,
+        "spark.rapids.tpu.sql.test.enabled": True,
+        "spark.rapids.tpu.sql.test.allowedNonTpu": ",".join(allow_non_tpu),
+    }
+    tpu_sess = TpuSession(tpu_conf)
+    cpu_rows = build(cpu_sess).collect()
+    tpu_rows = build(tpu_sess).collect()
+    compare_rows(cpu_rows, tpu_rows, ignore_order, approx_float)
+    return cpu_rows
+
+
+def assert_fallback(
+    build: Callable[[TpuSession], DataFrame],
+    fallback_class: str,
+    conf: Optional[Dict] = None,
+):
+    """Assert results equal AND that ``fallback_class`` stayed on CPU
+    (reference: assert_gpu_fallback_collect, asserts.py:281)."""
+    conf = dict(conf or {})
+    cpu_sess = TpuSession({**conf, "spark.rapids.tpu.sql.enabled": False})
+    tpu_sess = TpuSession({**conf, "spark.rapids.tpu.sql.enabled": True})
+    cpu_rows = build(cpu_sess).collect()
+    tpu_rows = build(tpu_sess).collect()
+    compare_rows(cpu_rows, tpu_rows)
+    meta = tpu_sess.overrides.last_meta
+    assert meta is not None, "no plan captured"
+    fellback = meta.fallback_nodes()
+    assert fallback_class in fellback, (
+        f"expected {fallback_class} to fall back; fell back: {fellback}\n"
+        + "\n".join(meta.explain_lines())
+    )
