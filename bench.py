@@ -1,0 +1,131 @@
+"""Benchmark: TPC-DS q5-class aggregate pipeline, TPU engine vs vectorized
+CPU (pandas stands in for per-core CPU Spark).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+``vs_baseline`` is the measured speedup divided by the reference's "4x
+typical" GPU-vs-CPU speedup claim (docs/FAQ.md:60-66; BASELINE.md) — 1.0
+means we match the reference's typical win, >1.0 beats it.
+
+Usage: python bench.py [--rows N] [--iters K]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1 << 22)
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    n = args.rows
+    rng = np.random.default_rng(42)
+    k = rng.integers(0, 64, n).astype(np.int32)
+    a = rng.integers(-(10**6), 10**6, n).astype(np.int64)
+    b = rng.normal(size=n)
+    b_null = rng.random(n) < 0.05
+
+    # ---- CPU baseline: pandas (vectorized, like per-core CPU Spark) ------
+    import pandas as pd
+
+    pdf = pd.DataFrame({"k": k, "a": a, "b": np.where(b_null, np.nan, b)})
+
+    def cpu_query():
+        f = pdf[pdf["a"] >= 0]
+        g = f.assign(a2=f["a"] * 2).groupby("k").agg(
+            s=("a2", "sum"), m=("b", "mean"), c=("b", "count"))
+        return g
+
+    cpu_query()  # warm
+    t0 = time.perf_counter()
+    for _ in range(max(1, args.iters // 2)):
+        cpu_query()
+    cpu_time = (time.perf_counter() - t0) / max(1, args.iters // 2)
+
+    # ---- TPU engine: the real exec-layer pipeline ------------------------
+    import jax
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar import ColumnarBatch, DeviceColumn
+    from spark_rapids_tpu.columnar.batch import schema_of
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.exec import (
+        InMemoryScanExec,
+        TpuFilterExec,
+        TpuHashAggregateExec,
+        TpuProjectExec,
+    )
+    from spark_rapids_tpu.expr import aggregates as A
+    from spark_rapids_tpu.expr import expressions as E
+    from spark_rapids_tpu.expr.expressions import col, lit
+    from spark_rapids_tpu.utils.bucketing import bucket_rows
+
+    conf = RapidsConf()
+    schema = schema_of(k=T.INT, a=T.LONG, b=T.DOUBLE)
+    cap = bucket_rows(n)
+    valid = np.ones(cap, dtype=bool)
+    valid[n:] = False
+
+    def dev(x, dt, v):
+        data = np.zeros(cap, dtype=x.dtype)
+        data[:n] = x
+        import jax.numpy as jnp
+
+        return DeviceColumn(dt, n, jnp.asarray(data), jnp.asarray(v))
+
+    bvalid = valid.copy()
+    bvalid[:n] = ~b_null
+    batch = ColumnarBatch(
+        [dev(k, T.INT, valid), dev(a, T.LONG, valid),
+         dev(np.where(b_null, 0.0, b), T.DOUBLE, bvalid)],
+        schema, n,
+    )
+
+    def build():
+        scan = InMemoryScanExec(conf, [[batch]], schema)
+        filt = TpuFilterExec(conf, E.GreaterThanOrEqual(col("a"), lit(0)), scan)
+        proj = TpuProjectExec(
+            conf, [col("k"), E.Alias(E.Multiply(col("a"), lit(2)), "a2"), col("b")],
+            filt)
+        return TpuHashAggregateExec(
+            conf, [col("k")],
+            [A.agg(A.Sum(col("a2")), "s"), A.agg(A.Average(col("b")), "m"),
+             A.agg(A.Count(col("b")), "c")],
+            proj)
+
+    agg_exec = build()
+
+    def tpu_query():
+        # full query semantics: results land on the host, like a collect()
+        out = list(agg_exec.execute_columnar())
+        return [b.to_rows() for b in out]
+
+    tpu_query()  # warm (compile)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        tpu_query()
+    tpu_time = (time.perf_counter() - t0) / args.iters
+
+    speedup = cpu_time / tpu_time
+    print(
+        f"rows={n} cpu={cpu_time*1e3:.1f}ms tpu={tpu_time*1e3:.1f}ms "
+        f"speedup={speedup:.2f}x",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": "tpcds_q5_like_agg_pipeline_speedup_vs_cpu",
+        "value": round(speedup, 3),
+        "unit": "x (pipeline wallclock, 4M rows)",
+        "vs_baseline": round(speedup / 4.0, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,13 +34,17 @@ from .base import (
     TpuExec,
     batch_from_vals,
     batch_signature,
+    count_scalar,
     timed,
     vals_of_batch,
 )
 
 
-@functools.lru_cache(maxsize=256)
+_AGG_CACHE: dict = {}
+
+
 def _agg_pipeline(
+    chain,  # fusable execs below this aggregate (fused into the update step)
     key_exprs: Tuple[E.Expression, ...],
     key_dtypes: Tuple[T.DataType, ...],
     value_exprs: Tuple[Optional[E.Expression], ...],
@@ -48,22 +52,41 @@ def _agg_pipeline(
     sig: tuple,
     cap: int,
     str_max_lens: Tuple[int, ...],
+    approx_float_sum: bool = False,
 ):
-    """One fused program: project keys+inputs, sort, segment-reduce."""
+    """ONE fused program: child chain (filter/project...), key+input
+    projection, groupby reduce — a whole query stage per dispatch."""
+    key = (
+        tuple(e.fusion_key() for e in chain), key_exprs, key_dtypes,
+        value_exprs, ops, sig, cap, str_max_lens, approx_float_sum,
+    )
+    fn = _AGG_CACHE.get(key)
+    if fn is not None:
+        return fn
+    chain_t = tuple(chain)
 
     def run(cols, num_rows):
+        from ..ops.filter_gather import live_of
+
+        live = live_of(num_rows, cap)
+        for e in chain_t:
+            cols, live = e.lower_batch(cols, live, cap)
         keys = [lower(e, cols, cap) for e in key_exprs]
         vals: List[Optional[ColV]] = []
         for e in value_exprs:
             vals.append(None if e is None else lower(e, cols, cap))
         if key_exprs:
-            return groupby_ops.sort_groupby(
-                keys, list(key_dtypes), vals, list(ops), num_rows, str_max_lens
+            return groupby_ops.groupby_agg(
+                keys, list(key_dtypes), vals, list(ops), live, str_max_lens,
+                approx_float_sum=approx_float_sum,
             )
-        outs = groupby_ops.reduce_no_keys(vals, list(ops), num_rows)
+        outs = groupby_ops.reduce_no_keys(vals, list(ops), live)
         return [], outs, jnp.int32(1)
 
-    return jax.jit(run)
+    if len(_AGG_CACHE) > 512:
+        _AGG_CACHE.clear()
+    fn = _AGG_CACHE[key] = jax.jit(run)
+    return fn
 
 
 class TpuHashAggregateExec(TpuExec):
@@ -192,33 +215,50 @@ class TpuHashAggregateExec(TpuExec):
     def _key_dtypes(self) -> Tuple[T.DataType, ...]:
         return tuple(f.dataType for f in self._key_fields)
 
-    def _str_max_lens(self, batch: ColumnarBatch) -> Tuple[int, ...]:
-        """Static byte-length buckets for string group keys (host sync)."""
+    def _str_max_lens(self, batch: ColumnarBatch, direct: bool) -> Tuple[int, ...]:
+        """Static byte-length buckets for string group keys (host sync only
+        when string keys exist). ``direct``: batch columns match the bound
+        key ordinals; otherwise (a fused chain below) any string key passed
+        through from a source string column, so the max over all source
+        string columns is a safe bound."""
         lens = []
+        source_max = None
         for b in self._bound_keys:
             if isinstance(b.dtype, (T.StringType, T.BinaryType)):
-                if isinstance(b, E.BoundReference):
+                if direct and isinstance(b, E.BoundReference):
                     col = batch.columns[b.ordinal]
                     m = int(max_string_len(StrV(col.offsets, col.chars, col.validity)))
                 else:
-                    m = 64
+                    if source_max is None:
+                        ms = [
+                            int(max_string_len(StrV(c.offsets, c.chars, c.validity)))
+                            for c in batch.columns if c.is_string
+                        ]
+                        source_max = max(ms) if ms else 64
+                    m = source_max
                 lens.append(max(4, bucket_rows(max(1, m), 4)))
         return tuple(lens)
 
     def _run_batch(self, batch: ColumnarBatch, ops: Sequence[str],
-                   value_exprs: Sequence[Optional[E.Expression]]) -> ColumnarBatch:
-        """Aggregate one batch into a [keys..., buffers...] batch."""
-        cap = batch.columns[0].capacity if batch.columns else bucket_rows(
+                   value_exprs: Sequence[Optional[E.Expression]],
+                   chain=()) -> ColumnarBatch:
+        """Aggregate one (source) batch into a [keys..., buffers...] batch,
+        fusing any fusable child execs into the same XLA program. The group
+        count stays a device scalar — no sync."""
+        cap = batch.capacity if batch.columns else bucket_rows(
             batch.num_rows, self.conf.shape_bucket_min)
-        sml = self._str_max_lens(batch)
+        sml = self._str_max_lens(batch, direct=not chain)
+        from ..conf import IMPROVED_FLOAT_OPS
+
         fn = _agg_pipeline(
-            tuple(self._bound_keys), self._key_dtypes(), tuple(value_exprs),
-            tuple(ops), batch_signature(batch), cap, sml,
+            chain, tuple(self._bound_keys), self._key_dtypes(),
+            tuple(value_exprs), tuple(ops), batch_signature(batch), cap, sml,
+            approx_float_sum=self.conf.get(IMPROVED_FLOAT_OPS),
         )
-        keys, aggs, nseg = fn(vals_of_batch(batch), jnp.int32(batch.num_rows))
-        n = int(nseg)
+        keys, aggs, nseg = fn(
+            vals_of_batch(batch), count_scalar(batch.num_rows_lazy))
         vals = list(keys) + list(aggs)
-        return batch_from_vals(vals, self._buffer_schema, n)
+        return batch_from_vals(vals, self._buffer_schema, nseg)
 
     def _merge(self, partials: List[ColumnarBatch]) -> ColumnarBatch:
         """Concat partial batches and re-aggregate with merge ops
@@ -282,18 +322,25 @@ class TpuHashAggregateExec(TpuExec):
         cap = buffers.columns[0].capacity if buffers.columns else 1
         fn = _project_pipeline(tuple(exprs), batch_signature(buffers), cap)
         vals = fn(vals_of_batch(buffers))
-        return batch_from_vals(vals, self._schema, buffers.num_rows)
+        return batch_from_vals(vals, self._schema, buffers.num_rows_lazy)
 
     # -- execution ---------------------------------------------------------
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
         partials: List[ColumnarBatch] = []
         ops = self._update_ops
         exprs = self._update_exprs
-        for batch in self.children[0].execute_partition(index):
-            if batch.num_rows == 0 and self.group_exprs:
+        # fuse any fusable execs below us into the update dispatch
+        child = self.children[0]
+        if child.fusable:
+            source, chain = child.fused_source_chain()
+        else:
+            source, chain = child, ()
+        for batch in source.execute_partition(index):
+            nr = batch.num_rows_lazy
+            if isinstance(nr, int) and nr == 0 and self.group_exprs and not chain:
                 continue
             with timed(self.metrics[TOTAL_TIME]):
-                partials.append(self._run_batch(batch, ops, exprs))
+                partials.append(self._run_batch(batch, ops, exprs, tuple(chain)))
         if not partials:
             if self.group_exprs:
                 return  # grouped aggregate over empty input -> no rows

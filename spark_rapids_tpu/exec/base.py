@@ -62,7 +62,19 @@ def timed(metric: Optional[Metric], trace_name: str = "", trace: bool = False):
 
 
 class TpuExec:
-    """Base physical operator producing columnar batches on TPU."""
+    """Base physical operator producing columnar batches on TPU.
+
+    Whole-stage fusion (TPU-first design, no reference analog): execs that
+    set ``fusable`` and implement ``lower_batch``/``fusion_key`` are traced
+    together into ONE XLA program per maximal single-child chain — project,
+    filter, and the aggregate's update step all fuse, so a scan->filter->
+    project->aggregate pipeline is a single device dispatch with zero
+    intermediate host syncs (row counts ride along as device scalars).
+    The reference launches one cudf kernel per expression node instead.
+    """
+
+    #: True when this exec can lower into a shared fused trace
+    fusable = False
 
     def __init__(self, conf: RapidsConf, children: Sequence["TpuExec"] = ()):
         self.conf = conf
@@ -90,6 +102,33 @@ class TpuExec:
         for p in range(self.num_partitions):
             yield from self.execute_partition(p)
 
+    #: True when lower_batch may clear liveness bits (filters); tells the
+    #: chain driver a final compaction is needed for standalone output
+    sparsifies = False
+
+    # -- fusion ------------------------------------------------------------
+    def fusion_key(self) -> tuple:
+        """Structural identity of this exec's lowering (cache key part)."""
+        raise NotImplementedError(type(self).__name__)
+
+    def lower_batch(self, cols, live, cap):
+        """Pure traced transform: (cols, live_mask) -> (cols, live_mask).
+
+        ``live`` is a (cap,) bool mask — filters just clear bits instead of
+        gathering rows (TPU gathers are slow; reductions consume the mask
+        for free). Compaction happens only at chain boundaries that need
+        dense batches."""
+        raise NotImplementedError(type(self).__name__)
+
+    def fused_source_chain(self):
+        """(source exec, [fusable execs bottom-up ending at self])."""
+        node = self
+        chain: List[TpuExec] = []
+        while node.fusable and len(node.children) == 1:
+            chain.append(node)
+            node = node.children[0]
+        return node, list(reversed(chain))
+
     # -- conveniences ------------------------------------------------------
     def metric(self, name: str) -> Metric:
         if name not in self.metrics:
@@ -97,7 +136,9 @@ class TpuExec:
         return self.metrics[name]
 
     def record_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
-        self.metrics[NUM_OUTPUT_ROWS].add(batch.num_rows)
+        nr = batch.num_rows_lazy
+        if isinstance(nr, int):
+            self.metrics[NUM_OUTPUT_ROWS].add(nr)
         self.metrics[NUM_OUTPUT_BATCHES].add(1)
         return batch
 
@@ -151,6 +192,59 @@ def batch_from_vals(
         else:
             cols.append(DeviceColumn(f.dataType, num_rows, v.data, v.validity))
     return ColumnarBatch(cols, schema, num_rows)
+
+
+_FUSED_CACHE: Dict[tuple, Callable] = {}
+
+
+def count_scalar(num_rows):
+    """Row count as a traced int32 scalar (host int or device scalar in)."""
+    import jax.numpy as jnp
+
+    return jnp.int32(num_rows) if isinstance(num_rows, int) else num_rows
+
+
+def fused_pipeline(chain: Sequence[TpuExec], sig: tuple, cap: int):
+    """One jitted program applying every exec in ``chain`` bottom-up.
+
+    The chain threads a liveness MASK between stages; if any stage
+    sparsified it (a filter), rows compact once at the end so the emitted
+    batch is dense — otherwise the input row count passes straight through.
+    """
+    key = (tuple(e.fusion_key() for e in chain), sig, cap)
+    fn = _FUSED_CACHE.get(key)
+    if fn is None:
+        chain_t = tuple(chain)
+        needs_compact = any(e.sparsifies for e in chain_t)
+
+        def run(cols, num_rows):
+            from ..ops import filter_gather
+
+            live = filter_gather.live_of(num_rows, cap)
+            for e in chain_t:
+                cols, live = e.lower_batch(cols, live, cap)
+            if needs_compact:
+                cols, count = filter_gather.filter_cols(cols, live, num_rows)
+                return cols, count
+            return cols, num_rows
+
+        if len(_FUSED_CACHE) > 1024:
+            _FUSED_CACHE.clear()
+        fn = _FUSED_CACHE[key] = jax.jit(run)
+    return fn
+
+
+def run_fused_chain(exec_self: TpuExec, index: int) -> Iterator[ColumnarBatch]:
+    """Shared execute_partition for fusable execs: the whole chain below
+    (and including) ``exec_self`` runs as one XLA dispatch per batch, with
+    the row count threaded through as a device scalar (no host syncs)."""
+    source, chain = exec_self.fused_source_chain()
+    out_schema = exec_self.output_schema
+    for batch in source.execute_partition(index):
+        cap = batch.capacity if batch.columns else 128
+        fn = fused_pipeline(chain, batch_signature(batch), cap)
+        vals, nr = fn(vals_of_batch(batch), count_scalar(batch.num_rows_lazy))
+        yield exec_self.record_batch(batch_from_vals(vals, out_schema, nr))
 
 
 def batch_signature(batch: ColumnarBatch) -> tuple:

@@ -101,7 +101,11 @@ def _project_pipeline(exprs: Tuple[E.Expression, ...], sig: tuple, cap: int):
 
 
 class TpuProjectExec(TpuExec):
-    """reference: GpuProjectExec (basicPhysicalOperators.scala:48-61)."""
+    """reference: GpuProjectExec (basicPhysicalOperators.scala:48-61).
+
+    Fusable: a project never dispatches alone if its neighbors fuse too."""
+
+    fusable = True
 
     def __init__(self, conf: RapidsConf, exprs: Sequence[E.Expression], child: TpuExec):
         super().__init__(conf, [child])
@@ -118,33 +122,27 @@ class TpuProjectExec(TpuExec):
     def describe(self):
         return f"TpuProjectExec [{', '.join(map(str, self.exprs))}]"
 
+    def fusion_key(self):
+        return ("project", self._bound)
+
+    def lower_batch(self, cols, live, cap):
+        return [lower(e, cols, cap) for e in self._bound], live
+
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
-        trace = self.conf.get(ENABLE_TRACE)
-        for batch in self.children[0].execute_partition(index):
-            with timed(self.metrics[TOTAL_TIME], "TpuProject", trace):
-                cap = batch.columns[0].capacity if batch.columns else bucket_rows(batch.num_rows)
-                fn = _project_pipeline(self._bound, batch_signature(batch), cap)
-                vals = fn(vals_of_batch(batch))
-                out = batch_from_vals(vals, self._schema, batch.num_rows)
-            yield self.record_batch(out)
+        from .base import run_fused_chain
 
-
-@functools.lru_cache(maxsize=512)
-def _filter_pipeline(cond: E.Expression, sig: tuple, cap: int):
-    def run(cols, num_rows):
-        c = lower(cond, cols, cap)
-        live = jnp.arange(cap, dtype=jnp.int32) < num_rows
-        mask = c.data & c.validity & live
-        out, count = filter_gather.filter_cols(cols, mask, num_rows)
-        return out, count
-
-    return jax.jit(run)
+        with timed(self.metrics[TOTAL_TIME], "TpuProject", self.conf.get(ENABLE_TRACE)):
+            yield from run_fused_chain(self, index)
 
 
 class TpuFilterExec(TpuExec):
     """reference: GpuFilterExec/GpuFilter (basicPhysicalOperators.scala:113-172).
 
-    Condition eval + compaction fuse into one XLA program."""
+    Condition eval + row compaction lower into the fused stage; the surviving
+    row count stays on device (cudf syncs for it — we don't have to)."""
+
+    fusable = True
+    sparsifies = True
 
     def __init__(self, conf: RapidsConf, condition: E.Expression, child: TpuExec):
         super().__init__(conf, [child])
@@ -158,15 +156,18 @@ class TpuFilterExec(TpuExec):
     def describe(self):
         return f"TpuFilterExec [{self.condition}]"
 
+    def fusion_key(self):
+        return ("filter", self._bound)
+
+    def lower_batch(self, cols, live, cap):
+        c = lower(self._bound, cols, cap)
+        return cols, live & c.data & c.validity
+
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
-        for batch in self.children[0].execute_partition(index):
-            with timed(self.metrics[TOTAL_TIME]):
-                cap = batch.columns[0].capacity if batch.columns else bucket_rows(batch.num_rows)
-                fn = _filter_pipeline(self._bound, batch_signature(batch), cap)
-                vals, count = fn(vals_of_batch(batch), jnp.int32(batch.num_rows))
-                n = int(count)  # row-count sync, same boundary cudf has
-                out = batch_from_vals(vals, self.output_schema, n)
-            yield self.record_batch(out)
+        from .base import run_fused_chain
+
+        with timed(self.metrics[TOTAL_TIME]):
+            yield from run_fused_chain(self, index)
 
 
 class TpuRangeExec(TpuExec):

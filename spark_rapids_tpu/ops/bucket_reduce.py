@@ -1,0 +1,155 @@
+"""MXU-based bucket reductions: segmented sums/counts as one-hot matmuls.
+
+TPU-first design with no reference analog: XLA's scatter (what
+``jax.ops.segment_sum`` lowers to) runs near-serially on TPU (~10ns/row),
+while the MXU multiplies 256x256 tiles for free. A bucket reduction
+``out[b] = sum(x[i] for seg[i]==b)`` is exactly ``one_hot(seg) @ x`` — and
+XLA fuses the one-hot generation into the matmul so the (n, B) matrix never
+materializes.
+
+Exactness: f32 matmuls (precision=HIGHEST) are exact for addends < 2^24, so
+int64 values are split into 4x16-bit limbs and reduced in row-blocks of 256
+(block limb sum <= 256*65535 < 2^24), block partials then accumulate in
+int64 — bit-exact integer sums at matmul speed, including Java wraparound.
+Counts are a ones-limb. Doubles use a hi/lo float split (not bit-exact,
+order-insensitive — the reference gates float aggregation the same way:
+spark.rapids.sql.variableFloatAgg.enabled).
+
+Out-of-range segment ids (padding/dead rows) one-hot to a zero row and
+drop out of every reduction for free.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+BLOCK_R = 256  # rows per block: 256 * (2^16 - 1) < 2^24 keeps f32 exact
+CHUNK_ROWS = 1 << 20  # super-chunk bound on the (nb, L, B) transient
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def _blocked(seg: jax.Array, cols: jax.Array, B: int):
+    """einsum over row-blocks: cols (n, L) f32 -> per-block sums (nb, L, B)."""
+    n = seg.shape[0]
+    R = min(BLOCK_R, n)
+    nb = n // R
+    oh_src = seg[: nb * R].reshape(nb, R)
+    c = cols[: nb * R].reshape(nb, R, -1)
+    oh = jax.nn.one_hot(oh_src, B, dtype=jnp.float32)
+    return jnp.einsum("brl,brB->blB", c, oh, precision=_HI)
+
+
+def bucket_reduce(
+    seg: jax.Array,
+    B: int,
+    int_cols: Sequence[Tuple[jax.Array, jax.Array]] = (),
+    count_cols: Sequence[jax.Array] = (),
+    float_cols: Sequence[Tuple[jax.Array, jax.Array]] = (),
+) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
+    """All requested reductions in one fused matmul pass.
+
+    seg: (n,) int32 bucket ids; ids >= B are dropped.
+    int_cols:   [(data int64/int32, valid bool)] -> exact int64 sums (B,)
+    count_cols: [valid bool] -> int64 counts (B,)
+    float_cols: [(data f64/f32, valid bool)] -> f64 sums (B,) (hi/lo split)
+    """
+    n = seg.shape[0]
+    limbs: List[jax.Array] = []
+    for data, valid in int_cols:
+        u = data.astype(jnp.int64).astype(jnp.uint64)
+        u = jnp.where(valid, u, jnp.uint64(0))
+        for i in range(4):
+            limbs.append(((u >> (16 * i)) & jnp.uint64(0xFFFF)).astype(jnp.float32))
+    for valid in count_cols:
+        limbs.append(valid.astype(jnp.float32))
+    nf_start = len(limbs)
+    for data, valid in float_cols:
+        d = jnp.where(valid, data, 0.0).astype(jnp.float64)
+        hi = d.astype(jnp.float32)
+        lo = (d - hi.astype(jnp.float64)).astype(jnp.float32)
+        limbs.append(hi)
+        limbs.append(lo)
+    if not limbs:
+        return [], [], []
+    cols = jnp.stack(limbs, axis=-1)  # (n, L)
+
+    # super-chunks bound the (nb, L, B) transient
+    L = cols.shape[1]
+    acc_i = jnp.zeros((nf_start, B), jnp.int64)
+    acc_f = jnp.zeros((L - nf_start, B), jnp.float64)
+    for start in range(0, n, CHUNK_ROWS):
+        end = min(n, start + CHUNK_ROWS)
+        S = _blocked(seg[start:end], cols[start:end], B)  # (nb, L, B) f32
+        acc_i = acc_i + S[:, :nf_start, :].astype(jnp.int64).sum(axis=0)
+        acc_f = acc_f + S[:, nf_start:, :].astype(jnp.float64).sum(axis=0)
+    # tail rows not covered by full blocks
+    R = min(BLOCK_R, n)
+    tail = n - (n // R) * R
+    if tail:
+        tseg = seg[n - tail:]
+        tcols = cols[n - tail:]
+        oh = jax.nn.one_hot(tseg, B, dtype=jnp.float32)
+        S = jnp.einsum("rl,rB->lB", tcols, oh, precision=_HI)
+        acc_i = acc_i + S[:nf_start].astype(jnp.int64)
+        acc_f = acc_f + S[nf_start:].astype(jnp.float64)
+
+    out_int: List[jax.Array] = []
+    k = 0
+    for _ in int_cols:
+        total = jnp.zeros(B, jnp.uint64)
+        for i in range(4):
+            total = total + (acc_i[k].astype(jnp.uint64) << (16 * i))
+            k += 1
+        out_int.append(total.astype(jnp.int64))
+    out_cnt: List[jax.Array] = []
+    for _ in count_cols:
+        out_cnt.append(acc_i[k])
+        k += 1
+    out_flt: List[jax.Array] = []
+    k = 0
+    for _ in float_cols:
+        out_flt.append(acc_f[k] + acc_f[k + 1])
+        k += 2
+    return out_int, out_cnt, out_flt
+
+
+def bucket_lookup_u32(
+    seg: jax.Array, B: int, table: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-row lookup of a u32 table value by bucket id, exactly, via two
+    16-bit-limb one-hot matmuls. Returns (lo, hi) f32 per row (each < 2^16,
+    exact). Rows with seg >= B read 0."""
+    n = seg.shape[0]
+    lo = (table & jnp.uint32(0xFFFF)).astype(jnp.float32)
+    hi = (table >> 16).astype(jnp.float32)
+    t2 = jnp.stack([lo, hi], axis=-1)  # (B, 2)
+    R = min(BLOCK_R, n)
+    nb = n // R
+    head = seg[: nb * R].reshape(nb, R)
+    oh = jax.nn.one_hot(head, B, dtype=jnp.float32)
+    vals = jnp.einsum("brB,Bt->brt", oh, t2, precision=_HI).reshape(nb * R, 2)
+    tail = n - nb * R
+    if tail:
+        oh_t = jax.nn.one_hot(seg[nb * R:], B, dtype=jnp.float32)
+        vt = jnp.einsum("rB,Bt->rt", oh_t, t2, precision=_HI)
+        vals = jnp.concatenate([vals, vt], axis=0)
+    return vals[:, 0], vals[:, 1]
+
+
+def bucket_equal_check(
+    seg: jax.Array,
+    B: int,
+    word: jax.Array,
+    rep_table: jax.Array,
+    live: jax.Array,
+) -> jax.Array:
+    """True iff every live row's u32 ``word`` equals its bucket's
+    representative (exact collision detection for hash groupby)."""
+    lo, hi = bucket_lookup_u32(seg, B, rep_table)
+    wlo = (word & jnp.uint32(0xFFFF)).astype(jnp.float32)
+    whi = (word >> 16).astype(jnp.float32)
+    mismatch = live & ((lo != wlo) | (hi != whi))
+    return ~jnp.any(mismatch)

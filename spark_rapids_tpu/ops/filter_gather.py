@@ -20,18 +20,36 @@ import jax.numpy as jnp
 from ..expr.eval import ColV, StrV, Val
 
 
+def live_of(num_rows_or_mask, cap: int) -> jax.Array:
+    """Normalize a row count (host int or device scalar) or a bool mask
+    into a (cap,) liveness mask. The mask form lets filters defer row
+    compaction entirely — downstream fused ops reduce over the mask."""
+    x = num_rows_or_mask
+    if isinstance(x, jax.Array) and x.dtype == jnp.bool_ and x.ndim == 1:
+        return x
+    return jnp.arange(cap, dtype=jnp.int32) < x
+
+
 def compaction_indices(mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Destination-order gather indices for selected rows.
 
     Returns (indices, count): ``indices[j]`` = row of the j-th selected row
     for j < count; tail entries point at row 0 (callers mask them out).
+
+    O(n): prefix-sum destinations + one scatter of row ids (a sort-based
+    selected-first permutation costs log^2 n passes on the TPU's bitonic
+    sorter — 100x more HBM traffic).
     """
     cap = mask.shape[0]
-    # position of each output slot among selected rows: a stable
-    # "selected-first" permutation via argsort of the inverted mask.
-    order = jnp.argsort(~mask, stable=True)
-    count = jnp.sum(mask.astype(jnp.int32))
-    return order.astype(jnp.int32), count
+    csum = jnp.cumsum(mask.astype(jnp.int32))
+    count = csum[cap - 1]
+    dest = jnp.where(mask, csum - 1, cap)  # cap = out of bounds -> dropped
+    indices = (
+        jnp.zeros(cap, jnp.int32)
+        .at[dest]
+        .set(jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    )
+    return indices, count
 
 
 def gather_fixed(col: ColV, indices: jax.Array, valid_slot: jax.Array) -> ColV:

@@ -20,6 +20,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .. import types as T
 from ..expr.eval import ColV, StrV, Val
@@ -43,7 +44,9 @@ def segment_ids_from_radix_keys(
     eq = jnp.ones(cap, jnp.bool_)
     for k in sorted_radix_keys:
         eq = eq & (k == jnp.roll(k, 1))
-    live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+    from .filter_gather import live_of
+
+    live = live_of(num_rows, cap)
     new_seg = live & (~eq | (jnp.arange(cap) == 0))
     seg = jnp.cumsum(new_seg.astype(jnp.int32)) - 1
     num_segments = jnp.max(jnp.where(live, seg, -1)) + 1
@@ -154,7 +157,7 @@ def sort_groupby(
     num_rows: Union[int, jax.Array],
     str_max_lens: Sequence[int] = (),
 ) -> Tuple[List[Val], List[ColV], jax.Array]:
-    """Full groupby: sort by keys, segment, reduce.
+    """Full groupby via sort: sort by keys, segment, reduce.
 
     ``value_cols[i]`` is the (pre-cast) input for ``agg_ops[i]`` (None for
     count_star). Returns (group key columns, aggregate columns, num_groups);
@@ -165,11 +168,13 @@ def sort_groupby(
         if isinstance(key_cols[0], StrV)
         else key_cols[0].validity.shape[0]
     )
+    from .filter_gather import live_of
+
     orders = [SortOrder(True, True) for _ in key_cols]
     perm, radix = sort_with_radix_keys(
         key_cols, key_dtypes, orders, num_rows, str_max_lens
     )
-    live_in = jnp.arange(cap, dtype=jnp.int32) < num_rows
+    live_in = live_of(num_rows, cap)
     live = jnp.take(live_in, perm, mode="clip")
     sorted_keys = gather(key_cols, perm, live)
     sorted_vals: List[Optional[ColV]] = []
@@ -219,12 +224,217 @@ def reduce_no_keys(
     ) if any(v is not None for v in value_cols) else 0
     if cap == 0:
         # only count(*) over an implicit capacity — caller supplies rows
-        cnt = jnp.asarray(num_rows, jnp.int64).reshape(1)
+        if isinstance(num_rows, jax.Array) and num_rows.dtype == jnp.bool_:
+            cnt = jnp.sum(num_rows.astype(jnp.int64)).reshape(1)
+        else:
+            cnt = jnp.asarray(num_rows, jnp.int64).reshape(1)
         return [ColV(cnt, jnp.ones(1, jnp.bool_)) for _ in agg_ops]
-    live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+    from .filter_gather import live_of
+
+    live = live_of(num_rows, cap)
     seg = jnp.where(live, 0, 1)
     outs = []
     for op, v in zip(agg_ops, value_cols):
         r = segment_reduce(op, v, seg, 1, live)
         outs.append(r)
     return outs
+
+
+# ---------------------------------------------------------------------------
+# Hash-bucket groupby (TPU fast path)
+# ---------------------------------------------------------------------------
+def hash_groupby(
+    key_cols: Sequence[ColV],
+    key_dtypes: Sequence[T.DataType],
+    value_cols: Sequence[Optional[ColV]],
+    agg_ops: Sequence[str],
+    num_rows: Union[int, jax.Array],
+    num_buckets: int,
+    approx_float_sum: bool = False,
+) -> Tuple[List[ColV], List[ColV], jax.Array, jax.Array]:
+    """O(n) groupby: hash keys into static buckets, reduce on the MXU.
+
+    Sums/counts run as one-hot limb matmuls (ops/bucket_reduce.py — exact
+    for integers); min/max/first/last use scatter segment ops; float sums
+    use the scatter path unless ``approx_float_sum`` (order-insensitive
+    matmul, the reference's variableFloatAgg tradeoff). Correct only when
+    no two DISTINCT keys share a bucket — collision detection compares
+    every row's radix words against its bucket representative via exact
+    16-bit-limb table lookups, and the returned ``collision_free`` scalar
+    lets :func:`groupby_agg` fall back to the sort path.
+
+    Returns (out_keys, out_aggs, num_groups, collision_free); outputs are
+    bucket-compacted to the front at the input capacity.
+    """
+    from .bucket_reduce import bucket_equal_check, bucket_reduce
+    from .filter_gather import live_of
+    from .hashing import murmur3
+    from .sort import SortOrder, fixed_radix_keys
+
+    cap = key_cols[0].validity.shape[0]
+    B = num_buckets
+    live = live_of(num_rows, cap)
+    h = murmur3(list(key_cols), list(key_dtypes))
+    bucket = (h.astype(jnp.uint32) & jnp.uint32(B - 1)).astype(jnp.int32)
+    seg = jnp.where(live, bucket, B)  # out-of-range ids drop out everywhere
+    idx = jnp.arange(cap, dtype=jnp.int32)
+
+    # the single scatter op: representative (first live) row per bucket
+    first_row = jax.ops.segment_min(
+        jnp.where(live, idx, jnp.int32(cap)), seg, num_segments=B)
+    occupied = first_row < cap
+    rep_row = jnp.clip(first_row, 0, cap - 1)
+
+    # collision detection: each key contributes its radix value words; all
+    # null ranks pack into one word (2 bits each). Every live row must
+    # match its bucket representative on every word.
+    order = SortOrder(True, True)
+    words: List[jax.Array] = []
+    nullpack = jnp.zeros(cap, jnp.uint32)
+    for i, (c, dt) in enumerate(zip(key_cols, key_dtypes)):
+        null_rank, vk = fixed_radix_keys(c, dt, order)
+        nullpack = nullpack | (null_rank << (2 * (i % 16)))
+        if vk.dtype == jnp.uint64:
+            words.append((vk & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
+            words.append((vk >> 32).astype(jnp.uint32))
+        else:
+            words.append(vk.astype(jnp.uint32))
+    words.append(nullpack)
+    collision_free = jnp.bool_(True)
+    for w in words:
+        rep_table = jnp.where(
+            occupied, jnp.take(w, rep_row, mode="clip"), jnp.uint32(0))
+        collision_free = collision_free & bucket_equal_check(
+            seg, B, w, rep_table, live)
+
+    # partition the reductions between MXU and scatter paths
+    int_specs, cnt_specs, flt_specs = [], [], []
+    plan = []  # per agg: (path, payload)
+    cnt_index: dict = {}
+
+    def _want_count(valid_arr, key):
+        if key not in cnt_index:
+            cnt_index[key] = len(cnt_specs)
+            cnt_specs.append(valid_arr)
+        return cnt_index[key]
+
+    for ai, (op, v) in enumerate(zip(agg_ops, value_cols)):
+        if op == "count_star":
+            plan.append(("count", _want_count(live, ("star",))))
+        elif op == "count":
+            plan.append(("count", _want_count(v.validity & live, ("c", ai))))
+        elif op == "sum" and not jnp.issubdtype(v.data.dtype, jnp.floating):
+            ci = _want_count(v.validity & live, ("c", ai))
+            int_specs.append((v.data, v.validity & live))
+            plan.append(("isum", (len(int_specs) - 1, ci)))
+        elif op == "sum" and approx_float_sum:
+            ci = _want_count(v.validity & live, ("c", ai))
+            flt_specs.append((v.data, v.validity & live))
+            plan.append(("fsum", (len(flt_specs) - 1, ci, v.data.dtype)))
+        else:
+            plan.append(("scatter", (op, v)))
+
+    isums, counts, fsums = bucket_reduce(
+        seg, B, int_specs, cnt_specs, flt_specs)
+
+    ngroups = jnp.sum(occupied.astype(jnp.int32)).astype(jnp.int32)
+
+    # bucket-compaction: present buckets to the front, padded out to cap
+    csum = jnp.cumsum(occupied.astype(jnp.int32))
+    dest = jnp.where(occupied, csum - 1, cap)
+    bucket_of_slot = (
+        jnp.zeros(cap, jnp.int32).at[dest].set(
+            jnp.arange(B, dtype=jnp.int32), mode="drop")
+    )
+    slot_live = jnp.arange(cap, dtype=jnp.int32) < ngroups
+
+    def to_slots(arr, valid):
+        d = jnp.take(arr, bucket_of_slot, mode="clip")
+        vv = jnp.take(valid, bucket_of_slot, mode="clip") & slot_live
+        pad = max(0, cap - d.shape[0])
+        if pad:
+            d = jnp.concatenate([d, jnp.zeros(pad, d.dtype)])
+            vv = jnp.concatenate([vv, jnp.zeros(pad, jnp.bool_)])
+        return ColV(jnp.where(vv[:cap], d[:cap], jnp.zeros((), d.dtype)), vv[:cap])
+
+    rep_row_of_slot = jnp.take(rep_row, bucket_of_slot, mode="clip")
+    out_keys: List[ColV] = []
+    for c in key_cols:
+        kd = jnp.take(c.data, rep_row_of_slot, mode="clip")
+        kv = jnp.take(c.validity, rep_row_of_slot, mode="clip") & slot_live
+        out_keys.append(ColV(jnp.where(kv, kd, jnp.zeros((), kd.dtype)), kv))
+
+    out_aggs: List[ColV] = []
+    for (kind, payload), (op, v) in zip(plan, zip(agg_ops, value_cols)):
+        if kind == "count":
+            out_aggs.append(to_slots(counts[payload], jnp.ones(B, jnp.bool_)))
+        elif kind == "isum":
+            si, ci = payload
+            data = isums[si]
+            if v.data.dtype != jnp.int64:
+                data = data.astype(v.data.dtype)
+            out_aggs.append(to_slots(data, counts[ci] > 0))
+        elif kind == "fsum":
+            si, ci, dt = payload
+            out_aggs.append(to_slots(fsums[si].astype(dt), counts[ci] > 0))
+        else:
+            sop, sv = payload
+            r = segment_reduce(sop, sv, seg, B, live)
+            out_aggs.append(to_slots(r.data, r.validity))
+    return out_keys, out_aggs, ngroups, collision_free
+
+
+def groupby_agg(
+    key_cols: Sequence[Val],
+    key_dtypes: Sequence[T.DataType],
+    value_cols: Sequence[Optional[ColV]],
+    agg_ops: Sequence[str],
+    num_rows: Union[int, jax.Array],
+    str_max_lens: Sequence[int] = (),
+    approx_float_sum: bool = False,
+    num_buckets: int = 8192,
+) -> Tuple[List[Val], List[ColV], jax.Array]:
+    """Adaptive groupby: MXU hash-bucket fast path with a traced sort
+    fallback.
+
+    Reference analog: cudf's hash groupby with sort-groupby fallback for
+    unsupported cases (aggregate.scala:806). Here the choice is a runtime
+    ``lax.cond`` on the collision-free check, so low-cardinality aggregates
+    (the TPC-DS common case) never pay the bitonic sort.
+    String keys currently always take the sort path.
+    """
+    if not key_cols:
+        return sort_groupby(
+            key_cols, key_dtypes, value_cols, agg_ops, num_rows, str_max_lens)
+    if any(isinstance(c, StrV) for c in key_cols):
+        return sort_groupby(
+            key_cols, key_dtypes, value_cols, agg_ops, num_rows, str_max_lens)
+    cap = key_cols[0].validity.shape[0]
+    B = min(cap, num_buckets)
+    if B & (B - 1):  # non-power-of-two capacity: round down
+        B = 1 << (B.bit_length() - 1)
+
+    hk, ha, hn, ok = hash_groupby(
+        list(key_cols), key_dtypes, value_cols, agg_ops, num_rows, B,
+        approx_float_sum=approx_float_sum)
+
+    def use_hash(_):
+        return (
+            tuple((c.data, c.validity) for c in hk),
+            tuple((c.data, c.validity) for c in ha),
+            hn,
+        )
+
+    def use_sort(_):
+        sk, sa, sn = sort_groupby(
+            key_cols, key_dtypes, value_cols, agg_ops, num_rows, str_max_lens)
+        return (
+            tuple((c.data, c.validity) for c in sk),
+            tuple((c.data, c.validity) for c in sa),
+            sn,
+        )
+
+    keys_t, aggs_t, n = lax.cond(ok, use_hash, use_sort, operand=None)
+    out_keys = [ColV(d, v) for d, v in keys_t]
+    out_aggs = [ColV(d, v) for d, v in aggs_t]
+    return out_keys, out_aggs, n

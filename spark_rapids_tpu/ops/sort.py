@@ -157,7 +157,9 @@ def sort_with_radix_keys(
         if isinstance(key_cols[0], StrV)
         else key_cols[0].validity.shape[0]
     )
-    pad_rank = (jnp.arange(cap, dtype=jnp.int32) >= num_rows).astype(jnp.uint32)
+    from .filter_gather import live_of
+
+    pad_rank = (~live_of(num_rows, cap)).astype(jnp.uint32)
     operands: List[jax.Array] = [pad_rank]
     si = 0
     for colv, dtype, order in zip(key_cols, key_dtypes, orders):
