@@ -7,39 +7,30 @@ while the MXU multiplies 256x256 tiles for free. A bucket reduction
 XLA fuses the one-hot generation into the matmul so the (n, B) matrix never
 materializes.
 
-Exactness: f32 matmuls (precision=HIGHEST) are exact for addends < 2^24, so
-int64 values are split into 4x16-bit limbs and reduced in row-blocks of 256
-(block limb sum <= 256*65535 < 2^24), block partials then accumulate in
-int64 — bit-exact integer sums at matmul speed, including Java wraparound.
-Counts are a ones-limb. Doubles use a hi/lo float split (not bit-exact,
-order-insensitive — the reference gates float aggregation the same way:
-spark.rapids.sql.variableFloatAgg.enabled).
+Exactness: f32 matmuls (precision=HIGHEST) are exact for addends < 2^24.
+int64 values split into 8x8-bit limbs reduced in row-blocks of 65536
+(block limb sum <= 65536*255 < 2^24), block partials accumulate in int64 —
+bit-exact integer sums at matmul speed, including Java wraparound. The
+8-bit/65536-row shape keeps the per-block partial tensor (nblocks, L, B)
+tiny; 16-bit limbs would force 256-row blocks and a gigabyte-scale
+transient. Counts are a ones-limb. Doubles use a hi/lo float split (not
+bit-exact, order-insensitive — the reference gates float aggregation the
+same way: spark.rapids.sql.variableFloatAgg.enabled).
 
 Out-of-range segment ids (padding/dead rows) one-hot to a zero row and
 drop out of every reduction for free.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
-BLOCK_R = 256  # rows per block: 256 * (2^16 - 1) < 2^24 keeps f32 exact
-CHUNK_ROWS = 1 << 20  # super-chunk bound on the (nb, L, B) transient
+BLOCK_R = 1 << 16  # rows per block: 65536 * 255 < 2^24 keeps f32 exact
+N_LIMBS = 8  # 8-bit limbs per int64
 
 _HI = jax.lax.Precision.HIGHEST
-
-
-def _blocked(seg: jax.Array, cols: jax.Array, B: int):
-    """einsum over row-blocks: cols (n, L) f32 -> per-block sums (nb, L, B)."""
-    n = seg.shape[0]
-    R = min(BLOCK_R, n)
-    nb = n // R
-    oh_src = seg[: nb * R].reshape(nb, R)
-    c = cols[: nb * R].reshape(nb, R, -1)
-    oh = jax.nn.one_hot(oh_src, B, dtype=jnp.float32)
-    return jnp.einsum("brl,brB->blB", c, oh, precision=_HI)
 
 
 def bucket_reduce(
@@ -59,10 +50,14 @@ def bucket_reduce(
     n = seg.shape[0]
     limbs: List[jax.Array] = []
     for data, valid in int_cols:
-        u = data.astype(jnp.int64).astype(jnp.uint64)
-        u = jnp.where(valid, u, jnp.uint64(0))
-        for i in range(4):
-            limbs.append(((u >> (16 * i)) & jnp.uint64(0xFFFF)).astype(jnp.float32))
+        # split into u32 halves first: all limb math stays 32-bit (64-bit
+        # elementwise ops are emulated on TPU at ~2-4x cost)
+        halves = jax.lax.bitcast_convert_type(
+            data.astype(jnp.int64), jnp.uint32)  # (n, 2) little-endian
+        for half in (halves[..., 0], halves[..., 1]):
+            h = jnp.where(valid, half, jnp.uint32(0))
+            for i in range(4):
+                limbs.append(((h >> (8 * i)) & jnp.uint32(0xFF)).astype(jnp.float32))
     for valid in count_cols:
         limbs.append(valid.astype(jnp.float32))
     nf_start = len(limbs)
@@ -75,33 +70,31 @@ def bucket_reduce(
     if not limbs:
         return [], [], []
     cols = jnp.stack(limbs, axis=-1)  # (n, L)
-
-    # super-chunks bound the (nb, L, B) transient
     L = cols.shape[1]
-    acc_i = jnp.zeros((nf_start, B), jnp.int64)
-    acc_f = jnp.zeros((L - nf_start, B), jnp.float64)
-    for start in range(0, n, CHUNK_ROWS):
-        end = min(n, start + CHUNK_ROWS)
-        S = _blocked(seg[start:end], cols[start:end], B)  # (nb, L, B) f32
-        acc_i = acc_i + S[:, :nf_start, :].astype(jnp.int64).sum(axis=0)
-        acc_f = acc_f + S[:, nf_start:, :].astype(jnp.float64).sum(axis=0)
-    # tail rows not covered by full blocks
+
     R = min(BLOCK_R, n)
-    tail = n - (n // R) * R
+    nb = n // R
+    S_parts = []
+    if nb:
+        oh_src = seg[: nb * R].reshape(nb, R)
+        c = cols[: nb * R].reshape(nb, R, L)
+        oh = jax.nn.one_hot(oh_src, B, dtype=jnp.float32)
+        S_parts.append(jnp.einsum("brl,brB->blB", c, oh, precision=_HI))
+    tail = n - nb * R
     if tail:
-        tseg = seg[n - tail:]
-        tcols = cols[n - tail:]
-        oh = jax.nn.one_hot(tseg, B, dtype=jnp.float32)
-        S = jnp.einsum("rl,rB->lB", tcols, oh, precision=_HI)
-        acc_i = acc_i + S[:nf_start].astype(jnp.int64)
-        acc_f = acc_f + S[nf_start:].astype(jnp.float64)
+        oh_t = jax.nn.one_hot(seg[nb * R:], B, dtype=jnp.float32)
+        St = jnp.einsum("rl,rB->lB", cols[nb * R:], oh_t, precision=_HI)
+        S_parts.append(St[None])
+    S = jnp.concatenate(S_parts, axis=0) if len(S_parts) > 1 else S_parts[0]
+    acc_i = S[:, :nf_start, :].astype(jnp.int64).sum(axis=0)  # exact
+    acc_f = S[:, nf_start:, :].astype(jnp.float64).sum(axis=0)
 
     out_int: List[jax.Array] = []
     k = 0
     for _ in int_cols:
         total = jnp.zeros(B, jnp.uint64)
-        for i in range(4):
-            total = total + (acc_i[k].astype(jnp.uint64) << (16 * i))
+        for i in range(N_LIMBS):
+            total = total + (acc_i[k].astype(jnp.uint64) << (8 * i))
             k += 1
         out_int.append(total.astype(jnp.int64))
     out_cnt: List[jax.Array] = []
@@ -126,16 +119,19 @@ def bucket_lookup_u32(
     lo = (table & jnp.uint32(0xFFFF)).astype(jnp.float32)
     hi = (table >> 16).astype(jnp.float32)
     t2 = jnp.stack([lo, hi], axis=-1)  # (B, 2)
-    R = min(BLOCK_R, n)
+    R = min(4096, n)
     nb = n // R
-    head = seg[: nb * R].reshape(nb, R)
-    oh = jax.nn.one_hot(head, B, dtype=jnp.float32)
-    vals = jnp.einsum("brB,Bt->brt", oh, t2, precision=_HI).reshape(nb * R, 2)
+    parts = []
+    if nb:
+        head = seg[: nb * R].reshape(nb, R)
+        oh = jax.nn.one_hot(head, B, dtype=jnp.float32)
+        parts.append(
+            jnp.einsum("brB,Bt->brt", oh, t2, precision=_HI).reshape(nb * R, 2))
     tail = n - nb * R
     if tail:
         oh_t = jax.nn.one_hot(seg[nb * R:], B, dtype=jnp.float32)
-        vt = jnp.einsum("rB,Bt->rt", oh_t, t2, precision=_HI)
-        vals = jnp.concatenate([vals, vt], axis=0)
+        parts.append(jnp.einsum("rB,Bt->rt", oh_t, t2, precision=_HI))
+    vals = jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
     return vals[:, 0], vals[:, 1]
 
 

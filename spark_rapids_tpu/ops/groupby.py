@@ -252,16 +252,22 @@ def hash_groupby(
     num_buckets: int,
     approx_float_sum: bool = False,
 ) -> Tuple[List[ColV], List[ColV], jax.Array, jax.Array]:
-    """O(n) groupby: hash keys into static buckets, reduce on the MXU.
+    """O(n) groupby: bucket keys, reduce on the MXU.
+
+    Bucketing tiers:
+      1. direct-range: when every key's value range is dense enough that
+         the composed (value - min) index fits ``num_buckets`` — the
+         TPC-DS dim-key/date case — buckets are injective BY CONSTRUCTION:
+         no hash, no collision check, and group keys are reconstructed
+         algebraically from the bucket id (zero scatter ops).
+      2. murmur3 + exact collision detection (limb-matmul lookups against
+         each bucket's representative row); a collision makes
+         :func:`groupby_agg` fall back to the sort path.
 
     Sums/counts run as one-hot limb matmuls (ops/bucket_reduce.py — exact
     for integers); min/max/first/last use scatter segment ops; float sums
-    use the scatter path unless ``approx_float_sum`` (order-insensitive
-    matmul, the reference's variableFloatAgg tradeoff). Correct only when
-    no two DISTINCT keys share a bucket — collision detection compares
-    every row's radix words against its bucket representative via exact
-    16-bit-limb table lookups, and the returned ``collision_free`` scalar
-    lets :func:`groupby_agg` fall back to the sort path.
+    use one scatter op unless ``approx_float_sum`` (order-insensitive
+    matmul, the reference's variableFloatAgg tradeoff).
 
     Returns (out_keys, out_aggs, num_groups, collision_free); outputs are
     bucket-compacted to the front at the input capacity.
@@ -274,40 +280,55 @@ def hash_groupby(
     cap = key_cols[0].validity.shape[0]
     B = num_buckets
     live = live_of(num_rows, cap)
-    h = murmur3(list(key_cols), list(key_dtypes))
-    bucket = (h.astype(jnp.uint32) & jnp.uint32(B - 1)).astype(jnp.int32)
-    seg = jnp.where(live, bucket, B)  # out-of-range ids drop out everywhere
     idx = jnp.arange(cap, dtype=jnp.int32)
+    any_live = jnp.any(live)
 
-    # the single scatter op: representative (first live) row per bucket
-    first_row = jax.ops.segment_min(
-        jnp.where(live, idx, jnp.int32(cap)), seg, num_segments=B)
-    occupied = first_row < cap
-    rep_row = jnp.clip(first_row, 0, cap - 1)
+    # --- tier 1: direct-range binning -----------------------------------
+    direct_capable = all(not dt.is_floating for dt in key_dtypes)
+    mns, spans, strides = [], [], []
+    if direct_capable:
+        direct_ok = any_live
+        stride = jnp.int64(1)
+        bucket_direct = jnp.zeros(cap, jnp.int64)
+        for c, dt in zip(key_cols, key_dtypes):
+            d = c.data.astype(jnp.int64)
+            lv = live & c.validity
+            has_val = jnp.any(lv)
+            mn = jnp.where(has_val, jnp.min(jnp.where(lv, d, jnp.int64(2**62))), 0)
+            mx = jnp.where(has_val, jnp.max(jnp.where(lv, d, jnp.int64(-(2**62)))), -1)
+            # exact range via u64 (no overflow even at int64 extremes)
+            ru = mx.astype(jnp.uint64) - mn.astype(jnp.uint64)
+            span = jnp.where(
+                ru < jnp.uint64(B), ru.astype(jnp.int64) + 2, jnp.int64(B + 1))
+            kidx = jnp.where(
+                c.validity,
+                (d.astype(jnp.uint64) - mn.astype(jnp.uint64)).astype(jnp.int64) + 1,
+                0,
+            )
+            bucket_direct = bucket_direct + kidx * stride
+            mns.append(mn)
+            spans.append(span)
+            strides.append(stride)
+            stride = stride * span
+            direct_ok = direct_ok & (stride <= jnp.int64(B))
+        bucket_direct = jnp.clip(bucket_direct, 0, B - 1).astype(jnp.int32)
+    else:
+        direct_ok = jnp.bool_(False)
+        bucket_direct = jnp.zeros(cap, jnp.int32)
 
-    # collision detection: each key contributes its radix value words; all
-    # null ranks pack into one word (2 bits each). Every live row must
-    # match its bucket representative on every word.
-    order = SortOrder(True, True)
-    words: List[jax.Array] = []
-    nullpack = jnp.zeros(cap, jnp.uint32)
-    for i, (c, dt) in enumerate(zip(key_cols, key_dtypes)):
-        null_rank, vk = fixed_radix_keys(c, dt, order)
-        nullpack = nullpack | (null_rank << (2 * (i % 16)))
-        if vk.dtype == jnp.uint64:
-            words.append((vk & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
-            words.append((vk >> 32).astype(jnp.uint32))
-        else:
-            words.append(vk.astype(jnp.uint32))
-    words.append(nullpack)
-    collision_free = jnp.bool_(True)
-    for w in words:
-        rep_table = jnp.where(
-            occupied, jnp.take(w, rep_row, mode="clip"), jnp.uint32(0))
-        collision_free = collision_free & bucket_equal_check(
-            seg, B, w, rep_table, live)
+    # --- tier 2: murmur3 buckets (computed only when tier 1 declines) ----
+    def _hash_buckets(_):
+        h = murmur3(list(key_cols), list(key_dtypes))
+        return (h.astype(jnp.uint32) & jnp.uint32(B - 1)).astype(jnp.int32)
 
-    # partition the reductions between MXU and scatter paths
+    if direct_capable:
+        bucket = lax.cond(
+            direct_ok, lambda _: bucket_direct, _hash_buckets, operand=None)
+    else:
+        bucket = _hash_buckets(None)
+    seg = jnp.where(live, bucket, B)  # out-of-range ids drop out everywhere
+
+    # --- reductions (all sums/counts in ONE matmul pass) ----------------
     int_specs, cnt_specs, flt_specs = [], [], []
     plan = []  # per agg: (path, payload)
     cnt_index: dict = {}
@@ -318,9 +339,10 @@ def hash_groupby(
             cnt_specs.append(valid_arr)
         return cnt_index[key]
 
+    live_count_i = _want_count(live, ("star",))  # also drives `occupied`
     for ai, (op, v) in enumerate(zip(agg_ops, value_cols)):
         if op == "count_star":
-            plan.append(("count", _want_count(live, ("star",))))
+            plan.append(("count", live_count_i))
         elif op == "count":
             plan.append(("count", _want_count(v.validity & live, ("c", ai))))
         elif op == "sum" and not jnp.issubdtype(v.data.dtype, jnp.floating):
@@ -331,38 +353,88 @@ def hash_groupby(
             ci = _want_count(v.validity & live, ("c", ai))
             flt_specs.append((v.data, v.validity & live))
             plan.append(("fsum", (len(flt_specs) - 1, ci, v.data.dtype)))
+        elif op == "sum":
+            # exact float sum: one scatter op; nullability via matmul count
+            ci = _want_count(v.validity & live, ("c", ai))
+            plan.append(("fsum_exact", (v, ci)))
         else:
             plan.append(("scatter", (op, v)))
 
     isums, counts, fsums = bucket_reduce(
         seg, B, int_specs, cnt_specs, flt_specs)
-
+    occupied = counts[live_count_i] > 0
     ngroups = jnp.sum(occupied.astype(jnp.int32)).astype(jnp.int32)
 
-    # bucket-compaction: present buckets to the front, padded out to cap
+    # --- group keys + collision status (branch on tier) -----------------
+    bucket_ids = jnp.arange(B, dtype=jnp.int64)
+
+    def _direct_branch(_):
+        keys_out = []
+        for (c, dt), mn, span, stride in zip(
+            zip(key_cols, key_dtypes), mns, spans, strides
+        ):
+            kidx = (bucket_ids // stride) % span  # 0 = null slot
+            val = (mn + kidx - 1).astype(c.data.dtype)
+            valid = (kidx > 0) & occupied
+            keys_out.append((jnp.where(valid, val, jnp.zeros((), val.dtype)), valid))
+        return tuple(keys_out), jnp.bool_(True)
+
+    def _hash_branch(_):
+        first_row = jax.ops.segment_min(
+            jnp.where(live, idx, jnp.int32(cap)), seg, num_segments=B)
+        rep_row = jnp.clip(first_row, 0, cap - 1)
+        order = SortOrder(True, True)
+        words: List[jax.Array] = []
+        nullpack = jnp.zeros(cap, jnp.uint32)
+        for i, (c, dt) in enumerate(zip(key_cols, key_dtypes)):
+            null_rank, vk = fixed_radix_keys(c, dt, order)
+            nullpack = nullpack | (null_rank << (2 * (i % 16)))
+            if vk.dtype == jnp.uint64:
+                words.append((vk & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
+                words.append((vk >> 32).astype(jnp.uint32))
+            else:
+                words.append(vk.astype(jnp.uint32))
+        words.append(nullpack)
+        ok = jnp.bool_(True)
+        for w in words:
+            rep_table = jnp.where(
+                occupied, jnp.take(w, rep_row, mode="clip"), jnp.uint32(0))
+            ok = ok & bucket_equal_check(seg, B, w, rep_table, live)
+        keys_out = []
+        for c in key_cols:
+            kd = jnp.take(c.data, rep_row, mode="clip")
+            kv = jnp.take(c.validity, rep_row, mode="clip") & occupied
+            keys_out.append((jnp.where(kv, kd, jnp.zeros((), kd.dtype)), kv))
+        return tuple(keys_out), ok
+
+    if direct_capable:
+        key_tables, collision_free = lax.cond(
+            direct_ok, _direct_branch, _hash_branch, operand=None)
+    else:
+        key_tables, collision_free = _hash_branch(None)
+
+    # --- bucket-compaction: present buckets to the front ----------------
+    # All slot work happens at size B (tiny); outputs pad up to the input
+    # capacity with plain copies — gathers at cap-size would cost ~100x.
     csum = jnp.cumsum(occupied.astype(jnp.int32))
-    dest = jnp.where(occupied, csum - 1, cap)
+    dest = jnp.where(occupied, csum - 1, B)
     bucket_of_slot = (
-        jnp.zeros(cap, jnp.int32).at[dest].set(
+        jnp.zeros(B, jnp.int32).at[dest].set(
             jnp.arange(B, dtype=jnp.int32), mode="drop")
     )
-    slot_live = jnp.arange(cap, dtype=jnp.int32) < ngroups
+    slot_live = jnp.arange(B, dtype=jnp.int32) < ngroups
+    pad = cap - B
 
     def to_slots(arr, valid):
         d = jnp.take(arr, bucket_of_slot, mode="clip")
         vv = jnp.take(valid, bucket_of_slot, mode="clip") & slot_live
-        pad = max(0, cap - d.shape[0])
-        if pad:
+        d = jnp.where(vv, d, jnp.zeros((), d.dtype))
+        if pad > 0:
             d = jnp.concatenate([d, jnp.zeros(pad, d.dtype)])
             vv = jnp.concatenate([vv, jnp.zeros(pad, jnp.bool_)])
-        return ColV(jnp.where(vv[:cap], d[:cap], jnp.zeros((), d.dtype)), vv[:cap])
+        return ColV(d, vv)
 
-    rep_row_of_slot = jnp.take(rep_row, bucket_of_slot, mode="clip")
-    out_keys: List[ColV] = []
-    for c in key_cols:
-        kd = jnp.take(c.data, rep_row_of_slot, mode="clip")
-        kv = jnp.take(c.validity, rep_row_of_slot, mode="clip") & slot_live
-        out_keys.append(ColV(jnp.where(kv, kd, jnp.zeros((), kd.dtype)), kv))
+    out_keys: List[ColV] = [to_slots(kd, kv) for kd, kv in key_tables]
 
     out_aggs: List[ColV] = []
     for (kind, payload), (op, v) in zip(plan, zip(agg_ops, value_cols)):
@@ -377,6 +449,12 @@ def hash_groupby(
         elif kind == "fsum":
             si, ci, dt = payload
             out_aggs.append(to_slots(fsums[si].astype(dt), counts[ci] > 0))
+        elif kind == "fsum_exact":
+            sv, ci = payload
+            z = jnp.zeros((), sv.data.dtype)
+            sm = jax.ops.segment_sum(
+                jnp.where(sv.validity & live, sv.data, z), seg, num_segments=B)
+            out_aggs.append(to_slots(sm, counts[ci] > 0))
         else:
             sop, sv = payload
             r = segment_reduce(sop, sv, seg, B, live)

@@ -24,6 +24,7 @@ from .. import types as T
 from ..conf import (
     DECIMAL_ENABLED,
     EXPLAIN,
+    IMPROVED_FLOAT_OPS,
     RapidsConf,
     SQL_ENABLED,
     TEST_ALLOWED_NONTPU,
@@ -204,8 +205,18 @@ def check_aggregate(
             )
         else:
             reasons.extend(check_expression(f.child, schema, conf))
-        if isinstance(f, (A.Sum, A.Average)) and isinstance(dt, (T.StringType, T.BinaryType)):
-            reasons.append("sum/avg require numeric input")
+        if (
+            isinstance(f, (A.Sum, A.Average))
+            and dt.is_floating
+            and not conf.get(IMPROVED_FLOAT_OPS)
+        ):
+            # same default as the reference: floating-point aggregation is
+            # order-dependent, so it stays on CPU unless the user opts in
+            # (RapidsConf.scala variableFloatAgg gate)
+            reasons.append(
+                "floating-point sum/average can differ from CPU results; set "
+                "spark.rapids.tpu.sql.variableFloatAgg.enabled=true to enable"
+            )
     return reasons
 
 

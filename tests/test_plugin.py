@@ -18,6 +18,10 @@ from harness import assert_fallback, assert_tpu_and_cpu_equal
 
 SCHEMA = schema_of(k=T.INT, a=T.LONG, b=T.DOUBLE, s=T.STRING)
 
+# floating-point aggregation is CPU-only by default (reference parity);
+# differential tests opt in and compare approximately
+FLOAT_AGG_CONF = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True}
+
 
 def _data(n=500):
     return {
@@ -74,6 +78,7 @@ class TestDifferential:
                 A.agg(A.Average(col("b")), "avg"),
                 A.agg(A.Count(), "n"),
             ),
+            conf=FLOAT_AGG_CONF,
             approx_float=True,
         )
 
@@ -105,7 +110,7 @@ class TestDifferential:
                 .agg(A.agg(A.Sum(col("a2")), "s"), A.agg(A.Average(col("b")), "m"))
             )
 
-        assert_tpu_and_cpu_equal(build, approx_float=True)
+        assert_tpu_and_cpu_equal(build, conf=FLOAT_AGG_CONF, approx_float=True)
 
     def test_union_limit(self):
         def build(s):
