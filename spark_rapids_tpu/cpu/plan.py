@@ -557,3 +557,129 @@ class CpuJoinExec(CpuExec):
             for ri, rrow in enumerate(right_rows):
                 if not right_matched[ri]:
                     yield (None,) * nl + rrow
+
+
+# ---------------------------------------------------------------------------
+# Window (whole-input, python oracle)
+# ---------------------------------------------------------------------------
+class CpuWindowExec(CpuExec):
+    def __init__(self, conf: RapidsConf, window_exprs, child: CpuExec):
+        super().__init__(conf, [child])
+        from ..expr import windows as W
+
+        self.window_exprs = list(window_exprs)
+        self.spec = self.window_exprs[0].spec
+        cs = child.output_schema
+        self._part = [E.bind_references(k, cs) for k in self.spec.partition_by]
+        self._order = [E.bind_references(k, cs) for k in self.spec.order_by]
+        self._orders = list(self.spec.orders) or [(True, None)] * len(self._order)
+        import dataclasses as _dc
+
+        self._funcs = []
+        fields = list(cs.fields)
+        for we in self.window_exprs:
+            f = we.func
+            if getattr(f, "child", None) is not None:
+                f = _dc.replace(f, child=E.bind_references(f.child, cs))
+            self._funcs.append(f)
+            fields.append(StructField(we.resolved_name(), f.dtype, True))
+        self._schema = StructType(tuple(fields))
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    @property
+    def num_partitions(self):
+        return 1
+
+    def execute_rows_partition(self, index: int) -> Iterator[tuple]:
+        from ..expr import windows as W
+
+        rows = []
+        for p in range(self.children[0].num_partitions):
+            rows.extend(self.children[0].execute_rows_partition(p))
+
+        def keyfn(row):
+            out = [
+                _SparkOrderKey(eval_row(b, row), True, True) for b in self._part
+            ]
+            for b, (asc, nf) in zip(self._order, self._orders):
+                out.append(_SparkOrderKey(eval_row(b, row), asc, asc if nf is None else nf))
+            return tuple(out)
+
+        rows = sorted(rows, key=keyfn)
+
+        def part_key(row):
+            return tuple(_group_key_part(eval_row(b, row)) for b in self._part)
+
+        def order_key(row):
+            return tuple(_group_key_part(eval_row(b, row)) for b in self._order)
+
+        frame = self.spec.resolved_frame()
+        whole = frame.is_whole_partition or not self._order
+        range_frame = frame.frame_type == W.RANGE
+
+        # group into partitions
+        partitions: List[List[tuple]] = []
+        cur_key = object()
+        for row in rows:
+            k = part_key(row)
+            if not partitions or k != cur_key:
+                partitions.append([])
+                cur_key = k
+            partitions[-1].append(row)
+
+        for part in partitions:
+            n = len(part)
+            okeys = [order_key(r) for r in part]
+            for i, row in enumerate(part):
+                extra = []
+                for f in self._funcs:
+                    extra.append(self._eval_func(
+                        f, part, okeys, i, whole, range_frame))
+                yield row + tuple(extra)
+
+    def _frame_rows(self, part, okeys, i, whole, range_frame):
+        if whole:
+            return range(len(part))
+        if range_frame:
+            end = i
+            while end + 1 < len(part) and okeys[end + 1] == okeys[i]:
+                end += 1
+            return range(0, end + 1)
+        return range(0, i + 1)
+
+    def _eval_func(self, f, part, okeys, i, whole, range_frame):
+        from ..expr import windows as W
+
+        if isinstance(f, W.RowNumber):
+            return i + 1
+        if isinstance(f, W.Rank):
+            j = i
+            while j > 0 and okeys[j - 1] == okeys[i]:
+                j -= 1
+            return j + 1
+        if isinstance(f, W.DenseRank):
+            seen = 1
+            for j in range(1, i + 1):
+                if okeys[j] != okeys[j - 1]:
+                    seen += 1
+            return seen
+        if isinstance(f, (W.Lead, W.Lag)):
+            off = f.offset if isinstance(f, W.Lead) else -f.offset
+            t = i + off
+            if 0 <= t < len(part):
+                return eval_row(f.child, part[t])
+            if f.default is not None:
+                return eval_row(f.default, part[i])
+            return None
+        # aggregate over the frame
+        st_kind = _KIND_OF[type(f)]
+        if st_kind == "count" and f.input is None:
+            st_kind = "count_star"
+        st = _AggState(st_kind, getattr(f, "ignore_nulls", False))
+        for j in self._frame_rows(part, okeys, i, whole, range_frame):
+            v = eval_row(f.child, part[j]) if f.input is not None else None
+            st.update(v)
+        return st.result(f.dtype)

@@ -18,3 +18,9 @@ from .basic import (  # noqa: F401
     InMemoryScanExec,
 )
 from .aggregate import TpuHashAggregateExec  # noqa: F401
+from .join import (  # noqa: F401
+    TpuBroadcastNestedLoopJoinExec,
+    TpuShuffledHashJoinExec,
+)
+from .sort import TpuSortExec  # noqa: F401
+from .window import TpuWindowExec  # noqa: F401

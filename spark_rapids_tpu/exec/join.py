@@ -1,0 +1,429 @@
+"""Equi-join execs (hash-join family on TPU).
+
+Reference analog: GpuHashJoin.doJoin (execution/GpuHashJoin.scala:158-263) —
+build-side table concat + per-stream-batch cudf join; join types inner/left/
+right/full/semi/anti (doJoinLeftRight :265). TPU re-design: the build side
+is concatenated and radix-SORTED once (ops/join.py), each probe batch runs a
+fused count+expand program, and the only host syncs are the build size and
+one match-total per probe batch (cudf syncs output sizes at the same
+boundaries).
+
+Right joins run as left joins with the sides swapped and the output columns
+re-permuted, like the reference's buildSide handling.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..columnar import ColumnarBatch
+from ..conf import RapidsConf
+from ..expr import expressions as E
+from ..expr.eval import ColV, StrV, Val, lower
+from ..ops import concat as concat_ops
+from ..ops import filter_gather
+from ..ops import join as join_ops
+from ..ops.sort import max_string_len, sort_with_radix_keys, SortOrder
+from ..types import StructField, StructType
+from ..utils.bucketing import bucket_rows
+from .base import (
+    NUM_OUTPUT_BATCHES,
+    TOTAL_TIME,
+    TpuExec,
+    batch_from_vals,
+    batch_signature,
+    count_scalar,
+    timed,
+    vals_of_batch,
+)
+
+_JOIN_TYPES = ("inner", "left", "right", "full", "semi", "anti", "cross")
+
+
+def _concat_all(conf, exec_: TpuExec) -> Optional[ColumnarBatch]:
+    """Materialize every partition of an exec into ONE batch (build side)."""
+    batches: List[ColumnarBatch] = []
+    for p in range(exec_.num_partitions):
+        for b in exec_.execute_partition(p):
+            if b.num_rows > 0:
+                batches.append(b)
+    if not batches:
+        return None
+    if len(batches) == 1:
+        return batches[0]
+    schema = exec_.output_schema
+    lengths = [b.num_rows for b in batches]
+    str_cols = [
+        j for j, f in enumerate(schema.fields)
+        if isinstance(f.dataType, (T.StringType, T.BinaryType))
+    ]
+    byte_lengths = [
+        [int(b.columns[j].offsets[b.num_rows]) for j in str_cols]
+        for b in batches
+    ]
+    out_cap = bucket_rows(sum(lengths))
+    out_char_caps = [
+        bucket_rows(max(1, sum(bl[k] for bl in byte_lengths)), 128)
+        for k in range(len(str_cols))
+    ]
+    cols, n = concat_ops.concat_batches_cols(
+        [vals_of_batch(b) for b in batches], lengths, byte_lengths,
+        out_cap, out_char_caps,
+    )
+    return batch_from_vals(cols, schema, n)
+
+
+class TpuShuffledHashJoinExec(TpuExec):
+    """Build right side once, stream probe batches from the left.
+
+    Handles inner/left/right/full/semi/anti equi-joins plus an optional
+    residual condition on inner joins (reference: GpuShuffledHashJoinBase +
+    GpuHashJoin condition handling)."""
+
+    def __init__(
+        self,
+        conf: RapidsConf,
+        left: TpuExec,
+        right: TpuExec,
+        left_keys: Sequence[E.Expression],
+        right_keys: Sequence[E.Expression],
+        join_type: str = "inner",
+        condition: Optional[E.Expression] = None,
+    ):
+        super().__init__(conf, [left, right])
+        if join_type not in _JOIN_TYPES:
+            raise ValueError(f"unknown join type {join_type}")
+        self.join_type = join_type
+        self.condition = condition
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        # right joins: swap sides, permute output columns back at the end
+        self._swap = join_type == "right"
+        self._probe = right if self._swap else left
+        self._build = left if self._swap else right
+        self._probe_keys = [
+            E.bind_references(k, self._probe.output_schema)
+            for k in (right_keys if self._swap else left_keys)
+        ]
+        self._build_keys = [
+            E.bind_references(k, self._build.output_schema)
+            for k in (left_keys if self._swap else right_keys)
+        ]
+        self._jt = "left" if self._swap else join_type
+
+        lf = left.output_schema.fields
+        rf = right.output_schema.fields
+        if join_type in ("semi", "anti"):
+            self._schema = StructType(tuple(lf))
+        else:
+            nl = join_type in ("right", "full")
+            nr = join_type in ("left", "full")
+            self._schema = StructType(tuple(
+                [StructField(f.name, f.dataType, f.nullable or nl) for f in lf]
+                + [StructField(f.name, f.dataType, f.nullable or nr) for f in rf]
+            ))
+        if condition is not None:
+            if join_type != "inner":
+                raise ValueError(
+                    "residual join conditions only supported for inner joins")
+            comb = StructType(tuple(lf) + tuple(rf))
+            self._cond = E.bind_references(condition, comb)
+        else:
+            self._cond = None
+        self._built = None  # lazy build-side state
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    @property
+    def num_partitions(self):
+        # full outer needs a global unmatched-build pass: single partition
+        if self.join_type == "full":
+            return 1
+        return self._probe.num_partitions
+
+    def describe(self):
+        return f"TpuShuffledHashJoinExec({self.join_type})"
+
+    # -- build side --------------------------------------------------------
+    def _key_str_lens(self, batch, keys) -> Tuple[int, ...]:
+        lens = []
+        for k in keys:
+            if isinstance(k.dtype, (T.StringType, T.BinaryType)):
+                if isinstance(k, E.BoundReference):
+                    c = batch.columns[k.ordinal]
+                    m = int(max_string_len(StrV(c.offsets, c.chars, c.validity)))
+                else:
+                    m = 64
+                lens.append(max(4, bucket_rows(max(1, m), 4)))
+        return tuple(lens)
+
+    def _get_build(self):
+        if self._built is not None:
+            return self._built
+        batch = _concat_all(self.conf, self._build)
+        if batch is None:
+            bschema = self._build.output_schema
+            batch = ColumnarBatch.from_pydict(
+                {f.name: [] for f in bschema.fields}, bschema)
+        cap = batch.capacity if batch.columns else 128
+        n = batch.num_rows
+        sml = self._key_str_lens(batch, self._build_keys)
+
+        def prep(cols, num_rows):
+            live = filter_gather.live_of(num_rows, cap)
+            keys = [lower(k, cols, cap) for k in self._build_keys]
+            words, any_null = join_ops.radix_key_words(
+                keys, [k.dtype for k in self._build_keys], sml)
+            ok = live & ~any_null
+            # sort build rows: joinable rows first, then live null-key rows
+            # (they can never match, but full outer must still emit them),
+            # dead padding last
+            order_rank = jnp.where(ok, 0, jnp.where(live, 1, 2))
+            perm, sorted_radix = sort_with_radix_keys(
+                keys, [k.dtype for k in self._build_keys],
+                [SortOrder(True, True) for _ in keys],
+                order_rank == 0, sml)
+            live_all = jnp.take(live, perm, mode="clip")
+            sorted_cols = filter_gather.gather(cols, perm, live_all)
+            sorted_words = [jnp.take(w, perm, mode="clip") for w in words]
+            count = jnp.sum(ok.astype(jnp.int32))
+            return sorted_cols, sorted_words, count, live_all
+
+        fn = jax.jit(prep)
+        sorted_cols, sorted_words, count, live_all = fn(
+            vals_of_batch(batch), count_scalar(n))
+        self._built = (
+            batch, sorted_cols, sorted_words, int(count), cap, sml, live_all)
+        return self._built
+
+    # -- probe -------------------------------------------------------------
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        (build_batch, build_cols, build_words, build_count, build_cap, bsml,
+         build_live_all) = self._get_build()
+        build_schema = self._build.output_schema
+        matched_any = (
+            jnp.zeros(build_cap, jnp.bool_) if self.join_type == "full" else None
+        )
+        probe_parts = (
+            range(self._probe.num_partitions)
+            if self.join_type == "full"
+            else [index]
+        )
+        for pi in probe_parts:
+            for pbatch in self._probe.execute_partition(pi):
+                out = self._probe_batch(
+                    pbatch, build_cols, build_words, build_count, build_cap)
+                if out is None:
+                    continue
+                batch, matched = out
+                if matched is not None and matched_any is not None:
+                    matched_any = matched_any | matched
+                if batch is not None and batch.num_rows > 0:
+                    yield self.record_batch(batch)
+        if self.join_type == "full":
+            yield from self._unmatched_build(
+                build_cols, build_live_all, matched_any)
+
+    def _probe_batch(self, pbatch, build_cols, build_words, build_count, build_cap):
+        cap = pbatch.capacity if pbatch.columns else 128
+        psml = self._key_str_lens(pbatch, self._probe_keys)
+        jt = self._jt
+
+        def count_phase(cols, num_rows):
+            live = filter_gather.live_of(num_rows, cap)
+            keys = [lower(k, cols, cap) for k in self._probe_keys]
+            words, any_null = join_ops.radix_key_words(
+                keys, [k.dtype for k in self._probe_keys], psml)
+            ok = live & ~any_null
+            lo, hi = join_ops.probe_ranges(
+                build_words, jnp.int32(build_count), words, ok)
+            counts = hi - lo
+            if jt in ("semi", "anti"):
+                keep = (counts > 0) if jt == "semi" else (live & (counts == 0))
+                if jt == "semi":
+                    keep = keep & ok
+                return lo, counts, keep, live
+            if jt in ("left", "full"):
+                ex_counts = jnp.where(live & (counts == 0), 1, counts)
+                ex_counts = jnp.where(live, ex_counts, 0)
+            else:  # inner probe side
+                ex_counts = jnp.where(live, counts, 0)
+            return lo, counts, ex_counts, live
+
+        ckey = ("count", batch_signature(pbatch), cap, psml)
+        fn = self._jit_cache_get(ckey, count_phase)
+        lo, counts, aux, live = fn(
+            vals_of_batch(pbatch), count_scalar(pbatch.num_rows_lazy))
+
+        matched = None
+        if self.join_type == "full":
+            matched = join_ops.matched_build_mask(lo, lo + counts, live, build_cap)
+
+        if jt in ("semi", "anti"):
+            vals, count = filter_gather.filter_cols(
+                vals_of_batch(pbatch), aux, pbatch.num_rows_lazy)
+            return batch_from_vals(vals, self._schema, count), matched
+
+        total = int(jnp.sum(aux))
+        if total == 0:
+            return None, matched
+        out_cap = bucket_rows(total, self.conf.shape_bucket_min)
+        p, build_row, slot_live = join_ops.expansion_plan(aux, lo, out_cap)
+        # rows with zero real matches (left join padding) read "no build row"
+        pad_slot = slot_live & (jnp.take(counts, p, mode="clip") == 0)
+        build_live = slot_live & ~pad_slot
+
+        def str_caps(cols, rows, live_mask):
+            caps = []
+            for c in cols:
+                if isinstance(c, StrV):
+                    lens = c.offsets[1:] - c.offsets[:-1]
+                    need = jnp.sum(jnp.where(
+                        live_mask, jnp.take(lens, rows, mode="clip"), 0))
+                    caps.append(bucket_rows(max(1, int(need)), 128))
+            return caps
+
+        probe_side = filter_gather.gather(
+            vals_of_batch(pbatch), p, slot_live,
+            str_caps(vals_of_batch(pbatch), p, slot_live))
+        build_side = filter_gather.gather(
+            build_cols, build_row, build_live,
+            str_caps(build_cols, build_row, build_live))
+        left_side, right_side = (
+            (build_side, probe_side) if self._swap else (probe_side, build_side)
+        )
+        vals = list(left_side) + list(right_side)
+        out = batch_from_vals(vals, self._schema, total)
+        if self._cond is not None:
+            ocap = out.capacity
+
+            def apply_cond(cols, num_rows):
+                livec = filter_gather.live_of(num_rows, ocap)
+                c = lower(self._cond, cols, ocap)
+                mask = livec & c.data & c.validity
+                return filter_gather.filter_cols(cols, mask, num_rows)
+
+            fnc = self._jit_cache_get(
+                ("cond", batch_signature(out), ocap), apply_cond)
+            vals2, cnt = fnc(
+                vals_of_batch(out), count_scalar(out.num_rows_lazy))
+            out = batch_from_vals(vals2, self._schema, cnt)
+        return out, matched
+
+    def _jit_cache_get(self, key, fn):
+        cache = getattr(self, "_jits", None)
+        if cache is None:
+            cache = self._jits = {}
+        if key not in cache:
+            cache[key] = jax.jit(fn)
+        return cache[key]
+
+    def _unmatched_build(self, build_cols, build_live_all, matched_any):
+        """full outer: emit build rows no probe row matched (including live
+        null-key rows, which can never match), null-padded on the left."""
+        unmatched = build_live_all & ~matched_any
+        vals, count = filter_gather.filter_cols(build_cols, unmatched, None)
+        n = int(count)
+        if n == 0:
+            return
+        lf = self.children[0].output_schema.fields
+        cap_out = vals[0].validity.shape[0] if vals else 128
+        null_left: List[Val] = []
+        for f in lf:
+            if isinstance(f.dataType, (T.StringType, T.BinaryType)):
+                null_left.append(StrV(
+                    jnp.zeros(cap_out + 1, jnp.int32),
+                    jnp.zeros(1, jnp.uint8),
+                    jnp.zeros(cap_out, jnp.bool_),
+                ))
+            else:
+                null_left.append(ColV(
+                    jnp.zeros(cap_out, dtype=f.dataType.to_numpy()),
+                    jnp.zeros(cap_out, jnp.bool_),
+                ))
+        out = batch_from_vals(null_left + list(vals), self._schema, n)
+        yield self.record_batch(out)
+
+
+class TpuBroadcastNestedLoopJoinExec(TpuExec):
+    """Cartesian/conditioned nested-loop join (reference:
+    GpuBroadcastNestedLoopJoinExec.scala:311, GpuCartesianProductExec).
+
+    Inner-only: every (probe, build) pair is generated with static shapes
+    and the condition filters it."""
+
+    def __init__(self, conf: RapidsConf, left: TpuExec, right: TpuExec,
+                 condition: Optional[E.Expression] = None):
+        super().__init__(conf, [left, right])
+        lf, rf = left.output_schema.fields, right.output_schema.fields
+        self._schema = StructType(tuple(lf) + tuple(rf))
+        self._cond = (
+            E.bind_references(condition, self._schema)
+            if condition is not None else None
+        )
+        self._built = None
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    @property
+    def num_partitions(self):
+        return self.children[0].num_partitions
+
+    def describe(self):
+        return "TpuBroadcastNestedLoopJoinExec"
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        if self._built is None:
+            self._built = _concat_all(self.conf, self.children[1])
+        build = self._built
+        if build is None:
+            return
+        nb = build.num_rows
+        build_vals = vals_of_batch(build)
+        for pbatch in self.children[0].execute_partition(index):
+            np_ = pbatch.num_rows
+            if np_ == 0 or nb == 0:
+                continue
+            out_cap = bucket_rows(np_ * nb, self.conf.shape_bucket_min)
+            pcap = pbatch.capacity
+            pcaps = [
+                bucket_rows(max(1, int(c.offsets[np_]) * nb), 128)
+                for c in pbatch.columns if c.is_string
+            ]
+            bcaps = [
+                bucket_rows(max(1, int(c.offsets[nb]) * np_), 128)
+                for c in build.columns if c.is_string
+            ]
+
+            def expand(pcols, bcols):
+                j = jnp.arange(out_cap, dtype=jnp.int32)
+                pi = j // nb
+                bi = j % nb
+                slot_live = j < (np_ * nb)
+                left_side = filter_gather.gather(pcols, pi, slot_live, pcaps)
+                right_side = filter_gather.gather(bcols, bi, slot_live, bcaps)
+                cols = list(left_side) + list(right_side)
+                if self._cond is not None:
+                    c = lower(self._cond, cols, out_cap)
+                    mask = slot_live & c.data & c.validity
+                    cols, count = filter_gather.filter_cols(cols, mask, np_ * nb)
+                    return cols, count
+                return cols, jnp.int32(np_ * nb)
+
+            cache = getattr(self, "_jits", None)
+            if cache is None:
+                cache = self._jits = {}
+            key = (batch_signature(pbatch), out_cap, np_, nb)
+            if key not in cache:
+                cache[key] = jax.jit(expand)
+            vals, count = cache[key](vals_of_batch(pbatch), build_vals)
+            n = int(count)
+            if n:
+                yield self.record_batch(batch_from_vals(vals, self._schema, n))

@@ -1,0 +1,121 @@
+"""Sort exec.
+
+Reference analog: GpuSortExec (GpuSortExec.scala:51) — local per-partition
+sort, or global sort (the reference range-partitions first; until the
+exchange layer lands, global sorts gather to one partition, which is also
+what a single-partition collect needs anyway). The kernel is ops/sort.py's
+radix-key bitonic sort; batches within a partition concatenate first
+(RequireSingleBatch coalesce goal in the reference).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..columnar import ColumnarBatch
+from ..conf import RapidsConf
+from ..expr import expressions as E
+from ..expr.eval import StrV, lower
+from ..ops import filter_gather
+from ..ops.sort import SortOrder, max_string_len, sort_permutation
+from ..types import StructType
+from ..utils.bucketing import bucket_rows
+from .base import (
+    TOTAL_TIME,
+    TpuExec,
+    batch_from_vals,
+    batch_signature,
+    count_scalar,
+    timed,
+    vals_of_batch,
+)
+from .join import _concat_all
+
+
+class TpuSortExec(TpuExec):
+    def __init__(
+        self,
+        conf: RapidsConf,
+        sort_exprs: Sequence[E.Expression],
+        orders: Sequence[Tuple[bool, object]],  # (ascending, nulls_first|None)
+        child: TpuExec,
+        global_sort: bool = True,
+    ):
+        super().__init__(conf, [child])
+        self.sort_exprs = list(sort_exprs)
+        self.orders = [SortOrder(a, nf) for a, nf in orders]
+        self.global_sort = global_sort
+        self._bound = [
+            E.bind_references(e, child.output_schema) for e in self.sort_exprs
+        ]
+        self._jits = {}
+
+    @property
+    def output_schema(self) -> StructType:
+        return self.children[0].output_schema
+
+    @property
+    def num_partitions(self) -> int:
+        return 1 if self.global_sort else self.children[0].num_partitions
+
+    def describe(self):
+        ks = ", ".join(
+            f"{e}{'' if o.ascending else ' DESC'}"
+            for e, o in zip(self.sort_exprs, self.orders)
+        )
+        return f"TpuSortExec [{ks}]" + ("" if self.global_sort else " (local)")
+
+    def _gather_input(self, index: int):
+        if self.global_sort:
+            return _concat_all(self.conf, self.children[0])
+        batches = [
+            b for b in self.children[0].execute_partition(index)
+            if b.num_rows > 0
+        ]
+        if not batches:
+            return None
+        if len(batches) == 1:
+            return batches[0]
+        from .basic import TpuCoalesceBatchesExec
+
+        co = TpuCoalesceBatchesExec(self.conf, self.children[0], target_rows=1 << 62)
+        return co._flush(batches)
+
+    def _str_lens(self, batch) -> Tuple[int, ...]:
+        lens = []
+        for b in self._bound:
+            if isinstance(b.dtype, (T.StringType, T.BinaryType)):
+                if isinstance(b, E.BoundReference):
+                    c = batch.columns[b.ordinal]
+                    m = int(max_string_len(StrV(c.offsets, c.chars, c.validity)))
+                else:
+                    m = 64
+                lens.append(max(4, bucket_rows(max(1, m), 4)))
+        return tuple(lens)
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        batch = self._gather_input(index)
+        if batch is None:
+            return
+        cap = batch.capacity if batch.columns else 128
+        sml = self._str_lens(batch)
+
+        def run(cols, num_rows):
+            live = filter_gather.live_of(num_rows, cap)
+            keys = [lower(b, cols, cap) for b in self._bound]
+            perm = sort_permutation(
+                keys, [b.dtype for b in self._bound], self.orders, live, sml)
+            live_sorted = jnp.take(live, perm, mode="clip")
+            return filter_gather.gather(cols, perm, live_sorted)
+
+        key = (batch_signature(batch), cap, sml)
+        if key not in self._jits:
+            self._jits[key] = jax.jit(run)
+        with timed(self.metrics[TOTAL_TIME]):
+            vals = self._jits[key](
+                vals_of_batch(batch), count_scalar(batch.num_rows_lazy))
+        yield self.record_batch(
+            batch_from_vals(vals, self.output_schema, batch.num_rows_lazy))

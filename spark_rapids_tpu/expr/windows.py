@@ -1,0 +1,143 @@
+"""Window expressions.
+
+Reference analog: GpuWindowExpression.scala:784 — window function + spec
+(partition/order/frame) with frame validation; GpuRowNumber (:712),
+GpuLead/GpuLag (:758,:772), and aggregate-over-window lowering (:709).
+
+Frames supported (same initial set the reference validates for):
+  * ROWS/RANGE UNBOUNDED PRECEDING .. CURRENT ROW  ("running"; RANGE
+    includes the full peer group, Spark's default when ORDER BY is set)
+  * UNBOUNDED PRECEDING .. UNBOUNDED FOLLOWING     (whole partition,
+    Spark's default without ORDER BY)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from .. import types as T
+from ..types import DataType
+from . import expressions as E
+from .aggregates import AggregateFunction
+
+ROWS = "rows"
+RANGE = "range"
+
+UNBOUNDED_PRECEDING = "unbounded_preceding"
+CURRENT_ROW = "current_row"
+UNBOUNDED_FOLLOWING = "unbounded_following"
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowFrame:
+    frame_type: str = RANGE
+    lower: str = UNBOUNDED_PRECEDING
+    upper: str = CURRENT_ROW
+
+    @property
+    def is_running(self) -> bool:
+        return (
+            self.lower == UNBOUNDED_PRECEDING and self.upper == CURRENT_ROW
+        )
+
+    @property
+    def is_whole_partition(self) -> bool:
+        return (
+            self.lower == UNBOUNDED_PRECEDING
+            and self.upper == UNBOUNDED_FOLLOWING
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowSpec:
+    """PARTITION BY / ORDER BY / frame."""
+
+    partition_by: Tuple[E.Expression, ...] = ()
+    order_by: Tuple[E.Expression, ...] = ()
+    #: (ascending, nulls_first|None) per order key
+    orders: Tuple[Tuple[bool, Optional[bool]], ...] = ()
+    frame: Optional[WindowFrame] = None
+
+    def resolved_frame(self) -> WindowFrame:
+        if self.frame is not None:
+            return self.frame
+        # Spark defaults: with ORDER BY -> RANGE UNBOUNDED..CURRENT;
+        # without -> whole partition
+        if self.order_by:
+            return WindowFrame(RANGE, UNBOUNDED_PRECEDING, CURRENT_ROW)
+        return WindowFrame(RANGE, UNBOUNDED_PRECEDING, UNBOUNDED_FOLLOWING)
+
+
+class WindowFunction(E.Expression):
+    """Marker base for ranking/offset window functions."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RowNumber(WindowFunction):
+    @property
+    def dtype(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class Rank(WindowFunction):
+    @property
+    def dtype(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class DenseRank(WindowFunction):
+    @property
+    def dtype(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class Lead(WindowFunction):
+    child: E.Expression = None  # type: ignore[assignment]
+    offset: int = 1
+    default: Optional[E.Expression] = None
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class Lag(WindowFunction):
+    child: E.Expression = None  # type: ignore[assignment]
+    offset: int = 1
+    default: Optional[E.Expression] = None
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowExpression(E.Expression):
+    """function OVER spec (reference: GpuWindowExpression)."""
+
+    func: E.Expression = None  # type: ignore[assignment]  # WindowFunction | AggregateFunction
+    spec: WindowSpec = WindowSpec()
+    name: str = ""
+
+    @property
+    def dtype(self):
+        return self.func.dtype
+
+    def resolved_name(self) -> str:
+        return self.name or f"{type(self.func).__name__.lower()}_over"

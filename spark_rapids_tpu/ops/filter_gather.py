@@ -99,13 +99,27 @@ def gather_string(
 
 
 def gather(
-    cols: Sequence[Val], indices: jax.Array, valid_slot: jax.Array
+    cols: Sequence[Val],
+    indices: jax.Array,
+    valid_slot: jax.Array,
+    char_caps: Optional[Sequence[int]] = None,
 ) -> List[Val]:
-    """Gather each column by row ``indices`` (same output rows for all)."""
+    """Gather each column by row ``indices`` (same output rows for all).
+
+    ``char_caps`` overrides the output byte-pool size per string column (in
+    order of appearance) — required when indices repeat rows (join
+    expansion), where output bytes can exceed the input pool."""
     out: List[Val] = []
+    si = 0
     for c in cols:
         if isinstance(c, StrV):
-            out.append(gather_string(c, indices, valid_slot, int(c.chars.shape[0])))
+            cc = (
+                char_caps[si]
+                if char_caps is not None and si < len(char_caps)
+                else int(c.chars.shape[0])
+            )
+            si += 1
+            out.append(gather_string(c, indices, valid_slot, cc))
         else:
             out.append(gather_fixed(c, indices, valid_slot))
     return out

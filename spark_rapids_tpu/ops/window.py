@@ -1,0 +1,210 @@
+"""Window kernels over partition-sorted rows: segmented scans + boundary
+arithmetic.
+
+Reference analog: cudf rolling-window aggregations driven by GpuWindowExec
+(GpuWindowExec.scala:92, GpuWindowExpression.scala:709). TPU re-design:
+after ONE sort by (partition keys, order keys), every supported window
+function is O(n) scan arithmetic — cumsum/cummax for running frames,
+``lax.associative_scan`` with a segment-reset combiner for segmented
+min/max, and prefix/boundary gathers for RANGE peer-group semantics. No
+per-partition looping: all partitions process in the same pass.
+
+Row indexing convention: arrays are partition-sorted, padding rows last;
+``part_start[i]``/``part_end[i]`` give the first/last row index of row i's
+partition; ``peer_end[i]`` the last row of its ORDER BY peer group.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..expr.eval import ColV
+
+
+def boundaries_from_radix(
+    part_radix: Tuple[jax.Array, ...],
+    order_radix: Tuple[jax.Array, ...],
+    live: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """(part_start, part_end, peer_start, peer_end, seg) per row.
+
+    Inputs are the co-sorted radix key arrays (partition keys, order keys)
+    and the sorted liveness mask."""
+    cap = live.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+
+    def change(arrs):
+        ch = jnp.zeros(cap, jnp.bool_)
+        for a in arrs:
+            ch = ch | (a != jnp.roll(a, 1))
+        return ch.at[0].set(True)
+
+    part_change = change(part_radix) & live
+    peer_change = (part_change | (change(order_radix) if order_radix else jnp.zeros(cap, jnp.bool_))) & live
+    part_change = part_change.at[0].set(live[0])
+    peer_change = peer_change.at[0].set(live[0])
+
+    seg = jnp.cumsum(part_change.astype(jnp.int32)) - 1
+    seg = jnp.where(live, seg, cap)
+
+    # start of current partition / peer group: running max of marked starts
+    part_start = lax.cummax(jnp.where(part_change, idx, 0))
+    peer_start = lax.cummax(jnp.where(peer_change, idx, 0))
+
+    # end = (next start) - 1, scanned from the right
+    def next_start(change_mask):
+        nxt = jnp.roll(change_mask, -1).at[-1].set(True)
+        marked = jnp.where(nxt, idx, cap - 1)
+        return lax.cummin(marked[::-1])[::-1]
+
+    part_end = next_start(part_change | ~live)
+    peer_end = next_start(peer_change | ~live)
+    return part_start, part_end, peer_start, peer_end, seg
+
+
+def row_number(part_start: jax.Array, live: jax.Array) -> ColV:
+    cap = live.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    return ColV(jnp.where(live, idx - part_start + 1, 0), live)
+
+
+def rank(part_start: jax.Array, peer_start: jax.Array, live: jax.Array) -> ColV:
+    return ColV(jnp.where(live, peer_start - part_start + 1, 0), live)
+
+
+def dense_rank(
+    part_start: jax.Array, peer_start: jax.Array, live: jax.Array
+) -> ColV:
+    cap = live.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    new_peer = (peer_start == idx) & live
+    pre = jnp.cumsum(new_peer.astype(jnp.int32))
+    base = lax.cummax(jnp.where(part_start == idx, pre, 0))
+    return ColV(jnp.where(live, pre - base + 1, 0), live)
+
+
+def shift_in_partition(
+    col: ColV,
+    offset: int,
+    part_start: jax.Array,
+    part_end: jax.Array,
+    live: jax.Array,
+    default: Optional[ColV] = None,
+) -> ColV:
+    """lead (offset>0) / lag (offset<0) within the partition."""
+    cap = live.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    target = idx + offset
+    in_part = (target >= part_start) & (target <= part_end) & live
+    safe = jnp.clip(target, 0, cap - 1)
+    data = jnp.take(col.data, safe, mode="clip")
+    valid = jnp.take(col.validity, safe, mode="clip") & in_part
+    if default is not None:
+        data = jnp.where(in_part, data, default.data)
+        valid = valid | (~in_part & live & default.validity)
+    data = jnp.where(valid, data, jnp.zeros((), data.dtype))
+    return ColV(data, valid & live)
+
+
+def _seg_scan(values: jax.Array, seg: jax.Array, combine):
+    """Segmented inclusive scan via associative_scan with reset-on-new-seg."""
+
+    def op(a, b):
+        va, sa = a
+        vb, sb = b
+        return (jnp.where(sa == sb, combine(va, vb), vb), sb)
+
+    out, _ = lax.associative_scan(op, (values, seg))
+    return out
+
+
+def running_agg(
+    op: str,
+    col: Optional[ColV],
+    seg: jax.Array,
+    part_start: jax.Array,
+    peer_end: jax.Array,
+    live: jax.Array,
+    range_frame: bool,
+    whole_partition: bool,
+    part_end: jax.Array,
+) -> ColV:
+    """sum/count/min/max/avg-buffer over a running or whole-partition frame.
+
+    ``range_frame``: include the whole ORDER BY peer group (Spark RANGE
+    UNBOUNDED..CURRENT). ``whole_partition`` overrides with the full frame.
+    """
+    cap = live.shape[0]
+    at = part_end if whole_partition else (peer_end if range_frame else None)
+
+    def frame_value(prefix):
+        if at is None:
+            return prefix
+        return jnp.take(prefix, jnp.clip(at, 0, cap - 1), mode="clip")
+
+    if op in ("count", "count_star"):
+        valid = live if op == "count_star" else (live & col.validity)
+        pre = jnp.cumsum(valid.astype(jnp.int64))
+        base = jnp.take(
+            pre - valid.astype(jnp.int64),
+            jnp.clip(part_start, 0, cap - 1), mode="clip")
+        cnt = frame_value(pre) - base
+        return ColV(jnp.where(live, cnt, 0), live)
+    valid = live & col.validity
+    if op == "sum":
+        x = jnp.where(valid, col.data, jnp.zeros((), col.data.dtype))
+        pre = jnp.cumsum(x)
+        base = jnp.take(pre - x, jnp.clip(part_start, 0, cap - 1), mode="clip")
+        s = frame_value(pre) - base
+        cpre = jnp.cumsum(valid.astype(jnp.int64))
+        cbase = jnp.take(
+            cpre - valid.astype(jnp.int64),
+            jnp.clip(part_start, 0, cap - 1), mode="clip")
+        cnt = frame_value(cpre) - cbase
+        has = cnt > 0
+        return ColV(jnp.where(has, s, jnp.zeros((), s.dtype)), has & live)
+    if op in ("min", "max"):
+        isfloat = jnp.issubdtype(col.data.dtype, jnp.floating)
+        if op == "max":
+            if isfloat:
+                fill = jnp.array(-jnp.inf, col.data.dtype)
+            elif col.data.dtype == jnp.bool_:
+                fill = jnp.array(False)
+            else:
+                fill = jnp.array(jnp.iinfo(col.data.dtype).min, col.data.dtype)
+            x = jnp.where(valid, col.data, fill)
+            scan = _seg_scan(x, seg, jnp.maximum)
+        else:
+            if isfloat:
+                # Spark min skips NaN unless all-NaN: map NaN -> +inf, fix later
+                x = jnp.where(jnp.isnan(col.data), jnp.inf, col.data)
+                fill = jnp.array(jnp.inf, col.data.dtype)
+            elif col.data.dtype == jnp.bool_:
+                x = col.data
+                fill = jnp.array(True)
+            else:
+                x = col.data
+                fill = jnp.array(jnp.iinfo(col.data.dtype).max, col.data.dtype)
+            x = jnp.where(valid, x, fill)
+            scan = _seg_scan(x, seg, jnp.minimum)
+        r = frame_value(scan)
+        cpre = jnp.cumsum(valid.astype(jnp.int64))
+        cbase = jnp.take(
+            cpre - valid.astype(jnp.int64),
+            jnp.clip(part_start, 0, cap - 1), mode="clip")
+        cnt = frame_value(cpre) - cbase
+        has = (cnt > 0) & live
+        if op == "min" and isfloat:
+            nn_valid = valid & ~jnp.isnan(col.data)
+            npre = jnp.cumsum(nn_valid.astype(jnp.int64))
+            nbase = jnp.take(
+                npre - nn_valid.astype(jnp.int64),
+                jnp.clip(part_start, 0, cap - 1), mode="clip")
+            n_nonnan = frame_value(npre) - nbase
+            r = jnp.where((n_nonnan == 0) & has, jnp.nan, r)
+        r = jnp.where(has, r, jnp.zeros((), r.dtype))
+        return ColV(r, has)
+    raise ValueError(f"unsupported window aggregation {op!r}")

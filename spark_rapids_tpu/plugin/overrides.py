@@ -139,6 +139,18 @@ for _cls, _name, _desc in [
 ]:
     _expr_rule(_cls, _name, _desc)
 
+from ..expr import windows as _W  # noqa: E402
+
+for _cls, _name, _desc in [
+    (_W.WindowExpression, "WindowExpression", "function over a window spec"),
+    (_W.RowNumber, "RowNumber", "row number within partition"),
+    (_W.Rank, "Rank", "rank with gaps"),
+    (_W.DenseRank, "DenseRank", "rank without gaps"),
+    (_W.Lead, "Lead", "value of a following row"),
+    (_W.Lag, "Lag", "value of a preceding row"),
+]:
+    _expr_rule(_cls, _name, _desc)
+
 
 def _check_type(dt: T.DataType, conf: RapidsConf) -> Optional[str]:
     """Allowed-type matrix (reference: isSupportedType GpuOverrides.scala:531)."""
@@ -349,6 +361,127 @@ def _convert_aggregate(cpu: C.CpuHashAggregateExec, conf, children):
         conf, cpu.group_exprs, cpu.agg_exprs, gathered, A.FINAL)
 
 
+def _sortable(dt: T.DataType) -> bool:
+    return T.is_fixed_width(dt) or isinstance(dt, (T.StringType, T.BinaryType))
+
+
+def _tag_sort(meta: "PlanMeta") -> None:
+    cpu: C.CpuSortExec = meta.wrapped  # type: ignore[assignment]
+    schema = cpu.children[0].output_schema
+    for e in cpu.sort_exprs:
+        for r in check_expression(e, schema, meta.conf):
+            meta.will_not_work(r)
+        try:
+            b = E.bind_references(e, schema)
+            if not _sortable(b.dtype):
+                meta.will_not_work(
+                    f"sort key type {b.dtype.simpleString} is not sortable on TPU")
+        except (ValueError, KeyError) as ex:
+            meta.will_not_work(str(ex))
+    _tag_output_types(meta)
+
+
+def _convert_sort(cpu: C.CpuSortExec, conf, children):
+    from ..exec.sort import TpuSortExec
+
+    return TpuSortExec(conf, cpu.sort_exprs, cpu.orders, children[0])
+
+
+def _tag_join(meta: "PlanMeta") -> None:
+    cpu: C.CpuJoinExec = meta.wrapped  # type: ignore[assignment]
+    ls = cpu.children[0].output_schema
+    rs = cpu.children[1].output_schema
+    if not cpu.left_keys:
+        if cpu.join_type != "inner":
+            meta.will_not_work(
+                f"non-equi {cpu.join_type} joins are not supported on TPU")
+    for k, schema in [(k, ls) for k in cpu.left_keys] + [
+        (k, rs) for k in cpu.right_keys
+    ]:
+        for r in check_expression(k, schema, meta.conf):
+            meta.will_not_work(r)
+        try:
+            b = E.bind_references(k, schema)
+            if not _sortable(b.dtype):
+                meta.will_not_work(
+                    f"join key type {b.dtype.simpleString} not supported on TPU")
+        except (ValueError, KeyError) as ex:
+            meta.will_not_work(str(ex))
+    if cpu.condition is not None:
+        if cpu.join_type != "inner":
+            meta.will_not_work(
+                "residual join conditions only run on TPU for inner joins")
+        comb = StructType(tuple(ls.fields) + tuple(rs.fields))
+        for r in check_expression(cpu.condition, comb, meta.conf):
+            meta.will_not_work(r)
+    _tag_output_types(meta)
+
+
+def _convert_join(cpu: C.CpuJoinExec, conf, children):
+    from ..exec.join import (
+        TpuBroadcastNestedLoopJoinExec,
+        TpuShuffledHashJoinExec,
+    )
+
+    if not cpu.left_keys:
+        return TpuBroadcastNestedLoopJoinExec(
+            conf, children[0], children[1], cpu.condition)
+    return TpuShuffledHashJoinExec(
+        conf, children[0], children[1], cpu.left_keys, cpu.right_keys,
+        cpu.join_type, cpu.condition,
+    )
+
+
+def _tag_window(meta: "PlanMeta") -> None:
+    from ..expr import windows as W
+
+    cpu: C.CpuWindowExec = meta.wrapped  # type: ignore[assignment]
+    schema = cpu.children[0].output_schema
+    spec = cpu.spec
+    for k in list(spec.partition_by) + list(spec.order_by):
+        for r in check_expression(k, schema, meta.conf):
+            meta.will_not_work(r)
+        try:
+            b = E.bind_references(k, schema)
+            if not _sortable(b.dtype):
+                meta.will_not_work(
+                    f"window key type {b.dtype.simpleString} not supported on TPU")
+        except (ValueError, KeyError) as ex:
+            meta.will_not_work(str(ex))
+    frame = spec.resolved_frame()
+    if not (frame.is_running or frame.is_whole_partition):
+        meta.will_not_work(
+            "only UNBOUNDED PRECEDING..CURRENT ROW / whole-partition window "
+            "frames run on TPU")
+    for we in cpu.window_exprs:
+        f = we.func
+        if isinstance(f, (W.RowNumber, W.Rank, W.DenseRank)):
+            continue
+        if isinstance(f, (W.Lead, W.Lag)):
+            for r in check_expression(f.child, schema, meta.conf):
+                meta.will_not_work(r)
+            continue
+        if isinstance(f, (A.Count, A.Sum, A.Min, A.Max, A.Average)):
+            if f.input is not None:
+                try:
+                    b = E.bind_references(f.child, schema)
+                    if isinstance(b.dtype, (T.StringType, T.BinaryType)):
+                        meta.will_not_work(
+                            "window aggregation over strings not supported on TPU")
+                except (ValueError, KeyError) as ex:
+                    meta.will_not_work(str(ex))
+            continue
+        meta.will_not_work(
+            f"window function {type(f).__name__} is not supported on TPU")
+    _tag_output_types(meta)
+
+
+def _convert_window(cpu: C.CpuWindowExec, conf, children):
+    from ..exec.window import TpuWindowExec
+
+    return TpuWindowExec(conf, cpu.window_exprs, children[0])
+
+
 _exec_rule(C.CpuScanExec, "ScanExec", "in-memory data source", _tag_scan, _convert_scan)
 _exec_rule(C.CpuRangeExec, "RangeExec", "range of longs", _tag_range, _convert_range)
 _exec_rule(C.CpuProjectExec, "ProjectExec", "column projection", _tag_project, _convert_project)
@@ -358,6 +491,11 @@ _exec_rule(C.CpuLocalLimitExec, "LocalLimitExec", "row limit", _tag_limit, _conv
 _exec_rule(C.CpuExpandExec, "ExpandExec", "expand projections", _tag_expand, _convert_expand)
 _exec_rule(C.CpuHashAggregateExec, "HashAggregateExec", "hash aggregation",
            _tag_aggregate, _convert_aggregate)
+_exec_rule(C.CpuSortExec, "SortExec", "sort", _tag_sort, _convert_sort)
+_exec_rule(C.CpuJoinExec, "JoinExec", "equi/nested-loop join",
+           _tag_join, _convert_join)
+_exec_rule(C.CpuWindowExec, "WindowExec", "window functions",
+           _tag_window, _convert_window)
 
 
 # ---------------------------------------------------------------------------

@@ -66,6 +66,9 @@ def _lower(node: LNode, conf: RapidsConf) -> C.CpuExec:
     if k == "join":
         lkeys, rkeys, how, cond = node.args
         return C.CpuJoinExec(conf, kids[0], kids[1], list(lkeys), list(rkeys), how, cond)
+    if k == "window":
+        (wexprs,) = node.args
+        return C.CpuWindowExec(conf, list(wexprs), kids[0])
     raise ValueError(f"unknown logical node {k}")
 
 
@@ -206,6 +209,12 @@ class DataFrame:
         return DataFrame(
             self.session,
             LNode("join", (lkeys, rkeys, how, condition), (self.node, other.node)),
+        )
+
+    def with_windows(self, *wexprs) -> "DataFrame":
+        """Append window columns (function OVER partition/order spec)."""
+        return DataFrame(
+            self.session, LNode("window", (tuple(wexprs),), (self.node,))
         )
 
     def distinct(self) -> "DataFrame":

@@ -153,19 +153,21 @@ class TestDifferential:
 
 
 class TestFallback:
-    def test_sort_falls_back(self):
-        # no TPU sort exec rule yet -> CpuSortExec stays on CPU, results equal
+    def test_float_agg_falls_back_by_default(self):
+        # reference parity: float sum/avg stay on CPU unless variableFloatAgg
         assert_fallback(
-            lambda s: make_df(s).select(col("k"), col("a")).order_by("a"),
-            "CpuSortExec",
+            lambda s: make_df(s).group_by("k").agg(A.agg(A.Sum(col("b")), "sb")),
+            "CpuHashAggregateExec",
         )
 
-    def test_join_falls_back(self):
+    def test_left_join_with_condition_falls_back(self):
         def build(s):
             left = make_df(s, 40, 1).select(col("k"), col("a"))
             right = make_df(s, 30, 1).select(
                 E.Alias(col("k"), "k2"), E.Alias(col("b"), "b2"))
-            return left.join(right, on=[("k", "k2")], how="inner")
+            return left.join(
+                right, on=[("k", "k2")], how="left",
+                condition=E.GreaterThan(col("b2"), lit(1.0)))
 
         assert_fallback(build, "CpuJoinExec")
 
@@ -180,7 +182,7 @@ class TestFallback:
             "spark.rapids.tpu.sql.enabled": True,
             "spark.rapids.tpu.sql.test.enabled": True,
         })
-        df = make_df(sess).order_by("a")
+        df = make_df(sess).group_by("k").agg(A.agg(A.Min(col("s")), "ms"))
         with pytest.raises(AssertionError, match="not columnar"):
             df.collect()
 
@@ -196,9 +198,10 @@ class TestFallback:
 class TestExplain:
     def test_explain_marks_tpu_and_cpu(self):
         sess = TpuSession()
-        df = make_df(sess).where(E.IsNotNull(col("k"))).order_by("k")
+        df = make_df(sess).where(E.IsNotNull(col("k"))).group_by("k").agg(
+            A.agg(A.Min(col("s")), "ms"))
         report = df.explain()
-        assert "!Exec <CpuSortExec> cannot run on TPU" in report
+        assert "!Exec <HashAggregateExec> cannot run on TPU" in report
         assert "*Exec <FilterExec> will run on TPU" in report
 
     def test_explain_conf_capture(self):
@@ -208,23 +211,32 @@ class TestExplain:
 
     def test_explain_not_on_tpu_only(self):
         sess = TpuSession({"spark.rapids.tpu.sql.explain": "NOT_ON_TPU"})
-        make_df(sess).order_by("a").collect()
+        make_df(sess).group_by("k").agg(A.agg(A.Min(col("s")), "ms")).collect()
         assert "cannot run on TPU" in sess.last_explain
         assert "will run on TPU" not in sess.last_explain
 
 
 class TestMixedPlan:
-    def test_tpu_below_cpu_sort(self):
-        """Filter/project run on TPU, sort falls back, transitions inserted."""
+    def test_tpu_below_cpu_agg(self):
+        """Filter/project run on TPU, string agg falls back, transitions
+        inserted at the boundary."""
         sess = TpuSession()
         df = (
             make_df(sess, 100, 2)
             .where(E.GreaterThan(col("a"), lit(-50)))
-            .select(col("a"))
-            .order_by("a")
+            .select(col("k"), col("s"))
+            .group_by("k")
+            .agg(A.agg(A.Min(col("s")), "ms"))
         )
         rows = df.collect()
-        assert [r[0] for r in rows] == sorted(r[0] for r in rows)
+        assert len(rows) > 0
         plan_str = sess.last_executed_plan.tree_string()
         assert "ColumnarToRowExec" in plan_str
         assert "TpuFilterExec" in plan_str
+
+    def test_sort_now_runs_on_tpu(self):
+        sess = TpuSession()
+        df = make_df(sess, 100, 2).select(col("a")).order_by("a")
+        rows = df.collect()
+        assert [r[0] for r in rows] == sorted(r[0] for r in rows)
+        assert "TpuSortExec" in sess.last_executed_plan.tree_string()
