@@ -61,12 +61,20 @@ def bucket_reduce(
     for valid in count_cols:
         limbs.append(valid.astype(jnp.float32))
     nf_start = len(limbs)
+    F32_MAX = jnp.float64(3.4028234663852886e38)
+    flt_corrections: List[Tuple[jax.Array, jax.Array]] = []
     for data, valid in float_cols:
         d = jnp.where(valid, data, 0.0).astype(jnp.float64)
-        hi = d.astype(jnp.float32)
-        lo = (d - hi.astype(jnp.float64)).astype(jnp.float32)
+        # |x| beyond f32 range would make hi=inf and lo=NaN; zero those rows
+        # out of the matmul path and scatter-add them separately (cond'd on
+        # actually seeing one, so the common case pays no scatter)
+        ovf = jnp.abs(d) > F32_MAX
+        d_main = jnp.where(ovf, 0.0, d)
+        hi = d_main.astype(jnp.float32)
+        lo = (d_main - hi.astype(jnp.float64)).astype(jnp.float32)
         limbs.append(hi)
         limbs.append(lo)
+        flt_corrections.append((jnp.any(ovf), jnp.where(ovf, d, 0.0)))
     if not limbs:
         return [], [], []
     cols = jnp.stack(limbs, axis=-1)  # (n, L)
@@ -103,8 +111,13 @@ def bucket_reduce(
         k += 1
     out_flt: List[jax.Array] = []
     k = 0
-    for _ in float_cols:
-        out_flt.append(acc_f[k] + acc_f[k + 1])
+    for (any_ovf, d_ovf) in flt_corrections:
+        corr = jax.lax.cond(
+            any_ovf,
+            lambda d=d_ovf: jax.ops.segment_sum(d, seg, num_segments=B),
+            lambda: jnp.zeros(B, jnp.float64),
+        )
+        out_flt.append(acc_f[k] + acc_f[k + 1] + corr)
         k += 2
     return out_int, out_cnt, out_flt
 

@@ -12,7 +12,7 @@ All functions are pure and trace-safe (usable under jit/shard_map).
 """
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple, Union
+from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp

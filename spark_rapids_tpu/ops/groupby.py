@@ -385,16 +385,20 @@ def hash_groupby(
         rep_row = jnp.clip(first_row, 0, cap - 1)
         order = SortOrder(True, True)
         words: List[jax.Array] = []
-        nullpack = jnp.zeros(cap, jnp.uint32)
+        # one nullpack word per 16 keys: 2-bit null ranks must not alias
+        nullpacks = [
+            jnp.zeros(cap, jnp.uint32)
+            for _ in range((len(key_cols) + 15) // 16)
+        ]
         for i, (c, dt) in enumerate(zip(key_cols, key_dtypes)):
             null_rank, vk = fixed_radix_keys(c, dt, order)
-            nullpack = nullpack | (null_rank << (2 * (i % 16)))
+            nullpacks[i // 16] = nullpacks[i // 16] | (null_rank << (2 * (i % 16)))
             if vk.dtype == jnp.uint64:
                 words.append((vk & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
                 words.append((vk >> 32).astype(jnp.uint32))
             else:
                 words.append(vk.astype(jnp.uint32))
-        words.append(nullpack)
+        words.extend(nullpacks)
         ok = jnp.bool_(True)
         for w in words:
             rep_table = jnp.where(

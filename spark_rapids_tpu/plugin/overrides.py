@@ -468,6 +468,20 @@ def _tag_window(meta: "PlanMeta") -> None:
                     if isinstance(b.dtype, (T.StringType, T.BinaryType)):
                         meta.will_not_work(
                             "window aggregation over strings not supported on TPU")
+                    if (
+                        isinstance(f, (A.Sum, A.Average))
+                        and b.dtype.is_floating
+                        and not meta.conf.get(IMPROVED_FLOAT_OPS)
+                    ):
+                        # same gate as check_aggregate: running float sums use
+                        # cumsum-then-subtract, whose cancellation can diverge
+                        # from the CPU's per-frame order (reference gates float
+                        # agg in window contexts too, GpuOverrides.scala:1725)
+                        meta.will_not_work(
+                            "floating-point window sum/average can differ from "
+                            "CPU results; set spark.rapids.tpu.sql."
+                            "variableFloatAgg.enabled=true to enable"
+                        )
                 except (ValueError, KeyError) as ex:
                     meta.will_not_work(str(ex))
             continue
