@@ -169,13 +169,20 @@ MEMORY_DEBUG = conf(
 # Shuffle (reference: RapidsConf.scala:687-786)
 # ---------------------------------------------------------------------------
 SHUFFLE_TRANSPORT_CLASS = conf(
-    "spark.rapids.tpu.shuffle.transport.class", "ici",
-    "Transport for exchange data: 'ici' (mesh all-to-all collectives) or "
-    "'host' (serialized host bytes).", valid_values=("ici", "host"))
+    "spark.rapids.tpu.shuffle.transport.class", "device",
+    "Transport for exchange pieces: 'device' (pieces stay TPU-resident in "
+    "the shuffle catalog, the UCX device-cache analog) or 'host' "
+    "(serialized host bytes, the fallback-serializer analog).",
+    valid_values=("device", "host"))
 SHUFFLE_COMPRESSION_CODEC = conf(
     "spark.rapids.tpu.shuffle.compression.codec", "none",
-    "Codec for host-path shuffle payloads: none/lz4/copy.",
-    valid_values=("none", "lz4", "copy"))
+    "Codec for host-path shuffle payloads: none/zstd (the host stand-in "
+    "for the reference's nvcomp LZ4).",
+    valid_values=("none", "zstd"))
+SHUFFLE_PARTITIONS = conf(
+    "spark.rapids.tpu.sql.shuffle.partitions", 0,
+    "Number of reduce partitions for exchanges; 0 keeps the child's "
+    "partition count (reference: spark.sql.shuffle.partitions).")
 SHUFFLE_PARTITIONING_MAX_PARTITIONS = conf(
     "spark.rapids.tpu.shuffle.maxPartitions", 1 << 16,
     "Upper bound on shuffle partitions.", check=_positive)

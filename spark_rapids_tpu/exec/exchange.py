@@ -1,0 +1,397 @@
+"""Columnar exchange execs: shuffle and broadcast.
+
+Reference analog: GpuShuffleExchangeExecBase.doExecuteColumnar
+(execution/GpuShuffleExchangeExec.scala:70,147) and
+GpuBroadcastExchangeExecBase (execution/GpuBroadcastExchangeExec.scala:237).
+The map side partitions each child batch with ONE fused device program
+(partition-id compute + stable sort + offsets; shuffle/partition.py), syncs
+only the (P+1,) offsets vector, slices device pieces, and writes them
+through the transport SPI. The reduce side fetches its pieces and concats
+them into one dense batch per partition (the GpuShuffleCoalesceExec role).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..columnar import ColumnarBatch
+from ..conf import (
+    RapidsConf,
+    SHUFFLE_COMPRESSION_CODEC,
+    SHUFFLE_TRANSPORT_CLASS,
+)
+from ..expr.eval import ColV, StrV, Val
+from ..ops import concat as concat_ops
+from ..ops import filter_gather
+from ..ops.sort import max_string_len
+from ..shuffle.partition import Partitioning, RangePartitioning, partition_cols
+from ..shuffle.transport import (
+    DeviceShuffleTransport,
+    SerializedShuffleTransport,
+    ShufflePiece,
+    ShuffleTransport,
+    new_shuffle_id,
+)
+from ..types import StructType
+from ..utils.bucketing import bucket_rows
+from .base import (
+    TOTAL_TIME,
+    TpuExec,
+    batch_from_vals,
+    batch_signature,
+    count_scalar,
+    timed,
+    vals_of_batch,
+)
+
+PARTITION_SIZE = "partitionSize"  # reference metric (GpuExec.scala:27-60)
+DATA_SIZE = "dataSize"
+
+
+def make_transport(conf: RapidsConf) -> ShuffleTransport:
+    kind = conf.get(SHUFFLE_TRANSPORT_CLASS)
+    if kind == "host":
+        return SerializedShuffleTransport(conf.get(SHUFFLE_COMPRESSION_CODEC))
+    return DeviceShuffleTransport()
+
+
+_SLICE_CACHE: Dict[tuple, object] = {}
+
+
+def _piece_slicer(sig: tuple, pcap: int, ccaps: Tuple[int, ...]):
+    """Jitted row-range slice at bucketed output shapes.
+
+    Start/count are TRACED operands, so one compiled program serves every
+    piece that lands in the same (capacity, char-cap) bucket — a naive
+    ``data[a:b]`` would compile one XLA slice per distinct range.
+    """
+    key = (sig, pcap, ccaps)
+    fn = _SLICE_CACHE.get(key)
+    if fn is None:
+
+        def run(cols, start, n):
+            idx = jnp.arange(pcap, dtype=jnp.int32) + start
+            valid_slot = jnp.arange(pcap, dtype=jnp.int32) < n
+            return filter_gather.gather(cols, idx, valid_slot, ccaps)
+
+        if len(_SLICE_CACHE) > 1024:
+            _SLICE_CACHE.clear()
+        fn = _SLICE_CACHE[key] = jax.jit(run)
+    return fn
+
+
+def _vals_signature(vals: Sequence[Val]) -> tuple:
+    sig = []
+    for v in vals:
+        if isinstance(v, StrV):
+            sig.append(("s", int(v.offsets.shape[0]), int(v.chars.shape[0])))
+        else:
+            sig.append((str(v.data.dtype), int(v.data.shape[0])))
+    return tuple(sig)
+
+
+def _slice_piece(
+    vals: Sequence[Val], a: int, b: int,
+    str_bounds: Sequence[Tuple[int, int]],
+) -> ShufflePiece:
+    """Device-slice rows [a, b) of partition-sorted columns into a piece
+    at power-of-two capacity (strings re-based to offset 0 by the gather).
+
+    ``str_bounds[i]`` = (byte_start, byte_end) for the i-th string column
+    (host ints synced at the map boundary)."""
+    n = b - a
+    byte_lens = tuple(bb - ba for ba, bb in str_bounds)
+    pcap = bucket_rows(max(1, n))
+    ccaps = tuple(bucket_rows(max(1, bl), 128) for bl in byte_lens)
+    fn = _piece_slicer(_vals_signature(vals), pcap, ccaps)
+    out = fn(vals, jnp.int32(a), jnp.int32(n))
+    return ShufflePiece(out, n, byte_lens)
+
+
+_CONCAT_CACHE: Dict[tuple, object] = {}
+
+
+def concat_pieces(
+    pieces: Sequence[ShufflePiece], schema: StructType
+) -> ColumnarBatch:
+    """Concat shuffle pieces into one dense batch with ONE jitted program
+    per shape set (row/byte counts are traced operands, so arbitrary piece
+    sizes reuse the same executable)."""
+    lengths = [p.n for p in pieces]
+    n_str = len(pieces[0].byte_lens)
+    out_cap = bucket_rows(max(1, sum(lengths)))
+    out_char_caps = tuple(
+        bucket_rows(max(1, sum(p.byte_lens[k] for p in pieces)), 128)
+        for k in range(n_str)
+    )
+    sigs = tuple(_vals_signature(p.vals) for p in pieces)
+    key = (sigs, out_cap, out_char_caps)
+    fn = _CONCAT_CACHE.get(key)
+    if fn is None:
+
+        def run(col_parts, counts, byte_counts):
+            return concat_ops.concat_pieces_traced(
+                col_parts, counts, byte_counts, out_cap, out_char_caps)
+
+        if len(_CONCAT_CACHE) > 1024:
+            _CONCAT_CACHE.clear()
+        fn = _CONCAT_CACHE[key] = jax.jit(run)
+    cols, _n = fn(
+        [p.vals for p in pieces],
+        [jnp.int32(p.n) for p in pieces],
+        [[jnp.int32(b) for b in p.byte_lens] for p in pieces],
+    )
+    return batch_from_vals(cols, schema, sum(lengths))
+
+
+class TpuShuffleExchangeExec(TpuExec):
+    """Repartition child output by a Partitioning through the transport."""
+
+    def __init__(self, conf: RapidsConf, child: TpuExec,
+                 partitioning: Partitioning,
+                 transport: Optional[ShuffleTransport] = None):
+        super().__init__(conf, [child])
+        self.partitioning = partitioning
+        self.transport = transport or make_transport(conf)
+        self.shuffle_id = new_shuffle_id()
+        self._map_done = False
+        self._map_lock = threading.Lock()
+        self._jits: Dict[tuple, object] = {}
+        self.metrics[PARTITION_SIZE] = self.metric(PARTITION_SIZE)
+        self.metrics[DATA_SIZE] = self.metric(DATA_SIZE)
+
+    @property
+    def output_schema(self) -> StructType:
+        return self.children[0].output_schema
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partitioning.num_partitions
+
+    def describe(self):
+        return f"TpuShuffleExchangeExec {self.partitioning.describe()}"
+
+    # -- map side ----------------------------------------------------------
+    def _part_cache_key(self) -> tuple:
+        p = self.partitioning
+        if isinstance(p, RangePartitioning) and p.bounds is not None:
+            return (p.describe(), tuple(tuple(b) for b in p.bounds))
+        return (p.describe(),)
+
+    def _key_str_lens(self, batch: ColumnarBatch) -> Tuple[int, ...]:
+        """Per-batch byte-length bucket for each STRING key column, so
+        hashing/range-comparison covers full strings (one tiny host sync,
+        same place TpuSortExec syncs its string bounds)."""
+        lens = []
+        for i in getattr(self.partitioning, "key_indices", ()):
+            c = batch.columns[i]
+            if c.is_string:
+                m = int(max_string_len(StrV(c.offsets, c.chars, c.validity)))
+                lens.append(max(4, bucket_rows(max(1, m), 4)))
+        return tuple(lens)
+
+    def _map_fn(self, sig: tuple, cap: int, schema: StructType,
+                sml: Tuple[int, ...]):
+        P = self.num_partitions
+        key = (sig, cap, P, sml, self._part_cache_key())
+        fn = self._jits.get(key)
+        if fn is None:
+            part = self.partitioning
+
+            def run(cols, num_rows, map_index):
+                live = filter_gather.live_of(num_rows, cap)
+                pids = part.partition_ids(
+                    cols, schema, live, map_index, str_max_lens=sml)
+                sorted_cols, offsets = partition_cols(cols, pids, num_rows, P)
+                byte_offs = [
+                    jnp.take(c.offsets, offsets, mode="clip")
+                    for c in sorted_cols if isinstance(c, StrV)
+                ]
+                return sorted_cols, offsets, byte_offs
+
+            fn = self._jits[key] = jax.jit(run)
+        return fn
+
+    def _sample_range_bounds(self, parts: List[List[ColumnarBatch]]) -> None:
+        """Sample key values host-side and set the range bounds
+        (reference: GpuRangePartitioner.sketch/determineBounds)."""
+        part = self.partitioning
+        assert isinstance(part, RangePartitioning)
+        if part.bounds is not None:
+            return
+        from ..cpu.plan import _SparkOrderKey
+
+        from .base import vals_of_batch
+
+        samples: List[tuple] = []
+        for batches in parts:
+            for b in batches:
+                n = b.num_rows
+                if n == 0:
+                    continue
+                take = min(n, 128)
+                step = max(1, n // take)
+                # gather the strided sample ON DEVICE, read back only it
+                # (a full column readback here would be O(rows) transfer
+                # for an O(128) sample)
+                idx = jnp.asarray(range(0, n, step), jnp.int32)
+                key_vals = [vals_of_batch(b)[i] for i in part.key_indices]
+                sampled = filter_gather.gather(
+                    key_vals, idx, jnp.ones(idx.shape[0], jnp.bool_))
+                from .base import batch_from_vals
+
+                sb = batch_from_vals(
+                    sampled,
+                    T.StructType(tuple(
+                        b.schema.fields[i] for i in part.key_indices)),
+                    idx.shape[0],
+                )
+                hosts = sb.host_columns()
+                for r in range(idx.shape[0]):
+                    samples.append(tuple(
+                        (None if not h.validity[r] else
+                         (h.data[r].item()
+                          if hasattr(h.data[r], "item") else h.data[r]))
+                        for h in hosts
+                    ))
+        P = part.num_partitions
+        if not samples:
+            part.bounds = [[None] * (P - 1) for _ in part.key_indices]
+            return
+        orders = part.orders
+        samples.sort(key=lambda row: tuple(
+            _SparkOrderKey(v, o.ascending, o.nulls_first_resolved)
+            for v, o in zip(row, orders)
+        ))
+        bounds_rows = []
+        for j in range(1, P):
+            bounds_rows.append(samples[min(len(samples) - 1,
+                                           j * len(samples) // P)])
+        part.bounds = [
+            [row[k] for row in bounds_rows]
+            for k in range(len(part.key_indices))
+        ]
+
+    def _run_map_side(self) -> None:
+        with self._map_lock:
+            if self._map_done:
+                return
+            child = self.children[0]
+            schema = self.output_schema
+            str_col_ix = [
+                j for j, f in enumerate(schema.fields)
+                if isinstance(f.dataType, (T.StringType, T.BinaryType))
+            ]
+            needs_sample = (
+                isinstance(self.partitioning, RangePartitioning)
+                and self.partitioning.bounds is None
+            )
+            if needs_sample:
+                parts = [
+                    list(child.execute_partition(p))
+                    for p in range(child.num_partitions)
+                ]
+                self._sample_range_bounds(parts)
+                batch_iter = [
+                    (p, b) for p, bs in enumerate(parts) for b in bs
+                ]
+            else:
+                batch_iter = (
+                    (p, b)
+                    for p in range(child.num_partitions)
+                    for b in child.execute_partition(p)
+                )
+            P = self.num_partitions
+            with timed(self.metrics[TOTAL_TIME]):
+                for map_id, batch in batch_iter:
+                    if not batch.columns:
+                        continue
+                    cap = batch.capacity
+                    fn = self._map_fn(
+                        batch_signature(batch), cap, schema,
+                        self._key_str_lens(batch))
+                    sorted_cols, offsets, byte_offs = fn(
+                        vals_of_batch(batch),
+                        count_scalar(batch.num_rows_lazy),
+                        jnp.int32(map_id),
+                    )
+                    # ONE host sync for the (P+1,) offsets (+ string bytes)
+                    off_h, *boffs_h = jax.device_get([offsets, *byte_offs])
+                    for j in range(P):
+                        a, b = int(off_h[j]), int(off_h[j + 1])
+                        if a == b:
+                            continue
+                        str_bounds = [
+                            (int(bo[j]), int(bo[j + 1])) for bo in boffs_h
+                        ]
+                        piece = _slice_piece(sorted_cols, a, b, str_bounds)
+                        self.transport.write(
+                            self.shuffle_id, map_id, j, piece, schema)
+                        self.metrics[PARTITION_SIZE].add(b - a)
+            self.metrics[DATA_SIZE].set(self.transport.bytes_written())
+            self._map_done = True
+
+    # -- reduce side -------------------------------------------------------
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        self._run_map_side()
+        pieces = self.transport.fetch(self.shuffle_id, index)
+        if not pieces:
+            return
+        schema = self.output_schema
+        yield self.record_batch(concat_pieces(pieces, schema))
+
+
+class TpuBroadcastExchangeExec(TpuExec):
+    """Materialize the child into one batch every consumer partition reads.
+
+    Reference analog: GpuBroadcastExchangeExecBase
+    (GpuBroadcastExchangeExec.scala:237) — the build side is concatenated
+    once and shared; on one host "broadcast" is reuse of the same
+    device-resident batch (serialized through the host path only when the
+    host transport is configured, mirroring the serialize-for-driver step).
+    """
+
+    def __init__(self, conf: RapidsConf, child: TpuExec):
+        super().__init__(conf, [child])
+        self._built: Optional[ColumnarBatch] = None
+        self._lock = threading.Lock()
+
+    @property
+    def output_schema(self) -> StructType:
+        return self.children[0].output_schema
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def describe(self):
+        return "TpuBroadcastExchangeExec"
+
+    def materialize(self) -> Optional[ColumnarBatch]:
+        with self._lock:
+            if self._built is None:
+                from .join import _concat_all
+
+                built = _concat_all(self.conf, self.children[0])
+                if (
+                    built is not None
+                    and self.conf.get(SHUFFLE_TRANSPORT_CLASS) == "host"
+                ):
+                    from ..shuffle.serializer import (
+                        deserialize_batch,
+                        serialize_batch,
+                    )
+
+                    built = deserialize_batch(serialize_batch(
+                        built, self.conf.get(SHUFFLE_COMPRESSION_CODEC)))
+                self._built = built
+            return self._built
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        b = self.materialize()
+        if b is not None:
+            yield self.record_batch(b)

@@ -50,11 +50,24 @@ def _concat_all(conf, exec_: TpuExec) -> Optional[ColumnarBatch]:
         for b in exec_.execute_partition(p):
             if b.num_rows > 0:
                 batches.append(b)
+    return _concat_batches(exec_.output_schema, batches)
+
+
+def _concat_partition(exec_: TpuExec, index: int) -> Optional[ColumnarBatch]:
+    """Materialize ONE partition of an exec into one batch."""
+    batches = [
+        b for b in exec_.execute_partition(index) if b.num_rows > 0
+    ]
+    return _concat_batches(exec_.output_schema, batches)
+
+
+def _concat_batches(
+    schema: StructType, batches: List[ColumnarBatch]
+) -> Optional[ColumnarBatch]:
     if not batches:
         return None
     if len(batches) == 1:
         return batches[0]
-    schema = exec_.output_schema
     lengths = [b.num_rows for b in batches]
     str_cols = [
         j for j, f in enumerate(schema.fields)
@@ -92,11 +105,15 @@ class TpuShuffledHashJoinExec(TpuExec):
         right_keys: Sequence[E.Expression],
         join_type: str = "inner",
         condition: Optional[E.Expression] = None,
+        partitioned: bool = False,
     ):
         super().__init__(conf, [left, right])
         if join_type not in _JOIN_TYPES:
             raise ValueError(f"unknown join type {join_type}")
         self.join_type = join_type
+        #: True when both sides are co-partitioned by the join keys (the
+        #: planner inserted hash exchanges): build/probe stay per-partition
+        self.partitioned = partitioned
         self.condition = condition
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
@@ -142,7 +159,8 @@ class TpuShuffledHashJoinExec(TpuExec):
     @property
     def num_partitions(self):
         # full outer needs a global unmatched-build pass: single partition
-        if self.join_type == "full":
+        # unless the sides are co-partitioned (unmatched rows stay local)
+        if self.join_type == "full" and not self.partitioned:
             return 1
         return self._probe.num_partitions
 
@@ -162,10 +180,18 @@ class TpuShuffledHashJoinExec(TpuExec):
                 lens.append(max(4, bucket_rows(max(1, m), 4)))
         return tuple(lens)
 
-    def _get_build(self):
-        if self._built is not None:
-            return self._built
-        batch = _concat_all(self.conf, self._build)
+    def _get_build(self, index: Optional[int] = None):
+        """Build-side state; ``index`` keys per-partition builds when the
+        sides are co-partitioned."""
+        if self._built is None:
+            self._built = {}
+        if index in self._built:
+            return self._built[index]
+        batch = (
+            _concat_partition(self._build, index)
+            if index is not None
+            else _concat_all(self.conf, self._build)
+        )
         if batch is None:
             bschema = self._build.output_schema
             batch = ColumnarBatch.from_pydict(
@@ -194,24 +220,26 @@ class TpuShuffledHashJoinExec(TpuExec):
             count = jnp.sum(ok.astype(jnp.int32))
             return sorted_cols, sorted_words, count, live_all
 
-        fn = jax.jit(prep)
+        fn = self._jit_cache_get(
+            ("build", batch_signature(batch), cap, sml), prep)
         sorted_cols, sorted_words, count, live_all = fn(
             vals_of_batch(batch), count_scalar(n))
-        self._built = (
+        built = (
             batch, sorted_cols, sorted_words, int(count), cap, sml, live_all)
-        return self._built
+        self._built[index] = built
+        return built
 
     # -- probe -------------------------------------------------------------
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
         (build_batch, build_cols, build_words, build_count, build_cap, bsml,
-         build_live_all) = self._get_build()
+         build_live_all) = self._get_build(index if self.partitioned else None)
         build_schema = self._build.output_schema
         matched_any = (
             jnp.zeros(build_cap, jnp.bool_) if self.join_type == "full" else None
         )
         probe_parts = (
             range(self._probe.num_partitions)
-            if self.join_type == "full"
+            if self.join_type == "full" and not self.partitioned
             else [index]
         )
         for pi in probe_parts:
@@ -234,14 +262,17 @@ class TpuShuffledHashJoinExec(TpuExec):
         psml = self._key_str_lens(pbatch, self._probe_keys)
         jt = self._jt
 
-        def count_phase(cols, num_rows):
+        # build words/count enter as jit ARGUMENTS (not closure constants):
+        # with per-partition builds the same compiled probe must serve every
+        # partition's build data
+        def count_phase(cols, num_rows, bwords, bcount):
             live = filter_gather.live_of(num_rows, cap)
             keys = [lower(k, cols, cap) for k in self._probe_keys]
             words, any_null = join_ops.radix_key_words(
                 keys, [k.dtype for k in self._probe_keys], psml)
             ok = live & ~any_null
             lo, hi = join_ops.probe_ranges(
-                build_words, jnp.int32(build_count), words, ok)
+                bwords, bcount.astype(jnp.int32), words, ok)
             counts = hi - lo
             if jt in ("semi", "anti"):
                 keep = (counts > 0) if jt == "semi" else (live & (counts == 0))
@@ -255,10 +286,12 @@ class TpuShuffledHashJoinExec(TpuExec):
                 ex_counts = jnp.where(live, counts, 0)
             return lo, counts, ex_counts, live
 
-        ckey = ("count", batch_signature(pbatch), cap, psml)
+        ckey = ("count", batch_signature(pbatch), cap, psml, build_cap,
+                len(build_words))
         fn = self._jit_cache_get(ckey, count_phase)
         lo, counts, aux, live = fn(
-            vals_of_batch(pbatch), count_scalar(pbatch.num_rows_lazy))
+            vals_of_batch(pbatch), count_scalar(pbatch.num_rows_lazy),
+            list(build_words), jnp.int32(build_count))
 
         matched = None
         if self.join_type == "full":

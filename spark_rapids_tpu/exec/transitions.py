@@ -80,28 +80,3 @@ class ColumnarToRowExec(CpuExec):
     def execute_rows_partition(self, index: int) -> Iterator[tuple]:
         for batch in self.tpu_child.execute_partition(index):
             yield from batch.to_rows()
-
-
-class TpuGatherPartitionsExec(TpuExec):
-    """All partitions of the child into one (placeholder single-node
-    exchange; the shuffle layer replaces this with a real exchange exec).
-
-    Reference analog: a ShuffleExchange to a single partition."""
-
-    def __init__(self, conf: RapidsConf, child: TpuExec):
-        super().__init__(conf, [child])
-
-    @property
-    def output_schema(self) -> StructType:
-        return self.children[0].output_schema
-
-    @property
-    def num_partitions(self) -> int:
-        return 1
-
-    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
-        assert index == 0
-        child = self.children[0]
-        for p in range(child.num_partitions):
-            for b in child.execute_partition(p):
-                yield self.record_batch(b)

@@ -60,6 +60,83 @@ def concat_string(
     return StrV(offsets, chars, validity)
 
 
+def concat_pieces_traced(
+    col_parts: Sequence[Sequence[Val]],
+    counts: Sequence[jax.Array],
+    byte_counts: Sequence[Sequence[jax.Array]],
+    out_cap: int,
+    out_char_caps: Sequence[int],
+) -> Tuple[List[Val], jax.Array]:
+    """Concat with TRACED row/byte counts — one XLA program per shape set.
+
+    ``concat_batches_cols`` bakes host lengths into each dispatch, so every
+    distinct length combination compiles a fresh executable; the exchange's
+    reduce side sees arbitrary piece sizes every query and would compile
+    forever. Here counts are operands: placement is masked
+    ``dynamic_update_slice`` at traced starts into a sum-of-capacities work
+    buffer (pieces applied in order, so each row's OWNING piece writes
+    last), then a static head slice. Trace-safe under jit/shard_map.
+    """
+    k = len(col_parts)
+    ncols = len(col_parts[0])
+    counts_arr = jnp.stack([jnp.int32(c) for c in counts])
+    row_offs = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts_arr)])
+    total = row_offs[k]
+
+    def place(parts: Sequence[jax.Array], lens) -> jax.Array:
+        # work buffer >= out_cap so the final head slice never clamps, and
+        # >= sum(caps) so no dynamic_update_slice start ever clamps
+        caps = [int(p.shape[0]) for p in parts]
+        work = jnp.zeros(max(sum(caps), out_cap), parts[0].dtype)
+        for i, p in enumerate(parts):
+            slot = jnp.arange(caps[i], dtype=jnp.int32)
+            masked = jnp.where(slot < lens[i], p, jnp.zeros((), p.dtype))
+            work = lax.dynamic_update_slice(work, masked, (row_offs[i],))
+        return work
+
+    out: List[Val] = []
+    si = 0
+    for j in range(ncols):
+        parts = [cp[j] for cp in col_parts]
+        if isinstance(parts[0], StrV):
+            bc = [byte_counts[i][si] for i in range(k)]
+            out_char_cap = out_char_caps[si]
+            si += 1
+            byte_offs = jnp.concatenate([
+                jnp.zeros(1, jnp.int32),
+                jnp.cumsum(jnp.stack([jnp.int32(b) for b in bc])),
+            ])
+            # per-row lengths placed like fixed data, then offsets by cumsum
+            lens_parts = [p.offsets[1:] - p.offsets[:-1] for p in parts]
+            lens_work = place(lens_parts, counts)[:out_cap]
+            idx = jnp.arange(out_cap, dtype=jnp.int32)
+            lens_work = jnp.where(idx < total, lens_work, 0)
+            offsets = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32),
+                 jnp.cumsum(lens_work).astype(jnp.int32)])
+            char_caps = [int(p.chars.shape[0]) for p in parts]
+            cwork = jnp.zeros(max(sum(char_caps), out_char_cap), jnp.uint8)
+            for i, p in enumerate(parts):
+                slot = jnp.arange(char_caps[i], dtype=jnp.int32)
+                masked = jnp.where(slot < bc[i], p.chars, jnp.uint8(0))
+                cwork = lax.dynamic_update_slice(cwork, masked, (byte_offs[i],))
+            chars = cwork[:out_char_cap]
+            validity = place(
+                [p.validity for p in parts], counts)[:out_cap]
+            validity = validity & (idx < total)
+            out.append(StrV(offsets, chars, validity))
+        else:
+            idx = jnp.arange(out_cap, dtype=jnp.int32)
+            data = place([p.data for p in parts], counts)[:out_cap]
+            validity = place(
+                [p.validity for p in parts], counts)[:out_cap]
+            validity = validity & (idx < total)
+            data = jnp.where(validity, data, jnp.zeros((), data.dtype))
+            out.append(ColV(data, validity))
+    return out, total
+
+
 def concat_batches_cols(
     col_parts: Sequence[Sequence[Val]],
     lengths: Sequence[int],

@@ -1,0 +1,128 @@
+"""Device-to-device row exchange over mesh collectives.
+
+Reference analog: the UCX accelerated shuffle data plane
+(shuffle/RapidsShuffleClient.scala:35-98, BufferSendState windowing over
+bounce buffers) — replaced wholesale by XLA's `lax.all_to_all` over ICI.
+Each shard stable-sorts its rows by target shard (shuffle/partition.py's
+kernel), lays the per-target runs into equal-sized blocks (the all_to_all
+exchange granule — the moral bounce buffer, but in HBM and wired through
+the compiler), swaps blocks chip-to-chip, and compacts what arrived. No
+host staging, no serialization: the wire format IS the column layout.
+
+Everything here is trace-safe inside shard_map: row counts stay device
+scalars throughout.
+
+Fixed-width columns only for now: string columns cross the single-host
+exchange (exec/exchange.py) until a two-phase (lengths, then bytes)
+collective lands.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..expr.eval import ColV
+from ..ops.filter_gather import live_of
+from ..shuffle.partition import partition_cols
+
+
+def all_to_all_exchange(
+    cols: Sequence[ColV],
+    pids: jax.Array,
+    num_rows: Union[int, jax.Array],
+    axis_name: str,
+    n_shards: int,
+    bucket_cap: int = 0,
+) -> Tuple[List[ColV], jax.Array, jax.Array]:
+    """Route each live row to the shard named by ``pids``.
+
+    Runs inside shard_map over ``axis_name``. ``bucket_cap`` is the
+    per-target block size (the exchange granule); 0 means the local
+    capacity — always enough, at the cost of an n_shards x local_cap
+    receive surface. Returns (received cols, received count, ok) where
+    ``ok`` is False iff some block overflowed ``bucket_cap`` (callers pick
+    a bigger granule and retry, like the reference's bounce-buffer
+    windowing retries).
+    """
+    cap = pids.shape[0]
+    B = bucket_cap or cap
+    # 1) partition-sort rows by target shard; offsets stay on device
+    sorted_cols, offsets = partition_cols(cols, pids, num_rows, n_shards)
+    counts = offsets[1:] - offsets[:-1]  # (n_shards,)
+    ok = ~jnp.any(counts > B)
+
+    # 2) scatter the per-target runs into (n_shards * B,) send blocks
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    live_sorted = idx < offsets[n_shards]
+    tgt = jnp.clip(
+        jnp.searchsorted(offsets[1:], idx, side="right"), 0, n_shards - 1
+    ).astype(jnp.int32)
+    slot = idx - jnp.take(offsets, tgt)
+    dest = jnp.where(
+        live_sorted & (slot < B), tgt * B + slot, jnp.int32(n_shards * B)
+    )
+
+    def scatter_block(data: jax.Array) -> jax.Array:
+        z = jnp.zeros(n_shards * B, data.dtype)
+        return z.at[dest].set(data, mode="drop")
+
+    send: List[jax.Array] = []
+    for c in sorted_cols:
+        send.append(scatter_block(c.data))
+        send.append(scatter_block(c.validity))
+
+    # 3) swap block b with shard b (counts ride along)
+    recv = [
+        lax.all_to_all(s.reshape(n_shards, B), axis_name, 0, 0, tiled=False)
+        .reshape(n_shards * B)
+        for s in send
+    ]
+    recv_counts = lax.all_to_all(
+        jnp.minimum(counts, B).reshape(n_shards, 1), axis_name, 0, 0,
+        tiled=False,
+    ).reshape(n_shards)
+    ok = lax.psum(ok.astype(jnp.int32), axis_name) == n_shards
+
+    # 4) compact received blocks to the front
+    j = jnp.arange(n_shards * B, dtype=jnp.int32)
+    block = j // B
+    live_recv = (j % B) < jnp.take(recv_counts, block)
+    from ..ops.filter_gather import filter_cols
+
+    out_cols = [
+        ColV(recv[2 * i], recv[2 * i + 1]) for i in range(len(sorted_cols))
+    ]
+    compacted, total = filter_cols(out_cols, live_recv, None)
+    return compacted, total, ok
+
+
+def gather_all(
+    cols: Sequence[ColV],
+    num_rows: Union[int, jax.Array],
+    axis_name: str,
+) -> Tuple[List[ColV], jax.Array]:
+    """all_gather every shard's rows (the single-partition merge path).
+
+    Each shard's padding slots are compacted out after the gather so the
+    result is dense. Returns replicated (cols, count).
+    """
+    cap = (
+        cols[0].validity.shape[0]
+        if not isinstance(num_rows, jax.Array) or num_rows.ndim == 0
+        else num_rows.shape[0]
+    )
+    live = live_of(num_rows, cap)
+    g_cols = [
+        ColV(
+            lax.all_gather(c.data, axis_name, tiled=True),
+            lax.all_gather(c.validity, axis_name, tiled=True),
+        )
+        for c in cols
+    ]
+    g_live = lax.all_gather(live, axis_name, tiled=True)
+    from ..ops.filter_gather import filter_cols
+
+    return filter_cols(g_cols, g_live, None)

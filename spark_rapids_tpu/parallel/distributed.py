@@ -1,0 +1,204 @@
+"""Distributed SQL operators: shard_map-traceable groupby / sort / join.
+
+These compose the single-chip kernels (ops/groupby.py, ops/sort.py,
+ops/join.py) with the collective exchange (parallel/collective.py) into one
+XLA program per mesh — the TPU-native expression of the reference's
+"PARTIAL aggregate -> shuffle -> FINAL aggregate" / "range partition ->
+local sort" / "hash partition both sides -> local join" plans
+(GpuShuffleExchangeExec.scala:70, GpuSortExec.scala:51,
+GpuShuffleHashJoinExec.scala:23). Where the reference schedules those as
+separate Spark stages with an RDMA shuffle between them, here the whole
+plan is one jitted SPMD computation: XLA schedules the all_to_all against
+compute and nothing touches the host.
+
+All functions run INSIDE shard_map over ``axis_name``; shapes are
+per-shard. Fixed-width columns only (matching the collective exchange).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import types as T
+from ..expr.eval import ColV
+from ..ops import groupby as groupby_ops
+from ..ops import hashing
+from ..ops import join as join_ops
+from ..ops.filter_gather import gather, live_of
+from ..ops.sort import SortOrder, sort_with_radix_keys
+from ..shuffle.partition import count_bounds_le
+from .collective import all_to_all_exchange
+
+
+def dist_groupby(
+    key_cols: Sequence[ColV],
+    key_dtypes: Sequence[T.DataType],
+    value_cols: Sequence[Optional[ColV]],
+    update_ops: Sequence[str],
+    merge_ops: Sequence[str],
+    num_rows: Union[int, jax.Array],
+    axis_name: str,
+    n_shards: int,
+) -> Tuple[List[ColV], List[ColV], jax.Array]:
+    """PARTIAL local aggregate -> key-hash all_to_all -> FINAL merge.
+
+    ``update_ops`` aggregate raw inputs into per-shard partials;
+    ``merge_ops`` combine partial buffers after the exchange (Spark's
+    update/merge split, AggregateFunctions.scala:531). Group keys end up
+    shard-disjoint, so results are the concatenation of every shard's
+    output (each shard returns its own groups + count).
+    """
+    # PARTIAL: local groupby shrinks rows before they cross the wire
+    pkeys, paggs, pn = groupby_ops.groupby_agg(
+        key_cols, key_dtypes, value_cols, list(update_ops), num_rows)
+
+    # exchange by key hash (same murmur3+pmod as the single-host exchange)
+    h = hashing.murmur3(list(pkeys), list(key_dtypes))
+    pids = hashing.partition_ids(h, n_shards)
+    all_cols = list(pkeys) + list(paggs)
+    recvd, rn, _ok = all_to_all_exchange(
+        all_cols, pids, pn, axis_name, n_shards)
+    rkeys = recvd[: len(pkeys)]
+    raggs = recvd[len(pkeys):]
+
+    # FINAL: merge partial buffers locally (keys now shard-disjoint)
+    return groupby_ops.groupby_agg(
+        rkeys, key_dtypes, list(raggs), list(merge_ops), rn)
+
+
+def _sample_bounds(
+    radix_words: Sequence[jax.Array],
+    live: jax.Array,
+    axis_name: str,
+    n_shards: int,
+    samples_per_shard: int = 64,
+) -> List[jax.Array]:
+    """Device-side bound sampling: each shard contributes an evenly-spaced
+    sample of its SORTED keys, samples all_gather, and the (n_shards-1)
+    quantiles become the range bounds (reference: GpuRangePartitioner
+    sketch/determineBounds — but with no driver round-trip)."""
+    cap = radix_words[0].shape[0]
+    n = jnp.sum(live.astype(jnp.int32))
+    # rows are already sorted by key here; sample evenly across live rows
+    pos = (
+        jnp.arange(samples_per_shard, dtype=jnp.int32)
+        * jnp.maximum(n, 1) // samples_per_shard
+    )
+    pos = jnp.clip(pos, 0, cap - 1)
+    has = jnp.arange(samples_per_shard, dtype=jnp.int32) < jnp.minimum(
+        n, samples_per_shard)
+    samples = [jnp.take(w, pos, mode="clip") for w in radix_words]
+
+    g_samples = [lax.all_gather(s, axis_name, tiled=True) for s in samples]
+    g_has = lax.all_gather(has, axis_name, tiled=True)
+    total = samples_per_shard * n_shards
+    # sort gathered samples (dead samples last via the has-rank key)
+    ops_in = [(~g_has).astype(jnp.uint32)] + list(g_samples)
+    sorted_ops = lax.sort(ops_in, num_keys=len(ops_in), is_stable=True)
+    s_words = sorted_ops[1:]
+    g_n = jnp.sum(g_has.astype(jnp.int32))
+    bpos = (
+        jnp.arange(1, n_shards, dtype=jnp.int32) * jnp.maximum(g_n, 1)
+        // n_shards
+    )
+    bpos = jnp.clip(bpos, 0, total - 1)
+    return [jnp.take(w, bpos, mode="clip") for w in s_words]
+
+
+def dist_sort(
+    cols: Sequence[ColV],
+    key_indices: Sequence[int],
+    key_dtypes: Sequence[T.DataType],
+    orders: Sequence[SortOrder],
+    num_rows: Union[int, jax.Array],
+    axis_name: str,
+    n_shards: int,
+) -> Tuple[List[ColV], jax.Array]:
+    """Sample-range exchange + local sort: shard i's rows all precede
+    shard i+1's in the requested order (the global sort contract)."""
+    cap = cols[0].validity.shape[0]
+    live = live_of(num_rows, cap)
+    key_cols = [cols[i] for i in key_indices]
+
+    # local sort FIRST: evenly-spaced positions then sample true quantiles,
+    # and the post-exchange sort of mostly-sorted runs is cheap
+    perm, sorted_radix = sort_with_radix_keys(
+        key_cols, key_dtypes, orders, live)
+    live_sorted = jnp.take(live, perm, mode="clip")
+    sorted_cols = gather(cols, perm, live_sorted)
+
+    bounds = _sample_bounds(sorted_radix, live_sorted, axis_name, n_shards)
+
+    # pid = number of bounds <= row (lexicographic over radix words)
+    pid = count_bounds_le(sorted_radix, bounds, n_shards - 1)
+
+    recvd, rn, _ok = all_to_all_exchange(
+        sorted_cols, pid, live_sorted, axis_name, n_shards)
+
+    rkeys = [recvd[i] for i in key_indices]
+    perm2, _ = sort_with_radix_keys(rkeys, key_dtypes, orders, rn)
+    rcap = recvd[0].validity.shape[0]
+    live2 = jnp.arange(rcap, dtype=jnp.int32) < rn
+    live2_sorted = jnp.take(live2, perm2, mode="clip")
+    return gather(recvd, perm2, live2_sorted), rn
+
+
+def dist_hash_join(
+    left_cols: Sequence[ColV],
+    left_keys: Sequence[int],
+    right_cols: Sequence[ColV],
+    right_keys: Sequence[int],
+    key_dtypes: Sequence[T.DataType],
+    left_rows: Union[int, jax.Array],
+    right_rows: Union[int, jax.Array],
+    axis_name: str,
+    n_shards: int,
+    out_cap: int,
+) -> Tuple[List[ColV], jax.Array, jax.Array]:
+    """Inner equi-join: hash-exchange both sides, join locally.
+
+    ``out_cap`` is the static per-shard output capacity (callers size it
+    from expected selectivity; overflow reports ok=False). Returns
+    (cols = left..right, match count, ok).
+    """
+    def exchange_side(cols, key_ix, rows):
+        kc = [cols[i] for i in key_ix]
+        h = hashing.murmur3(kc, list(key_dtypes))
+        pids = hashing.partition_ids(h, n_shards)
+        return all_to_all_exchange(cols, pids, rows, axis_name, n_shards)
+
+    l_cols, ln, ok1 = exchange_side(left_cols, left_keys, left_rows)
+    r_cols, rn, ok2 = exchange_side(right_cols, right_keys, right_rows)
+
+    # build = right side: sort by key words, probe with binary search
+    rkc = [r_cols[i] for i in right_keys]
+    rwords, r_null = join_ops.radix_key_words(rkc, key_dtypes)
+    rcap = r_cols[0].validity.shape[0]
+    r_live = jnp.arange(rcap, dtype=jnp.int32) < rn
+    ok_rows = r_live & ~r_null
+    order_rank = jnp.where(ok_rows, 0, 1).astype(jnp.uint32)
+    sort_ops = lax.sort(
+        [order_rank] + [w for w in rwords]
+        + [jnp.arange(rcap, dtype=jnp.int32)],
+        num_keys=1 + len(rwords), is_stable=True)
+    perm = sort_ops[-1]
+    sorted_rwords = [jnp.take(w, perm, mode="clip") for w in rwords]
+    sorted_build = gather(r_cols, perm, jnp.take(r_live, perm, mode="clip"))
+    build_count = jnp.sum(ok_rows.astype(jnp.int32))
+
+    lkc = [l_cols[i] for i in left_keys]
+    lwords, l_null = join_ops.radix_key_words(lkc, key_dtypes)
+    lcap = l_cols[0].validity.shape[0]
+    l_live = (jnp.arange(lcap, dtype=jnp.int32) < ln) & ~l_null
+    lo, hi = join_ops.probe_ranges(sorted_rwords, build_count, lwords, l_live)
+    counts = jnp.where(l_live, hi - lo, 0)
+    total = jnp.sum(counts.astype(jnp.int64))
+    ok = ok1 & ok2 & (total <= out_cap)
+
+    p, build_row, slot_live = join_ops.expansion_plan(counts, lo, out_cap)
+    left_out = gather(l_cols, p, slot_live)
+    right_out = gather(sorted_build, build_row, slot_live)
+    return list(left_out) + list(right_out), total.astype(jnp.int32), ok

@@ -37,7 +37,6 @@ from ..exec.base import TpuExec
 from ..exec.transitions import (
     ColumnarToRowExec,
     RowToColumnarExec,
-    TpuGatherPartitionsExec,
 )
 from ..expr import aggregates as A
 from ..expr import expressions as E
@@ -348,17 +347,36 @@ def _tag_aggregate(meta: "PlanMeta") -> None:
     _tag_output_types(meta)
 
 
+def _shuffle_partitions(conf, child) -> int:
+    from ..conf import SHUFFLE_PARTITIONS
+
+    n = conf.get(SHUFFLE_PARTITIONS)
+    return n if n > 0 else child.num_partitions
+
+
 def _convert_aggregate(cpu: C.CpuHashAggregateExec, conf, children):
     child = children[0]
     if child.num_partitions == 1:
         return XA.TpuHashAggregateExec(
             conf, cpu.group_exprs, cpu.agg_exprs, child, A.COMPLETE)
-    # partial per partition -> single-partition exchange -> final merge
+    # partial per partition -> key-hash exchange -> final merge per reduce
+    # partition (reference: GpuHashAggregateExec partial/final split +
+    # GpuShuffleExchangeExec; group keys are partition-disjoint after the
+    # hash exchange so FINAL merges stay partition-local)
+    from ..exec.exchange import TpuShuffleExchangeExec
+    from ..shuffle.partition import HashPartitioning, SinglePartitioning
+
     partial = XA.TpuHashAggregateExec(
         conf, cpu.group_exprs, cpu.agg_exprs, child, A.PARTIAL)
-    gathered = TpuGatherPartitionsExec(conf, partial)
+    nk = len(cpu.group_exprs)
+    if nk == 0:
+        part = SinglePartitioning()
+    else:
+        part = HashPartitioning(
+            list(range(nk)), _shuffle_partitions(conf, child))
+    exchanged = TpuShuffleExchangeExec(conf, partial, part)
     return XA.TpuHashAggregateExec(
-        conf, cpu.group_exprs, cpu.agg_exprs, gathered, A.FINAL)
+        conf, cpu.group_exprs, cpu.agg_exprs, exchanged, A.FINAL)
 
 
 def _sortable(dt: T.DataType) -> bool:
@@ -384,7 +402,34 @@ def _tag_sort(meta: "PlanMeta") -> None:
 def _convert_sort(cpu: C.CpuSortExec, conf, children):
     from ..exec.sort import TpuSortExec
 
-    return TpuSortExec(conf, cpu.sort_exprs, cpu.orders, children[0])
+    child = children[0]
+    if child.num_partitions == 1:
+        return TpuSortExec(conf, cpu.sort_exprs, cpu.orders, child)
+    # global sort over a partitioned child: range-exchange so partitions are
+    # key-ordered, then sort each locally (reference: GpuSortExec global
+    # path = GpuRangePartitioning + local sort)
+    from ..exec.exchange import TpuShuffleExchangeExec
+    from ..ops.sort import SortOrder
+    from ..shuffle.partition import RangePartitioning, SinglePartitioning
+
+    schema = child.output_schema
+    bound = []
+    try:
+        bound = [E.bind_references(e, schema) for e in cpu.sort_exprs]
+    except (ValueError, KeyError):
+        bound = []
+    P = _shuffle_partitions(conf, child)
+    if bound and all(isinstance(b, E.BoundReference) for b in bound) and P > 1:
+        part = RangePartitioning(
+            [b.ordinal for b in bound],
+            [SortOrder(a, nf) for a, nf in cpu.orders],
+            P,
+        )
+    else:
+        part = SinglePartitioning()
+    exchanged = TpuShuffleExchangeExec(conf, child, part)
+    return TpuSortExec(
+        conf, cpu.sort_exprs, cpu.orders, exchanged, global_sort=False)
 
 
 def _tag_join(meta: "PlanMeta") -> None:
@@ -424,8 +469,53 @@ def _convert_join(cpu: C.CpuJoinExec, conf, children):
     )
 
     if not cpu.left_keys:
+        # build side flows through a broadcast exchange (reference:
+        # GpuBroadcastExchangeExec feeding GpuBroadcastNestedLoopJoinExec)
+        from ..exec.exchange import TpuBroadcastExchangeExec
+
         return TpuBroadcastNestedLoopJoinExec(
-            conf, children[0], children[1], cpu.condition)
+            conf, children[0],
+            TpuBroadcastExchangeExec(conf, children[1]), cpu.condition)
+    left, right = children
+    if left.num_partitions > 1 or right.num_partitions > 1:
+        # co-partition both sides by the join keys through hash exchanges
+        # (reference: GpuShuffledHashJoinExec requires HashPartitioning
+        # children); non-column keys fall back to a single partition
+        from ..exec.exchange import TpuShuffleExchangeExec
+        from ..shuffle.partition import HashPartitioning, SinglePartitioning
+
+        lb = rb = None
+        try:
+            lb = [E.bind_references(k, left.output_schema)
+                  for k in cpu.left_keys]
+            rb = [E.bind_references(k, right.output_schema)
+                  for k in cpu.right_keys]
+        except (ValueError, KeyError):
+            pass
+        P = max(_shuffle_partitions(conf, left),
+                _shuffle_partitions(conf, right))
+        plain = (
+            lb is not None and rb is not None
+            and all(isinstance(b, E.BoundReference) for b in lb)
+            and all(isinstance(b, E.BoundReference) for b in rb)
+            # mismatched key dtypes hash differently (Spark casts first);
+            # keep those single-partition until the planner inserts casts
+            and all(l.dtype == r.dtype for l, r in zip(lb, rb))
+        )
+        if plain and P > 1:
+            lpart = HashPartitioning([b.ordinal for b in lb], P)
+            rpart = HashPartitioning([b.ordinal for b in rb], P)
+            partitioned = True
+        else:
+            lpart = SinglePartitioning()
+            rpart = SinglePartitioning()
+            partitioned = False
+        left = TpuShuffleExchangeExec(conf, left, lpart)
+        right = TpuShuffleExchangeExec(conf, right, rpart)
+        return TpuShuffledHashJoinExec(
+            conf, left, right, cpu.left_keys, cpu.right_keys,
+            cpu.join_type, cpu.condition, partitioned=partitioned,
+        )
     return TpuShuffledHashJoinExec(
         conf, children[0], children[1], cpu.left_keys, cpu.right_keys,
         cpu.join_type, cpu.condition,

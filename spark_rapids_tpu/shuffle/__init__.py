@@ -1,0 +1,25 @@
+"""Shuffle layer: partitioners, exchange execs, serializer, transport.
+
+Reference analog: sql-plugin's §2.8 surface — GpuHashPartitioning.scala,
+GpuRangePartitioning.scala, GpuShuffleExchangeExec.scala,
+GpuColumnarBatchSerializer.scala, shuffle/RapidsShuffleTransport.scala.
+TPU re-design: partitioning is ONE stable device sort by partition id
+(cudf's ``table.partition``-style), pieces stay device-resident in a
+catalog for the in-process transport (the UCX device-cache analog), and a
+host-serialized path mirrors the JVM-shuffle fallback serializer.
+"""
+from .partition import (
+    HashPartitioning,
+    Partitioning,
+    RangePartitioning,
+    RoundRobinPartitioning,
+    SinglePartitioning,
+)
+
+__all__ = [
+    "Partitioning",
+    "HashPartitioning",
+    "RangePartitioning",
+    "RoundRobinPartitioning",
+    "SinglePartitioning",
+]

@@ -1,0 +1,176 @@
+"""Host columnar wire format (the JVM-shuffle fallback serializer analog).
+
+Reference analog: GpuColumnarBatchSerializer.scala:51-253 — cudf
+JCudfSerialization host-buffer stream written through the byte shuffle — and
+the TableCompressionCodec SPI (TableCompressionCodec.scala:107-282, nvcomp
+LZ4). Here the wire format is explicit little-endian framing over numpy
+buffers: validity bitpacked 8x, string offsets+bytes as-is, with an optional
+zstd codec (the host stand-in for nvcomp). The native C++ serializer (when
+built) accelerates the same format.
+
+Layout (all little-endian):
+  magic  u32 = 0x54505542 ("TPUB")
+  flags  u8: bit0 = zstd-compressed payload
+  ncols  u16
+  nrows  u32
+  per column header (fixed 8 bytes): type_code u8, precision u8, scale i8,
+    name_len u8, reserved u32; then name bytes (utf-8)
+  payload (possibly compressed as one zstd frame):
+    per column: validity bitpacked ceil(n/8) bytes, then
+      fixed: data[:n] raw
+      string: offsets[:n+1] i32 raw + char bytes
+"""
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import DeviceColumn, HostColumn
+
+MAGIC = 0x54505542
+
+_TYPE_CODES = [
+    (T.NullType, 0),
+    (T.BooleanType, 1),
+    (T.ByteType, 2),
+    (T.ShortType, 3),
+    (T.IntegerType, 4),
+    (T.LongType, 5),
+    (T.FloatType, 6),
+    (T.DoubleType, 7),
+    (T.StringType, 8),
+    (T.BinaryType, 9),
+    (T.DateType, 10),
+    (T.TimestampType, 11),
+    (T.DecimalType, 12),
+]
+_CODE_OF = {cls: code for cls, code in _TYPE_CODES}
+_CLS_OF = {code: cls for cls, code in _TYPE_CODES}
+
+
+def _dtype_header(dt: T.DataType, name: str) -> bytes:
+    code = _CODE_OF[type(dt)]
+    prec = getattr(dt, "precision", 0) or 0
+    scale = getattr(dt, "scale", 0) or 0
+    nm = name.encode("utf-8")[:255]
+    return struct.pack("<BBbBI", code, prec, scale, len(nm), 0) + nm
+
+
+def _read_dtype_header(buf: memoryview, pos: int) -> Tuple[T.DataType, str, int]:
+    code, prec, scale, nlen, _ = struct.unpack_from("<BBbBI", buf, pos)
+    pos += 8
+    name = bytes(buf[pos: pos + nlen]).decode("utf-8")
+    pos += nlen
+    cls = _CLS_OF[code]
+    dt = cls(prec, scale) if cls is T.DecimalType else cls()
+    return dt, name, pos
+
+
+def serialize_host_columns(
+    cols: List[HostColumn], names: List[str], n: int,
+    codec: str = "none",
+) -> bytes:
+    """Serialize host columns (strings as object arrays) to wire bytes."""
+    head = struct.pack(
+        "<IBHI", MAGIC, 1 if codec == "zstd" else 0, len(cols), n)
+    for c, nm in zip(cols, names):
+        head += _dtype_header(c.dtype, nm)
+
+    payload_parts: List[bytes] = []
+    for c in cols:
+        valid = np.asarray(c.validity[:n], dtype=bool)
+        payload_parts.append(np.packbits(valid).tobytes())
+        if isinstance(c.dtype, (T.StringType, T.BinaryType)):
+            bufs = []
+            offsets = np.zeros(n + 1, np.int32)
+            for i in range(n):
+                v = c.data[i]
+                if v is None or not valid[i]:
+                    b = b""
+                elif isinstance(v, bytes):
+                    b = v
+                else:
+                    b = str(v).encode("utf-8")
+                bufs.append(b)
+                offsets[i + 1] = offsets[i] + len(b)
+            payload_parts.append(offsets.tobytes())
+            payload_parts.append(b"".join(bufs))
+        elif isinstance(c.dtype, T.NullType):
+            pass
+        else:
+            payload_parts.append(
+                np.ascontiguousarray(c.data[:n]).tobytes())
+    payload = b"".join(payload_parts)
+    if codec == "zstd":
+        import zstandard
+
+        payload = zstandard.ZstdCompressor(level=1).compress(payload)
+    return head + payload
+
+
+def serialize_batch(batch: ColumnarBatch, codec: str = "none") -> bytes:
+    """Device batch -> wire bytes (one device_get via host_columns)."""
+    hosts = batch.host_columns()
+    names = [f.name for f in batch.schema.fields]
+    return serialize_host_columns(hosts, names, batch.num_rows, codec)
+
+
+def deserialize_batch(data: bytes) -> ColumnarBatch:
+    """Wire bytes -> device batch (uploads via DeviceColumn.from_host)."""
+    buf = memoryview(data)
+    magic, flags, ncols, n = struct.unpack_from("<IBHI", buf, 0)
+    if magic != MAGIC:
+        raise ValueError("bad shuffle stream magic")
+    pos = struct.calcsize("<IBHI")
+    dts: List[T.DataType] = []
+    names: List[str] = []
+    for _ in range(ncols):
+        dt, name, pos = _read_dtype_header(buf, pos)
+        dts.append(dt)
+        names.append(name)
+    payload = bytes(buf[pos:])
+    if flags & 1:
+        import zstandard
+
+        payload = zstandard.ZstdDecompressor().decompress(payload)
+
+    p = 0
+    nvbytes = (n + 7) // 8
+    cols: List[DeviceColumn] = []
+    for dt in dts:
+        valid = np.unpackbits(
+            np.frombuffer(payload, np.uint8, nvbytes, p)
+        )[:n].astype(bool)
+        p += nvbytes
+        if isinstance(dt, (T.StringType, T.BinaryType)):
+            offsets = np.frombuffer(payload, np.int32, n + 1, p)
+            p += 4 * (n + 1)
+            total = int(offsets[n]) if n else 0
+            raw = payload[p: p + total]
+            p += total
+            data_arr = np.empty(n, dtype=object)
+            for i in range(n):
+                if valid[i]:
+                    b = raw[int(offsets[i]): int(offsets[i + 1])]
+                    data_arr[i] = (
+                        b if isinstance(dt, T.BinaryType)
+                        else b.decode("utf-8")
+                    )
+                else:
+                    data_arr[i] = None
+            cols.append(HostColumn(dt, data_arr, valid).to_device())
+        elif isinstance(dt, T.NullType):
+            cols.append(
+                HostColumn(dt, np.zeros(n, bool), valid).to_device())
+        else:
+            npdt = np.dtype(dt.to_numpy())
+            data_arr = np.frombuffer(payload, npdt, n, p).copy()
+            p += npdt.itemsize * n
+            cols.append(HostColumn(dt, data_arr, valid).to_device())
+    schema = T.StructType(tuple(
+        T.StructField(nm, dt) for nm, dt in zip(names, dts)))
+    return ColumnarBatch(cols, schema, n)

@@ -1,0 +1,141 @@
+"""Transport-shaped shuffle storage: device-resident catalog + host bytes.
+
+Reference analog: shuffle/RapidsShuffleTransport.scala:328-411 (the
+transport SPI), ShuffleBufferCatalog.scala (shuffleId -> buffers), and the
+two data paths of §3.4: the UCX device-cache path (batches stay on the
+accelerator) vs the JVM-shuffle host-bytes fallback. On a single TPU host
+the "wire" is process memory; what's preserved is the architecture: map
+tasks write pieces through a transport, reduce tasks fetch by
+(shuffle_id, reduce_id), and the transport decides residency. The
+device transport is what an ICI all-to-all replaces in the SPMD path
+(parallel/collective.py); the serialized transport is the
+GpuColumnarBatchSerializer-equivalent host fallback.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import types as T
+from ..expr.eval import Val
+
+
+@dataclasses.dataclass
+class ShufflePiece:
+    """One (map, reduce) sliced piece: device columns + host row count.
+
+    ``byte_lens[i]`` is the byte length of the i-th string column (in order
+    of appearance) — synced once at the map boundary, the same place the
+    reference syncs contiguousSplit sizes.
+    """
+
+    vals: List[Val]
+    n: int
+    byte_lens: Tuple[int, ...] = ()
+
+
+class ShuffleTransport:
+    """Transport SPI (reference: RapidsShuffleTransport.scala:328)."""
+
+    def write(self, shuffle_id: int, map_id: int, reduce_id: int,
+              piece: ShufflePiece, schema: T.StructType) -> None:
+        raise NotImplementedError
+
+    def fetch(self, shuffle_id: int, reduce_id: int) -> List[ShufflePiece]:
+        """All pieces for a reduce partition, in map order."""
+        raise NotImplementedError
+
+    def bytes_written(self) -> int:
+        return 0
+
+    def release(self, shuffle_id: int) -> None:
+        pass
+
+
+class DeviceShuffleTransport(ShuffleTransport):
+    """Pieces stay device-resident (the UCX device-cache path analog:
+    RapidsCachingWriter stores sliced batches in the device store)."""
+
+    def __init__(self):
+        self._catalog: Dict[Tuple[int, int], List[Tuple[int, ShufflePiece]]] = {}
+        self._lock = threading.Lock()
+
+    def write(self, shuffle_id, map_id, reduce_id, piece, schema):
+        with self._lock:
+            self._catalog.setdefault((shuffle_id, reduce_id), []).append(
+                (map_id, piece))
+
+    def fetch(self, shuffle_id, reduce_id):
+        with self._lock:
+            entries = sorted(
+                self._catalog.get((shuffle_id, reduce_id), ()),
+                key=lambda e: e[0],
+            )
+        return [p for _, p in entries]
+
+    def release(self, shuffle_id):
+        with self._lock:
+            for k in [k for k in self._catalog if k[0] == shuffle_id]:
+                del self._catalog[k]
+
+
+class SerializedShuffleTransport(ShuffleTransport):
+    """Pieces round-trip through the host wire format (the fallback
+    serializer path: GpuColumnarBatchSerializer.scala:51)."""
+
+    def __init__(self, codec: str = "none"):
+        self.codec = codec
+        self._store: Dict[Tuple[int, int], List[Tuple[int, bytes]]] = {}
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    def write(self, shuffle_id, map_id, reduce_id, piece, schema):
+        from ..exec.base import batch_from_vals
+        from .serializer import serialize_batch
+
+        batch = batch_from_vals(piece.vals, schema, piece.n)
+        data = serialize_batch(batch, self.codec)
+        with self._lock:
+            self._bytes += len(data)
+            self._store.setdefault((shuffle_id, reduce_id), []).append(
+                (map_id, data))
+
+    def fetch(self, shuffle_id, reduce_id):
+        from ..exec.base import vals_of_batch
+        from ..expr.eval import StrV
+        from .serializer import deserialize_batch
+
+        with self._lock:
+            entries = sorted(
+                self._store.get((shuffle_id, reduce_id), ()),
+                key=lambda e: e[0],
+            )
+        out = []
+        for _, data in entries:
+            batch = deserialize_batch(data)
+            vals = vals_of_batch(batch)
+            byte_lens = tuple(
+                int(c.offsets[batch.num_rows])
+                for c in batch.columns if c.is_string
+            )
+            out.append(ShufflePiece(vals, batch.num_rows, byte_lens))
+        return out
+
+    def bytes_written(self):
+        return self._bytes
+
+    def release(self, shuffle_id):
+        with self._lock:
+            for k in [k for k in self._store if k[0] == shuffle_id]:
+                del self._store[k]
+
+
+_next_shuffle_id = [0]
+_id_lock = threading.Lock()
+
+
+def new_shuffle_id() -> int:
+    with _id_lock:
+        _next_shuffle_id[0] += 1
+        return _next_shuffle_id[0]
