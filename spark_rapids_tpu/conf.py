@@ -209,8 +209,8 @@ CLOUD_SCHEMES = conf(
 CSV_ENABLED = conf(
     "spark.rapids.tpu.sql.format.csv.enabled", True, "Enable TPU CSV scan.")
 ORC_ENABLED = conf(
-    "spark.rapids.tpu.sql.format.orc.enabled", False,
-    "ORC support (not yet implemented; scans fall back to CPU).")
+    "spark.rapids.tpu.sql.format.orc.enabled", True,
+    "Enable TPU ORC scan (per-stripe splits via the host arrow reader).")
 
 # ---------------------------------------------------------------------------
 # Test hooks (reference: RapidsConf 'test' keys)

@@ -90,6 +90,71 @@ class CpuScanExec(CpuExec):
         yield from self._partitions[index]
 
 
+class CpuFileScanExec(CpuExec):
+    """Row-based file scan — fallback path AND differential oracle for the
+    TPU file scan. Values decode through the SAME numpy conversion as the
+    device path (io/arrow_convert) so both engines agree on the value
+    model (DATE = int days, TIMESTAMP = int micros, DECIMAL = unscaled)."""
+
+    def __init__(self, conf: RapidsConf, scanner, fmt: str):
+        super().__init__(conf)
+        self.scanner = scanner
+        self.fmt = fmt
+
+    @property
+    def output_schema(self):
+        return self.scanner.schema
+
+    @property
+    def num_partitions(self):
+        return max(1, self.scanner.num_splits())
+
+    def describe(self):
+        return f"CpuFileScanExec({self.fmt})"
+
+    def execute_rows_partition(self, index: int) -> Iterator[tuple]:
+        from ..io.arrow_convert import _np_from_arrow_array
+
+        if index >= self.scanner.num_splits():
+            return
+        table, pvals = self.scanner.read_split_i(index)
+        schema = self.output_schema
+        npart = len(pvals)
+        file_fields = schema.fields[: len(schema.fields) - npart]
+        n = table.num_rows
+        cols: List[List[Any]] = []
+        for f, name in zip(file_fields, table.column_names):
+            import pyarrow as pa
+
+            arr = table.column(name)
+            if isinstance(arr, pa.ChunkedArray):
+                if arr.num_chunks == 0:
+                    arr = pa.array([], type=table.schema.field(name).type)
+                else:
+                    arr = arr.combine_chunks()
+            parts = _np_from_arrow_array(arr, f.dataType)
+            vals: List[Any] = []
+            if len(parts) == 3:
+                offsets, chars, validity = parts
+                raw = chars.tobytes()
+                for i in range(n):
+                    if validity[i]:
+                        b = raw[int(offsets[i]): int(offsets[i + 1])]
+                        vals.append(
+                            b if isinstance(f.dataType, T.BinaryType)
+                            else b.decode("utf-8"))
+                    else:
+                        vals.append(None)
+            else:
+                data, validity = parts
+                for i in range(n):
+                    vals.append(data[i].item() if validity[i] else None)
+            cols.append(vals)
+        for _, v in pvals:
+            cols.append([None if v is None else str(v)] * n)
+        yield from zip(*cols) if cols else iter(())
+
+
 class CpuRangeExec(CpuExec):
     def __init__(self, conf: RapidsConf, start: int, end: int, step: int = 1,
                  num_slices: int = 1, name: str = "id"):

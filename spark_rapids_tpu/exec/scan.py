@@ -1,0 +1,92 @@
+"""File-source scan exec: splits -> device batches.
+
+Reference analog: GpuFileSourceScanExec.scala (569) + PartitionReaderIterator
++ ColumnarPartitionReaderWithPartitionValues (constant partition columns).
+The host half (footer parse, prune, column-chunk read) happened in the
+scanner; here each split's arrow table uploads buffer-level and partition
+values append as constant device columns.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..columnar import ColumnarBatch
+from ..columnar.column import DeviceColumn
+from ..conf import RapidsConf
+from ..types import StructType
+from ..utils.bucketing import bucket_rows
+from .base import TOTAL_TIME, TpuExec, timed
+
+SCAN_TIME = "scanTime"  # reference metric name (GpuMetricNames)
+DECODE_TIME = "tpuDecodeTime"
+
+
+def constant_string_column(value, n: int, cap: int) -> DeviceColumn:
+    """One value repeated n times (partition-value column) — O(1) python."""
+    import jax.numpy as jnp
+
+    if value is None:
+        return DeviceColumn(
+            T.STRING, n, None, jnp.zeros(cap, bool),
+            offsets=jnp.zeros(cap + 1, jnp.int32),
+            chars=jnp.zeros(1, jnp.uint8))
+    b = str(value).encode("utf-8")
+    L = len(b)
+    ccap = bucket_rows(max(1, L * n), 128)
+    offsets = np.minimum(np.arange(cap + 1, dtype=np.int64) * L,
+                         L * n).astype(np.int32)
+    chars = np.zeros(ccap, np.uint8)
+    if L:
+        chars[: L * n] = np.frombuffer(b * n, np.uint8)
+    valid = np.zeros(cap, bool)
+    valid[:n] = True
+    return DeviceColumn(
+        T.STRING, n, None, jnp.asarray(valid),
+        offsets=jnp.asarray(offsets), chars=jnp.asarray(chars))
+
+
+class TpuFileSourceScanExec(TpuExec):
+    """Columnar scan over a file scanner's splits (one split = one
+    partition; the MULTITHREADED reader prefetches neighbors)."""
+
+    def __init__(self, conf: RapidsConf, scanner, fmt: str):
+        super().__init__(conf)
+        self.scanner = scanner
+        self.fmt = fmt
+        self.metrics[SCAN_TIME] = self.metric(SCAN_TIME)
+        self.metrics[DECODE_TIME] = self.metric(DECODE_TIME)
+
+    @property
+    def output_schema(self) -> StructType:
+        return self.scanner.schema
+
+    @property
+    def num_partitions(self) -> int:
+        return max(1, self.scanner.num_splits())
+
+    def describe(self):
+        return f"TpuFileSourceScanExec {self.fmt} {getattr(self.scanner, 'path', '')}"
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        from ..io.arrow_convert import arrow_to_batch
+
+        if index >= self.scanner.num_splits():
+            return
+        with timed(self.metrics[SCAN_TIME]):
+            table, pvals = self.scanner.read_split_i(index)
+        with timed(self.metrics[DECODE_TIME]):
+            schema = self.output_schema
+            npart = len(pvals)
+            file_fields = schema.fields[: len(schema.fields) - npart]
+            batch = arrow_to_batch(
+                table, T.StructType(tuple(file_fields)))
+            if npart:
+                n, cap = batch.num_rows, max(batch.capacity, 1)
+                cols = list(batch.columns)
+                for _, v in pvals:
+                    cols.append(constant_string_column(v, n, cap))
+                batch = ColumnarBatch(cols, schema, n)
+        yield self.record_batch(batch)

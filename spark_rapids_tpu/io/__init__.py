@@ -1,0 +1,13 @@
+"""I/O layer: file scans (parquet/CSV/ORC) and writers.
+
+Reference analog: §2.6 — GpuParquetScan.scala (CPU footer parse +
+row-group prune + device decode), GpuOrcScan.scala, GpuBatchScanExec CSV,
+GpuParquetFileFormat writers, partition-value attachment
+(ColumnarPartitionReaderWithPartitionValues.scala). On TPU the host-side
+half is pyarrow (the reference also parses footers and prunes on the CPU:
+GpuParquetScan.scala:289-300); the device half is a buffer-level arrow ->
+device-column upload with no per-row Python.
+"""
+from .arrow_convert import arrow_to_batch, batch_to_arrow
+
+__all__ = ["arrow_to_batch", "batch_to_arrow"]

@@ -1,0 +1,199 @@
+"""Buffer-level Arrow <-> device column conversion.
+
+Reference analog: HostColumnarToGpu.scala (arrow-backed host columnar ->
+device upload) and GpuColumnVector.from(Table). The device layout IS
+Arrow (data + validity, offsets + chars for strings), so conversion is
+numpy buffer reshaping + one upload per column — never a per-row loop.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import DeviceColumn
+from ..utils.bucketing import bucket_rows
+
+
+def arrow_type_to_tpu(at) -> T.DataType:
+    import pyarrow as pa
+
+    if pa.types.is_boolean(at):
+        return T.BOOLEAN
+    if pa.types.is_int8(at):
+        return T.BYTE
+    if pa.types.is_int16(at):
+        return T.SHORT
+    if pa.types.is_int32(at):
+        return T.INT
+    if pa.types.is_int64(at):
+        return T.LONG
+    if pa.types.is_float32(at):
+        return T.FLOAT
+    if pa.types.is_float64(at):
+        return T.DOUBLE
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return T.STRING
+    if pa.types.is_binary(at) or pa.types.is_large_binary(at):
+        return T.BINARY
+    if pa.types.is_date32(at):
+        return T.DATE
+    if pa.types.is_timestamp(at):
+        return T.TIMESTAMP
+    if pa.types.is_decimal(at):
+        if at.precision > T.DecimalType.MAX_PRECISION:
+            raise TypeError(
+                f"decimal precision {at.precision} > 18 not supported")
+        return T.DecimalType(at.precision, at.scale)
+    raise TypeError(f"unsupported arrow type {at}")
+
+
+def arrow_schema_to_tpu(schema) -> T.StructType:
+    return T.StructType(tuple(
+        T.StructField(f.name, arrow_type_to_tpu(f.type), f.nullable)
+        for f in schema
+    ))
+
+
+def _np_from_arrow_array(arr, dt: T.DataType) -> Tuple[np.ndarray, ...]:
+    """(data, validity) or (offsets, chars, validity) numpy views."""
+    import pyarrow as pa
+
+    n = len(arr)
+    validity = np.ones(n, bool) if arr.null_count == 0 else ~np.asarray(
+        arr.is_null())
+    if isinstance(dt, (T.StringType, T.BinaryType)):
+        if pa.types.is_large_string(arr.type) or pa.types.is_large_binary(arr.type):
+            arr = arr.cast(
+                pa.string() if isinstance(dt, T.StringType) else pa.binary())
+        # slice-safe: combine offsets relative to the slice start
+        off_buf = arr.buffers()[1]
+        data_buf = arr.buffers()[2]
+        offsets = np.frombuffer(off_buf, np.int32,
+                                n + 1 + arr.offset)[arr.offset:]
+        chars_all = (
+            np.frombuffer(data_buf, np.uint8) if data_buf is not None
+            else np.zeros(0, np.uint8)
+        )
+        start = int(offsets[0])
+        end = int(offsets[n])
+        return (offsets - start, chars_all[start:end], validity)
+    if isinstance(dt, T.TimestampType):
+        import pyarrow as pa
+
+        arr = arr.cast(pa.timestamp("us"))
+        data = np.asarray(arr.view(pa.int64()))
+        return (np.where(validity, data, 0).astype(np.int64), validity)
+    if isinstance(dt, T.DateType):
+        import pyarrow as pa
+
+        data = np.asarray(arr.view(pa.int32()))
+        return (np.where(validity, data, 0).astype(np.int32), validity)
+    if isinstance(dt, T.DecimalType):
+        data = _decimal_to_int64(arr, dt)
+        return (np.where(validity, data, 0), validity)
+    if isinstance(dt, T.BooleanType):
+        data = np.asarray(arr.cast("bool").fill_null(False))
+        return (data.astype(bool), validity)
+    np_dt = np.dtype(dt.to_numpy())
+    # fill_null avoids NaN poison in padding; cheap on host
+    try:
+        filled = arr.fill_null(0)
+    except Exception:
+        filled = arr
+    data = np.asarray(filled).astype(np_dt, copy=False)
+    return (data, validity)
+
+
+def _decimal_to_int64(arr, dt: T.DecimalType) -> np.ndarray:
+    """decimal128 -> unscaled int64 (precision <= 18 fits)."""
+    import pyarrow as pa
+
+    i128 = np.frombuffer(arr.buffers()[1], np.int64)
+    lo = i128[0::2][arr.offset: arr.offset + len(arr)]
+    return lo.copy()
+
+
+def arrow_to_batch(table_or_rb, schema: Optional[T.StructType] = None,
+                   capacity: Optional[int] = None) -> ColumnarBatch:
+    """pyarrow Table/RecordBatch -> device ColumnarBatch (one upload per
+    buffer; capacity bucketed so XLA executables are shared)."""
+    import jax.numpy as jnp
+    import pyarrow as pa
+
+    if isinstance(table_or_rb, pa.Table):
+        table_or_rb = table_or_rb.combine_chunks()
+        arrays = [
+            c.chunk(0) if c.num_chunks else pa.array([], type=c.type)
+            for c in table_or_rb.columns
+        ]
+        a_schema = table_or_rb.schema
+    else:
+        arrays = table_or_rb.columns
+        a_schema = table_or_rb.schema
+    if schema is None:
+        schema = arrow_schema_to_tpu(a_schema)
+    n = table_or_rb.num_rows
+    cap = capacity or bucket_rows(max(1, n))
+    cols: List[DeviceColumn] = []
+    for arr, f in zip(arrays, schema.fields):
+        dt = f.dataType
+        parts = _np_from_arrow_array(arr, dt)
+        if len(parts) == 3:
+            offsets, chars, validity = parts
+            nb = int(offsets[n]) if n else 0
+            ccap = bucket_rows(max(1, nb), 128)
+            o = np.zeros(cap + 1, np.int32)
+            o[: n + 1] = offsets[: n + 1]
+            o[n + 1:] = nb
+            ch = np.zeros(ccap, np.uint8)
+            ch[:nb] = chars[:nb]
+            v = np.zeros(cap, bool)
+            v[:n] = validity
+            cols.append(DeviceColumn(
+                dt, n, None, jnp.asarray(v),
+                offsets=jnp.asarray(o), chars=jnp.asarray(ch)))
+        else:
+            data, validity = parts
+            d = np.zeros(cap, data.dtype)
+            d[:n] = data
+            v = np.zeros(cap, bool)
+            v[:n] = validity
+            d[:n] = np.where(validity, data, np.zeros(1, data.dtype))
+            cols.append(DeviceColumn(
+                dt, n, jnp.asarray(d), jnp.asarray(v)))
+    return ColumnarBatch(cols, schema, n)
+
+
+def batch_to_arrow(batch: ColumnarBatch):
+    """Device ColumnarBatch -> pyarrow Table (for writers / interop)."""
+    import pyarrow as pa
+
+    hosts = batch.host_columns()
+    n = batch.num_rows
+    arrays = []
+    names = []
+    for f, h in zip(batch.schema.fields, hosts):
+        names.append(f.name)
+        dt = f.dataType
+        mask = ~h.validity[:n]
+        if isinstance(dt, (T.StringType, T.BinaryType)):
+            at = pa.string() if isinstance(dt, T.StringType) else pa.binary()
+            arrays.append(pa.array(list(h.data[:n]), type=at))
+        elif isinstance(dt, T.DateType):
+            arrays.append(pa.array(
+                h.data[:n].astype(np.int32), type=pa.int32(),
+                mask=mask).cast(pa.date32()))
+        elif isinstance(dt, T.TimestampType):
+            arrays.append(pa.array(
+                h.data[:n].astype(np.int64), type=pa.int64(),
+                mask=mask).cast(pa.timestamp("us", tz="UTC")))
+        elif isinstance(dt, T.DecimalType):
+            arrays.append(pa.array(
+                h.data[:n].astype(np.int64), type=pa.int64(), mask=mask
+            ).cast(pa.decimal128(dt.precision, dt.scale)))
+        else:
+            arrays.append(pa.array(h.data[:n], mask=mask))
+    return pa.table(dict(zip(names, arrays)))

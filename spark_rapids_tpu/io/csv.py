@@ -1,0 +1,78 @@
+"""CSV scan (reference: GpuBatchScanExec.scala:511 CSVScan + cudf readCSV).
+
+pyarrow.csv parses on the host (the reference buffers on the host then
+decodes on the device; a TPU has no byte-wrangling advantage for CSV so
+the parse stays host-side), then the standard buffer-level upload.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from .. import types as T
+from ..conf import RapidsConf
+from .arrow_convert import arrow_schema_to_tpu
+from .parquet import discover_files
+
+
+_ARROW_OF = None
+
+
+def _arrow_type(dt: T.DataType):
+    import pyarrow as pa
+
+    m = {
+        T.BOOLEAN: pa.bool_(), T.BYTE: pa.int8(), T.SHORT: pa.int16(),
+        T.INT: pa.int32(), T.LONG: pa.int64(), T.FLOAT: pa.float32(),
+        T.DOUBLE: pa.float64(), T.STRING: pa.string(),
+        T.DATE: pa.date32(), T.TIMESTAMP: pa.timestamp("us", tz="UTC"),
+    }
+    return m[dt]
+
+
+class CsvScanner:
+    """One split per file; schema given or inferred from the first file."""
+
+    def __init__(self, path: str, conf: RapidsConf,
+                 schema: Optional[T.StructType] = None,
+                 header: bool = True, sep: str = ","):
+        self.conf = conf
+        self.header = header
+        self.sep = sep
+        self.files = discover_files(path)
+        if not self.files:
+            raise FileNotFoundError(path)
+        self.user_schema = schema
+        if schema is None:
+            t = self._read(self.files[0][0])
+            self.schema = arrow_schema_to_tpu(t.schema)
+        else:
+            self.schema = schema
+
+    def _read(self, fpath: str):
+        import pyarrow.csv as pacsv
+
+        ropts = pacsv.ReadOptions(autogenerate_column_names=not self.header)
+        popts = pacsv.ParseOptions(delimiter=self.sep)
+        copts = None
+        if self.user_schema is not None:
+            if not self.header:
+                ropts = pacsv.ReadOptions(
+                    column_names=[f.name for f in self.user_schema.fields])
+            copts = pacsv.ConvertOptions(column_types={
+                f.name: _arrow_type(f.dataType)
+                for f in self.user_schema.fields
+                if not isinstance(f.dataType, (T.BinaryType, T.DecimalType))
+            })
+        return pacsv.read_csv(
+            fpath, read_options=ropts, parse_options=popts,
+            convert_options=copts)
+
+    def num_splits(self) -> int:
+        return len(self.files)
+
+    def read_split(self, i: int):
+        return self._read(self.files[i][0])
+
+    def read_split_i(self, i: int):
+        """(pyarrow table, partition values): unified scanner protocol."""
+        return self._read(self.files[i][0]), ()

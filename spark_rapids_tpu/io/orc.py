@@ -1,0 +1,54 @@
+"""ORC scan (reference: GpuOrcScan.scala:924 — same CPU-prune/device-decode
+pattern as parquet, single-file reader). pyarrow.orc reads stripes on the
+host; upload is the shared buffer-level path.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .. import types as T
+from ..conf import RapidsConf
+from .arrow_convert import arrow_schema_to_tpu
+from .parquet import discover_files
+
+
+class OrcScanner:
+    """One split per (file, stripe)."""
+
+    def __init__(self, path: str, conf: RapidsConf,
+                 columns: Optional[Sequence[str]] = None):
+        from pyarrow import orc
+
+        self.conf = conf
+        self.files = discover_files(path)
+        if not self.files:
+            raise FileNotFoundError(path)
+        f0 = orc.ORCFile(self.files[0][0])
+        self.file_schema = f0.schema
+        self.columns = list(columns) if columns is not None else [
+            self.file_schema.field(i).name
+            for i in range(len(self.file_schema.names))
+        ]
+        self.schema = arrow_schema_to_tpu(
+            self.file_schema.empty_table().select(self.columns).schema)
+        self._splits = [
+            (fp, s)
+            for fp, _ in self.files
+            for s in range(orc.ORCFile(fp).nstripes)
+        ] or [(self.files[0][0], None)]
+
+    def num_splits(self) -> int:
+        return len(self._splits)
+
+    def read_split(self, i: int):
+        from pyarrow import orc
+
+        fp, stripe = self._splits[i]
+        f = orc.ORCFile(fp)
+        if stripe is None:
+            return f.schema.empty_table().select(self.columns)
+        return f.read_stripe(stripe, columns=self.columns)
+
+    def read_split_i(self, i: int):
+        """(pyarrow table, partition values): unified scanner protocol."""
+        return self.read_split(i), ()

@@ -1,0 +1,332 @@
+"""Parquet scan: footer parse, row-group pruning, three reader strategies.
+
+Reference analog: GpuParquetScan.scala —
+  * CPU-side footer parse + row-group/column prune:
+    GpuParquetFileFilterHandler.filterBlocks (:289-352);
+  * PERFILE / COALESCING (MultiFileParquetPartitionReader :880) /
+    MULTITHREADED cloud reader (MultiFileCloudParquetPartitionReader :1299)
+    selected by reader-type conf + cloudSchemes (RapidsConf.scala:546-577);
+  * partition values attached as constant columns
+    (ColumnarPartitionReaderWithPartitionValues.scala).
+
+Here pyarrow does the host half (exactly the role the CPU plays in the
+reference) and the device half is the buffer-level upload in
+arrow_convert.py. A "split" is the unit of data parallelism: one or more
+(file, row-group) runs that execute as one partition.
+"""
+from __future__ import annotations
+
+import dataclasses
+import glob as _glob
+import os
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from .. import types as T
+from ..conf import (
+    CLOUD_SCHEMES,
+    MAX_READER_BATCH_SIZE_BYTES,
+    PARQUET_MULTITHREAD_READ_NUM_THREADS,
+    PARQUET_READER_TYPE,
+    RapidsConf,
+)
+from .arrow_convert import arrow_schema_to_tpu
+
+
+@dataclasses.dataclass(frozen=True)
+class PushedFilter:
+    """A col-vs-literal conjunct usable for row-group stat pruning
+    (reference: the parquet filter pushdown in filterBlocks)."""
+
+    column: str
+    op: str  # one of < <= > >= = != isnull notnull
+    value: Any = None
+
+
+@dataclasses.dataclass
+class FileSplit:
+    """One scan partition: runs of row groups, plus partition values."""
+
+    path: str
+    row_groups: Tuple[int, ...]
+    partition_values: Tuple[Tuple[str, Any], ...] = ()
+
+
+def _is_cloud_path(path: str, conf: RapidsConf) -> bool:
+    scheme = path.split("://", 1)[0] if "://" in path else ""
+    return scheme in conf.get(CLOUD_SCHEMES).split(",")
+
+
+def discover_files(path: str) -> List[Tuple[str, Tuple[Tuple[str, Any], ...]]]:
+    """Expand a file/directory/glob into (file, hive partition values).
+
+    Directory layouts with key=value components attach partition values
+    (reference: partition-value columns in the V1 read bridges).
+    """
+    paths: List[str]
+    if os.path.isdir(path):
+        paths = sorted(
+            p for p in _glob.glob(os.path.join(path, "**", "*"),
+                                  recursive=True)
+            if os.path.isfile(p) and not os.path.basename(p).startswith(
+                ("_", "."))
+        )
+    elif any(c in path for c in "*?["):
+        paths = sorted(p for p in _glob.glob(path) if os.path.isfile(p))
+    else:
+        paths = [path]
+    out = []
+    base = path.rstrip("/")
+    for p in paths:
+        pvals: List[Tuple[str, Any]] = []
+        rel = os.path.relpath(p, base) if os.path.isdir(base) else ""
+        for comp in rel.split(os.sep)[:-1]:
+            if "=" in comp:
+                k, v = comp.split("=", 1)
+                pvals.append((k, None if v == "__HIVE_DEFAULT_PARTITION__"
+                              else v))
+        out.append((p, tuple(pvals)))
+    return out
+
+
+def _stats_allow(stats, f: PushedFilter) -> bool:
+    """Can this row group contain rows passing the filter? Conservative:
+    True when unknown (reference: filterBlocks keeps unprunable blocks)."""
+    if stats is None or not stats.has_min_max:
+        return f.op not in ("isnull",) or stats is None or (
+            stats.null_count is None or stats.null_count > 0)
+    mn, mx = stats.min, stats.max
+    v = f.value
+    try:
+        if f.op == "=":
+            return mn <= v <= mx
+        if f.op == "<":
+            return mn < v
+        if f.op == "<=":
+            return mn <= v
+        if f.op == ">":
+            return mx > v
+        if f.op == ">=":
+            return mx >= v
+        if f.op == "isnull":
+            return stats.null_count is None or stats.null_count > 0
+        if f.op == "notnull":
+            return stats.num_values is None or stats.num_values > 0
+    except TypeError:
+        return True
+    return True
+
+
+def prune_row_groups(pf, filters: Sequence[PushedFilter]) -> List[int]:
+    """Row groups that may contain matching rows (min/max/null stats)."""
+    md = pf.metadata
+    name_to_idx = {md.schema.column(i).path: i
+                   for i in range(md.num_columns)}
+    keep = []
+    for rg in range(md.num_row_groups):
+        rgmd = md.row_group(rg)
+        ok = True
+        for f in filters:
+            ci = name_to_idx.get(f.column)
+            if ci is None:
+                continue
+            stats = rgmd.column(ci).statistics
+            if not _stats_allow(stats, f):
+                ok = False
+                break
+        if ok:
+            keep.append(rg)
+    return keep
+
+
+class ParquetScanner:
+    """Plans splits and reads them as pyarrow tables."""
+
+    def __init__(self, path: str, conf: RapidsConf,
+                 columns: Optional[Sequence[str]] = None,
+                 filters: Sequence[PushedFilter] = ()):
+        import pyarrow.parquet as pq
+
+        self.path = path
+        self.conf = conf
+        self.filters = list(filters)
+        self.files = discover_files(path)
+        if not self.files:
+            raise FileNotFoundError(path)
+        first = pq.ParquetFile(self.files[0][0])
+        self.file_schema = first.schema_arrow
+        self.columns = list(columns) if columns is not None else [
+            f.name for f in self.file_schema
+        ]
+        # partition columns come from directory names (string-typed);
+        # only keys present on EVERY file become schema columns (ragged
+        # layouts keep the common prefix)
+        if self.files[0][1]:
+            common = [k for k, _ in self.files[0][1]]
+            for _, pv in self.files[1:]:
+                keys = {k for k, _ in pv}
+                common = [k for k in common if k in keys]
+            self.partition_cols = common
+        else:
+            self.partition_cols = []
+        base = arrow_schema_to_tpu(
+            self.file_schema.empty_table().select(self.columns).schema)
+        fields = list(base.fields)
+        for k in self.partition_cols:
+            fields.append(T.StructField(k, T.STRING, True))
+        self.schema = T.StructType(tuple(fields))
+        self._splits: Optional[List[FileSplit]] = None
+
+    # -- planning ----------------------------------------------------------
+    def reader_type(self) -> str:
+        rt = self.conf.get(PARQUET_READER_TYPE)
+        if rt != "AUTO":
+            return rt
+        return (
+            "MULTITHREADED"
+            if _is_cloud_path(self.path, self.conf) else "COALESCING"
+        )
+
+    def splits(self) -> List[FileSplit]:
+        """Partition the scan: row-group pruning + file coalescing.
+
+        PERFILE: one split per file. COALESCING: files/row-groups packed
+        into splits up to the reader batch byte target. MULTITHREADED:
+        per-file splits read with a thread pool at execute time.
+        """
+        if self._splits is not None:
+            return self._splits
+        import pyarrow.parquet as pq
+
+        target = self.conf.get(MAX_READER_BATCH_SIZE_BYTES)
+        rt = self.reader_type()
+        splits: List[FileSplit] = []
+        pending: List[FileSplit] = []
+        pending_bytes = 0
+        for fpath, pvals in self.files:
+            pf = pq.ParquetFile(fpath)
+            keep = prune_row_groups(pf, self.filters)
+            if not keep:
+                continue
+            if rt in ("PERFILE", "MULTITHREADED"):
+                splits.append(FileSplit(fpath, tuple(keep), pvals))
+                continue
+            # COALESCING: pack row-group runs up to the byte target
+            md = pf.metadata
+            for rg in keep:
+                sz = md.row_group(rg).total_byte_size
+                if pending and pending_bytes + sz > target:
+                    splits.extend(_merge_pending(pending))
+                    pending, pending_bytes = [], 0
+                pending.append(FileSplit(fpath, (rg,), pvals))
+                pending_bytes += sz
+        if pending:
+            splits.extend(_merge_pending(pending))
+        if not splits:
+            # fully pruned: one empty split keeps the schema flowing
+            splits = [FileSplit(self.files[0][0], (), self.files[0][1])]
+        self._splits = splits
+        return splits
+
+    # -- reading -----------------------------------------------------------
+    def read_split(self, split: FileSplit):
+        """One split -> pyarrow Table (file columns only)."""
+        import pyarrow.parquet as pq
+
+        pf = pq.ParquetFile(split.path)
+        file_cols = [c for c in self.columns if c not in split_pcols(split)]
+        if not split.row_groups:
+            return pf.schema_arrow.empty_table().select(file_cols)
+        t = pf.read_row_groups(list(split.row_groups), columns=file_cols)
+        return t
+
+    # unified scanner protocol (shared with CsvScanner/OrcScanner)
+    def num_splits(self) -> int:
+        return len(self.splits())
+
+    def read_split_i(self, i: int):
+        """(pyarrow table, partition values) for split i."""
+        s = self.splits()[i]
+        return self.read_split(s), s.partition_values
+
+    def read_splits_threaded(self, splits: Sequence[FileSplit]):
+        """MULTITHREADED cloud reader: buffer files in a thread pool
+        (reference: MultiFileCloudParquetPartitionReader :1299-1333)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        nthreads = self.conf.get(PARQUET_MULTITHREAD_READ_NUM_THREADS)
+        with ThreadPoolExecutor(max_workers=nthreads) as pool:
+            yield from pool.map(self.read_split, splits)
+
+
+def split_pcols(split: FileSplit) -> List[str]:
+    return [k for k, _ in split.partition_values]
+
+
+def _merge_pending(pending: List[FileSplit]) -> List[FileSplit]:
+    """Merge same-file consecutive row-group splits; distinct files stay
+    separate splits but the exec treats a pending group as one partition.
+    """
+    out: List[FileSplit] = []
+    for s in pending:
+        if (out and out[-1].path == s.path
+                and out[-1].partition_values == s.partition_values):
+            out[-1] = FileSplit(
+                s.path, out[-1].row_groups + s.row_groups,
+                s.partition_values)
+        else:
+            out.append(s)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# writer (reference: GpuParquetFileFormat.scala + GpuFileFormatWriter)
+# ---------------------------------------------------------------------------
+def write_parquet(
+    batches, path: str, schema: T.StructType,
+    compression: str = "snappy",
+) -> Dict[str, int]:
+    """Chunked parquet write with a temp-file commit protocol.
+
+    Reference analog: cudf chunked writer + GpuFileFormatWriter.scala:339's
+    commit semantics (write temp, rename on success). Returns write stats
+    (BasicColumnarWriteStatsTracker analog).
+    """
+    import pyarrow.parquet as pq
+
+    from .arrow_convert import batch_to_arrow
+
+    tmp = path + "._temporary"
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    writer = None
+    rows = 0
+    nbatches = 0
+    try:
+        for b in batches:
+            t = batch_to_arrow(b)
+            if writer is None:
+                writer = pq.ParquetWriter(
+                    tmp, t.schema, compression=compression)
+            writer.write_table(t)
+            rows += t.num_rows
+            nbatches += 1
+        if writer is None:
+            import pyarrow as pa
+
+            from .arrow_convert import batch_to_arrow as _b2a
+            from ..columnar.batch import ColumnarBatch
+
+            empty = ColumnarBatch.from_pydict(
+                {f.name: [] for f in schema.fields}, schema)
+            t = _b2a(empty)
+            writer = pq.ParquetWriter(tmp, t.schema, compression=compression)
+            writer.write_table(t)
+        writer.close()
+        writer = None
+        os.replace(tmp, path)  # commit
+    finally:
+        if writer is not None:
+            writer.close()
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    return {"numRows": rows, "numBatches": nbatches,
+            "bytes": os.path.getsize(path)}

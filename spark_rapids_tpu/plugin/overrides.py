@@ -270,6 +270,27 @@ def _convert_scan(cpu: C.CpuScanExec, conf, children):
     return XB.InMemoryScanExec(conf, parts, cpu.output_schema)
 
 
+def _tag_file_scan(meta: "PlanMeta") -> None:
+    cpu: C.CpuFileScanExec = meta.wrapped  # type: ignore[assignment]
+    from ..conf import CSV_ENABLED, ORC_ENABLED, PARQUET_ENABLED
+
+    gate = {
+        "parquet": (PARQUET_ENABLED, "spark.rapids.tpu.sql.format.parquet.enabled"),
+        "csv": (CSV_ENABLED, "spark.rapids.tpu.sql.format.csv.enabled"),
+        "orc": (ORC_ENABLED, "spark.rapids.tpu.sql.format.orc.enabled"),
+    }.get(cpu.fmt)
+    if gate is not None and not meta.conf.get(gate[0]):
+        meta.will_not_work(
+            f"{cpu.fmt} scan is disabled by {gate[1]}")
+    _tag_output_types(meta)
+
+
+def _convert_file_scan(cpu: "C.CpuFileScanExec", conf, children):
+    from ..exec.scan import TpuFileSourceScanExec
+
+    return TpuFileSourceScanExec(conf, cpu.scanner, cpu.fmt)
+
+
 def _tag_project(meta: "PlanMeta") -> None:
     cpu: C.CpuProjectExec = meta.wrapped  # type: ignore[assignment]
     schema = cpu.children[0].output_schema
@@ -587,6 +608,8 @@ def _convert_window(cpu: C.CpuWindowExec, conf, children):
 
 
 _exec_rule(C.CpuScanExec, "ScanExec", "in-memory data source", _tag_scan, _convert_scan)
+_exec_rule(C.CpuFileScanExec, "FileSourceScanExec", "parquet/csv/orc file scan",
+           _tag_file_scan, _convert_file_scan)
 _exec_rule(C.CpuRangeExec, "RangeExec", "range of longs", _tag_range, _convert_range)
 _exec_rule(C.CpuProjectExec, "ProjectExec", "column projection", _tag_project, _convert_project)
 _exec_rule(C.CpuFilterExec, "FilterExec", "row filter", _tag_filter, _convert_filter)

@@ -29,9 +29,92 @@ class LNode:
     children: Tuple["LNode", ...] = ()
 
 
+_SCANNER_CACHE: Dict[tuple, Any] = {}
+
+
+def _make_scanner(fmt: str, path: str, opts: tuple, conf: RapidsConf,
+                  pushed: tuple = ()):
+    """Build (and cache) a file scanner; the cache avoids re-parsing
+    footers on every schema access (conf identity is part of the key)."""
+    key = (fmt, path, opts, pushed, id(conf))
+    sc = _SCANNER_CACHE.get(key)
+    if sc is None:
+        od = dict(opts)
+        if fmt == "parquet":
+            from ..io.parquet import ParquetScanner
+
+            sc = ParquetScanner(
+                path, conf, columns=od.get("columns"),
+                filters=list(pushed))
+        elif fmt == "csv":
+            from ..io.csv import CsvScanner
+
+            sc = CsvScanner(
+                path, conf, schema=od.get("schema"),
+                header=od.get("header", True), sep=od.get("sep", ","))
+        elif fmt == "orc":
+            from ..io.orc import OrcScanner
+
+            sc = OrcScanner(path, conf, columns=od.get("columns"))
+        else:
+            raise ValueError(f"unknown file format {fmt}")
+        if len(_SCANNER_CACHE) > 256:
+            _SCANNER_CACHE.clear()
+        _SCANNER_CACHE[key] = sc
+    return sc
+
+
+def _extract_pushed_filters(cond: E.Expression) -> tuple:
+    """col-vs-literal conjuncts for row-group pruning (reference: the
+    parquet pushdown assembled in GpuParquetScan's filterBlocks). Unknown
+    shapes are simply not pushed — pruning is advisory, the filter exec
+    still runs."""
+    from ..io.parquet import PushedFilter
+
+    out: List[PushedFilter] = []
+
+    def visit(e: E.Expression):
+        if isinstance(e, E.And):
+            visit(e.left)
+            visit(e.right)
+            return
+        ops = {
+            E.EqualTo: "=", E.LessThan: "<", E.LessThanOrEqual: "<=",
+            E.GreaterThan: ">", E.GreaterThanOrEqual: ">=",
+        }
+        t = type(e)
+        if t in ops:
+            l, r = e.left, e.right
+            if isinstance(l, E.UnresolvedAttribute) and isinstance(r, E.Literal):
+                out.append(PushedFilter(l.name, ops[t], r.value))
+            elif isinstance(r, E.UnresolvedAttribute) and isinstance(l, E.Literal):
+                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}
+                out.append(PushedFilter(r.name, flip[ops[t]], l.value))
+        elif isinstance(e, E.IsNull) and isinstance(
+                e.child, E.UnresolvedAttribute):
+            out.append(PushedFilter(e.child.name, "isnull"))
+        elif isinstance(e, E.IsNotNull) and isinstance(
+                e.child, E.UnresolvedAttribute):
+            out.append(PushedFilter(e.child.name, "notnull"))
+
+    visit(cond)
+    return tuple(out)
+
+
 def _lower(node: LNode, conf: RapidsConf) -> C.CpuExec:
-    kids = [_lower(c, conf) for c in node.children]
     k = node.kind
+    if k == "filter" and node.children[0].kind == "file_scan":
+        # push col-vs-literal conjuncts into the scan for row-group pruning
+        (cond,) = node.args
+        fmt, path, opts = node.children[0].args
+        pushed = _extract_pushed_filters(cond) if fmt == "parquet" else ()
+        sc = _make_scanner(fmt, path, opts, conf, pushed)
+        return C.CpuFilterExec(conf, cond, C.CpuFileScanExec(conf, sc, fmt))
+    kids = [_lower(c, conf) for c in node.children]
+    if k == "file_scan":
+        fmt, path, opts = node.args
+        return C.CpuFileScanExec(
+            conf, _make_scanner(fmt, path, opts, conf), fmt)
     if k == "scan":
         rows, schema, nparts = node.args
         per = (len(rows) + nparts - 1) // nparts if rows else 0
@@ -112,6 +195,10 @@ class TpuSession:
             start, end = 0, start
         return DataFrame(self, LNode("range", (start, end, step, num_slices, "id")))
 
+    @property
+    def read(self) -> "DataFrameReader":
+        return DataFrameReader(self)
+
     # -- execution ---------------------------------------------------------
     def _execute(self, node: LNode) -> C.CpuExec:
         cpu = _lower(node, self.conf)
@@ -138,10 +225,75 @@ class GroupedData:
         return self.agg(A.agg(A.Count(), "count"))
 
 
+class DataFrameReader:
+    """reference analog: spark.read with the plugin's scan rules."""
+
+    def __init__(self, session: "TpuSession"):
+        self._session = session
+
+    def parquet(self, path: str,
+                columns: Optional[Sequence[str]] = None) -> "DataFrame":
+        opts = (("columns", tuple(columns) if columns else None),)
+        return DataFrame(
+            self._session, LNode("file_scan", ("parquet", path, opts)))
+
+    def csv(self, path: str, schema: Optional[StructType] = None,
+            header: bool = True, sep: str = ",") -> "DataFrame":
+        opts = (("schema", schema), ("header", header), ("sep", sep))
+        return DataFrame(
+            self._session, LNode("file_scan", ("csv", path, opts)))
+
+    def orc(self, path: str,
+            columns: Optional[Sequence[str]] = None) -> "DataFrame":
+        opts = (("columns", tuple(columns) if columns else None),)
+        return DataFrame(
+            self._session, LNode("file_scan", ("orc", path, opts)))
+
+
+class DataFrameWriter:
+    """reference analog: df.write through GpuParquetFileFormat +
+    GpuFileFormatWriter's commit protocol."""
+
+    def __init__(self, df: "DataFrame"):
+        self._df = df
+
+    def parquet(self, path: str, compression: str = "snappy") -> Dict[str, int]:
+        from ..io.parquet import write_parquet
+
+        df = self._df
+        final = df.session._execute(df.node)
+        schema = final.output_schema
+
+        def batches():
+            if isinstance(final, ColumnarToRowExec):
+                # columnar fast path: hand device batches to the writer
+                yield from final.tpu_child.execute_columnar()
+            else:
+                from ..columnar.batch import batch_from_rows
+
+                buf: List[tuple] = []
+                for row in (
+                    r for p in range(final.num_partitions)
+                    for r in final.execute_rows_partition(p)
+                ):
+                    buf.append(row)
+                    if len(buf) >= 65536:
+                        yield batch_from_rows(buf, schema)
+                        buf = []
+                if buf:
+                    yield batch_from_rows(buf, schema)
+
+        return write_parquet(batches(), path, schema, compression)
+
+
 class DataFrame:
     def __init__(self, session: TpuSession, node: LNode):
         self.session = session
         self.node = node
+
+    @property
+    def write(self) -> DataFrameWriter:
+        return DataFrameWriter(self)
 
     # -- transformations ---------------------------------------------------
     def select(self, *exprs: Union[str, E.Expression]) -> "DataFrame":

@@ -246,6 +246,11 @@ class StructField:
 class StructType(DataType):
     fields: tuple = ()
 
+    def __post_init__(self):
+        # callers may pass a list; normalize so the type stays hashable
+        if not isinstance(self.fields, tuple):
+            object.__setattr__(self, "fields", tuple(self.fields))
+
     @property
     def name(self):  # type: ignore[override]
         inner = ",".join(f"{f.name}:{f.dataType.simpleString}" for f in self.fields)
