@@ -119,7 +119,10 @@ class CpuFileScanExec(CpuExec):
             return
         table, pvals = self.scanner.read_split_i(index)
         schema = self.output_schema
-        npart = len(pvals)
+        # select partition values by the schema's common keys (ragged
+        # layouts can report extra per-split keys) — mirrors scan.py
+        pkeys = list(getattr(self.scanner, "partition_cols", ()))
+        npart = len(pkeys)
         file_fields = schema.fields[: len(schema.fields) - npart]
         n = table.num_rows
         cols: List[List[Any]] = []
@@ -150,7 +153,9 @@ class CpuFileScanExec(CpuExec):
                 for i in range(n):
                     vals.append(data[i].item() if validity[i] else None)
             cols.append(vals)
-        for _, v in pvals:
+        pmap = dict(pvals)
+        for k in pkeys:
+            v = pmap.get(k)
             cols.append([None if v is None else str(v)] * n)
         yield from zip(*cols) if cols else iter(())
 

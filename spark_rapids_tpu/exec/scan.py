@@ -79,14 +79,19 @@ class TpuFileSourceScanExec(TpuExec):
             table, pvals = self.scanner.read_split_i(index)
         with timed(self.metrics[DECODE_TIME]):
             schema = self.output_schema
-            npart = len(pvals)
+            # the schema only carries the partition keys common to every
+            # file (scanner.partition_cols); a split may report extra keys
+            # on ragged layouts — select by schema key, not raw count
+            pkeys = list(getattr(self.scanner, "partition_cols", ()))
+            npart = len(pkeys)
             file_fields = schema.fields[: len(schema.fields) - npart]
             batch = arrow_to_batch(
                 table, T.StructType(tuple(file_fields)))
             if npart:
+                pmap = dict(pvals)
                 n, cap = batch.num_rows, max(batch.capacity, 1)
                 cols = list(batch.columns)
-                for _, v in pvals:
-                    cols.append(constant_string_column(v, n, cap))
+                for k in pkeys:
+                    cols.append(constant_string_column(pmap.get(k), n, cap))
                 batch = ColumnarBatch(cols, schema, n)
         yield self.record_batch(batch)

@@ -14,6 +14,7 @@ from typing import Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .. import types as T
@@ -21,8 +22,11 @@ from ..expr.eval import ColV, StrV, Val
 
 DEFAULT_SEED = 42
 
-_C1 = jnp.uint32(0xCC9E2D51)
-_C2 = jnp.uint32(0x1B873593)
+# numpy scalars on purpose: importing this module must not touch any JAX
+# backend (module-level jnp constants would materialize eagerly on the
+# default platform, breaking CPU-mesh fallback on hosts with a broken TPU)
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
 
 
 def _rotl(x: jax.Array, r: int) -> jax.Array:

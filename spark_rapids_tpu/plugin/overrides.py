@@ -686,11 +686,22 @@ class PlanMeta:
             lines.extend(c.explain_lines(indent + 1))
         return lines
 
-    def fallback_nodes(self) -> List[str]:
-        out = [] if self.can_replace else [self.wrapped.node_name]
+    def fallback_name_sets(self) -> List[Tuple[str, ...]]:
+        """Per fallen-back node, every name it answers to: the wrapped CPU
+        class name and the Spark-style rule name (reference:
+        assert_gpu_fallback_collect matches Spark class names)."""
+        out: List[Tuple[str, ...]] = []
+        if not self.can_replace:
+            names = [self.wrapped.node_name]
+            if self.rule is not None and self.rule.name not in names:
+                names.append(self.rule.name)
+            out.append(tuple(names))
         for c in self.child_metas:
-            out.extend(c.fallback_nodes())
+            out.extend(c.fallback_name_sets())
         return out
+
+    def fallback_nodes(self) -> List[str]:
+        return [n for names in self.fallback_name_sets() for n in names]
 
 
 def explain_plan(meta: PlanMeta, conf: RapidsConf) -> str:
@@ -726,7 +737,8 @@ class TpuOverrides:
                 for s in self.conf.get(TEST_ALLOWED_NONTPU).split(",")
                 if s.strip()
             }
-            bad = [n for n in meta.fallback_nodes() if n not in allowed]
+            bad = [names[0] for names in meta.fallback_name_sets()
+                   if not any(n in allowed for n in names)]
             if bad:
                 raise AssertionError(
                     "Part of the plan is not columnar "
