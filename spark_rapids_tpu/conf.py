@@ -105,6 +105,10 @@ ENABLE_CAST_STRING_TO_FLOAT = conf(
 ENABLE_CAST_STRING_TO_TIMESTAMP = conf(
     "spark.rapids.tpu.sql.castStringToTimestamp.enabled", False,
     "String-to-timestamp casts support a subset of formats.")
+ENABLE_CAST_STRING_TO_INTEGER = conf(
+    "spark.rapids.tpu.sql.castStringToInteger.enabled", False,
+    "String-to-integral casts can differ from Spark on malformed-input edge "
+    "cases (reference gate: spark.rapids.sql.castStringToInteger.enabled).")
 DECIMAL_ENABLED = conf(
     "spark.rapids.tpu.sql.decimalType.enabled", True,
     "Enable DECIMAL(<=18) columns on the TPU (stored as int64 unscaled).")

@@ -36,11 +36,87 @@ def _wrap_int(v: int, name: str) -> int:
     return v - mod if v > hi else v
 
 
+_JAVA_WS = "\t\n\x0b\x0c\r "
+
+
+def _cast_from_string(s: str, to: T.DataType) -> Any:
+    """Spark non-ANSI cast from string (GpuCast.scala string rows)."""
+    import re
+
+    t = s.strip(_JAVA_WS)
+    if isinstance(to, T.BooleanType):
+        tl = t.lower()
+        if tl in ("t", "true", "y", "yes", "1"):
+            return True
+        if tl in ("f", "false", "n", "no", "0"):
+            return False
+        return None
+    if to.name in _INT_RANGES:
+        if not re.fullmatch(r"[+-]?\d+", t):
+            return None
+        v = int(t)
+        lo, hi, _ = _INT_RANGES[to.name]
+        return v if lo <= v <= hi else None
+    if to.is_floating:
+        tl = t.lower()
+        specials = {"inf": math.inf, "+inf": math.inf, "-inf": -math.inf,
+                    "infinity": math.inf, "+infinity": math.inf,
+                    "-infinity": -math.inf, "nan": math.nan}
+        if tl in specials:
+            v = specials[tl]
+        elif re.fullmatch(r"[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?", t):
+            v = float(t)
+        else:
+            return None
+        return _f32(v) if isinstance(to, T.FloatType) else v
+    raise NotImplementedError(f"cpu cast string -> {to}")
+
+
+def _java_double_str(v: float, single: bool) -> str:
+    """Java Double.toString/Float.toString: shortest round-trip decimal,
+    positional for 1e-3 <= |v| < 1e7, else d.dddEn scientific."""
+    import numpy as np
+
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "Infinity" if v > 0 else "-Infinity"
+    f = np.float32(v) if single else np.float64(v)
+    a = abs(float(f))
+    if a == 0:
+        return "-0.0" if math.copysign(1, v) < 0 else "0.0"
+    if 1e-3 <= a < 1e7:
+        r = np.format_float_positional(f, unique=True)
+        if r.endswith("."):
+            r += "0"
+        return r
+    m, e = np.format_float_scientific(f, unique=True).split("e")
+    if m.endswith("."):
+        m += "0"
+    if "." not in m:
+        m += ".0"
+    return f"{m}E{int(e)}"
+
+
+def _cast_to_string(v: Any, frm: T.DataType) -> str:
+    if isinstance(frm, T.BooleanType):
+        return "true" if v else "false"
+    if frm.name in _INT_RANGES:
+        return str(v)
+    if frm.is_floating:
+        return _java_double_str(float(v), isinstance(frm, T.FloatType))
+    raise NotImplementedError(f"cpu cast {frm} -> string")
+
+
 def _java_cast(v: Any, frm: T.DataType, to: T.DataType) -> Any:
     if v is None:
         return None
     if frm == to:
         return v
+    if isinstance(frm, T.StringType):
+        return _cast_from_string(v, to)
+    if isinstance(to, T.StringType):
+        return _cast_to_string(v, frm)
     if isinstance(to, T.BooleanType):
         return v != 0
     if isinstance(frm, T.BooleanType):
@@ -451,6 +527,152 @@ def eval_row(expr: E.Expression, row: Sequence[Any]) -> Any:
         if v is None:
             return None
         return len(v)
+
+    # ----- strings (Spark/UTF8String semantics, implemented over Python str
+    # independently of the TPU kernels) ------------------------------------
+    if isinstance(expr, E.Upper):
+        v = ev(expr.child)
+        return None if v is None else v.upper()
+
+    if isinstance(expr, E.Lower):
+        v = ev(expr.child)
+        return None if v is None else v.lower()
+
+    if isinstance(expr, E.InitCap):
+        v = ev(expr.child)
+        if v is None:
+            return None
+        # Spark: lowercase everything, uppercase the char after each space
+        out = []
+        prev_space = True
+        for ch in v.lower():
+            out.append(ch.upper() if prev_space else ch)
+            prev_space = ch == " "
+        return "".join(out)
+
+    if isinstance(expr, E.Substring):
+        v, pos, ln = ev(expr.str), ev(expr.pos), ev(expr.len)
+        if v is None or pos is None or ln is None:
+            return None
+        n = len(v)
+        start = (pos - 1) if pos > 0 else ((n + pos) if pos < 0 else 0)
+        end = start + ln
+        s0 = max(min(start, n), 0)
+        e0 = max(min(end, n), 0)
+        return v[s0:e0] if e0 > s0 else ""
+
+    if isinstance(expr, E.Concat):
+        parts = [ev(e) for e in expr.children_]
+        if any(p is None for p in parts):
+            return None
+        return "".join(parts)
+
+    if isinstance(expr, (E.StringTrim, E.StringTrimLeft, E.StringTrimRight)):
+        v = ev(expr.column)
+        if v is None:
+            return None
+        tset = expr.trim_str if expr.trim_str is not None else " "
+        if isinstance(expr, E.StringTrimLeft):
+            return v.lstrip(tset)
+        if isinstance(expr, E.StringTrimRight):
+            return v.rstrip(tset)
+        return v.strip(tset)
+
+    if isinstance(expr, (E.StartsWith, E.EndsWith, E.Contains)):
+        l, r = ev(expr.left), ev(expr.right)
+        if l is None or r is None:
+            return None
+        if isinstance(expr, E.StartsWith):
+            return l.startswith(r)
+        if isinstance(expr, E.EndsWith):
+            return l.endswith(r)
+        return r in l
+
+    if isinstance(expr, E.Like):
+        v, p = ev(expr.left), ev(expr.pattern)
+        if v is None or p is None:
+            return None
+        import re as _re
+
+        esc = expr.escape
+        out = []
+        i = 0
+        while i < len(p):
+            ch = p[i]
+            if ch == esc:
+                if i + 1 >= len(p):
+                    raise ValueError(f"invalid LIKE pattern {p!r}")
+                nxt = p[i + 1]
+                if nxt not in ("_", "%", esc):
+                    raise ValueError(f"invalid LIKE pattern {p!r}")
+                out.append(_re.escape(nxt))
+                i += 2
+                continue
+            if ch == "%":
+                out.append("(.|\\n)*")
+            elif ch == "_":
+                out.append("(.|\\n)")
+            else:
+                out.append(_re.escape(ch))
+            i += 1
+        return _re.match("(?:" + "".join(out) + r")\Z", v) is not None
+
+    if isinstance(expr, E.StringLocate):
+        start = ev(expr.start)
+        if start is None:
+            return 0  # reference: null start -> 0 for every row
+        sub = ev(expr.substr)
+        if sub is None:
+            return None
+        v = ev(expr.str)
+        if v is None:
+            return None
+        if start < 1:
+            return 0
+        if sub == "":
+            return 1
+        i = v.find(sub, start - 1)
+        return i + 1
+
+    if isinstance(expr, E.StringReplace):
+        v, s, r = ev(expr.str), ev(expr.search), ev(expr.replacement)
+        if v is None or s is None or r is None:
+            return None
+        if s == "":
+            return v
+        return v.replace(s, r)
+
+    if isinstance(expr, (E.StringLPad, E.StringRPad)):
+        v, ln, pad = ev(expr.str), ev(expr.len), ev(expr.pad)
+        if v is None or ln is None or pad is None:
+            return None
+        if ln <= 0:
+            return ""
+        if len(v) >= ln:
+            return v[:ln]
+        if not pad:
+            return v
+        need = ln - len(v)
+        reps = (pad * (need // len(pad) + 1))[:need]
+        return (reps + v) if isinstance(expr, E.StringLPad) else (v + reps)
+
+    if isinstance(expr, E.SubstringIndex):
+        v, d, cnt = ev(expr.str), ev(expr.delim), ev(expr.count)
+        if v is None or d is None or cnt is None:
+            return None
+        if cnt == 0 or d == "":
+            return ""
+        parts = v.split(d)
+        if cnt > 0:
+            return d.join(parts[:cnt])
+        return d.join(parts[cnt:])
+
+    if isinstance(expr, E.StringSplitPart):
+        v, d, i = ev(expr.str), ev(expr.delim), ev(expr.index)
+        if v is None or d is None or i is None:
+            return None
+        parts = v.split(d)
+        return parts[i] if 0 <= i < len(parts) else None
 
     raise NotImplementedError(f"cpu interpreter: {type(expr).__name__}")
 

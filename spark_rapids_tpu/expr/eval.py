@@ -19,7 +19,7 @@ pin this against the independent CPU interpreter.
 from __future__ import annotations
 
 import functools
-from typing import List, NamedTuple, Sequence, Tuple, Union
+from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -29,25 +29,7 @@ from .. import types as T
 from ..columnar import ColumnarBatch, DeviceColumn
 from ..types import DataType
 from . import expressions as E
-
-
-class ColV(NamedTuple):
-    data: jax.Array
-    validity: jax.Array
-
-
-class StrV(NamedTuple):
-    offsets: jax.Array
-    chars: jax.Array
-    validity: jax.Array
-
-
-Val = Union[ColV, StrV]
-
-
-class UnsupportedExpressionError(Exception):
-    """Raised when a tree can't lower to TPU; planner uses this to fall back
-    (reference: RapidsMeta.willNotWorkOnGpu)."""
+from .values import ColV, StrV, Val, UnsupportedExpressionError  # noqa: F401
 
 
 _INT_INFO = {
@@ -201,7 +183,12 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
     if isinstance(expr, E._BinaryComparison):
         l, r = ev(expr.left), ev(expr.right)
         if isinstance(l, StrV) or isinstance(r, StrV):
-            raise UnsupportedExpressionError("string comparison not yet on TPU")
+            if not (isinstance(l, StrV) and isinstance(r, StrV)):
+                raise UnsupportedExpressionError(
+                    "comparison between string and non-string")
+            from .eval_strings import compare_strings
+
+            return compare_strings(expr, l, r, cap)
         tgt = (
             T.promote(expr.left.dtype, expr.right.dtype)
             if expr.left.dtype != expr.right.dtype
@@ -239,7 +226,9 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
     if isinstance(expr, E.In):
         c = ev(expr.child)
         if isinstance(c, StrV):
-            raise UnsupportedExpressionError("string IN not yet on TPU")
+            from .eval_strings import string_in
+
+            return string_in(c, expr.values, cap)
         child_dt = expr.child.dtype
         non_null = [v for v in expr.values if v is not None]
         has_null_value = len(non_null) != len(expr.values)
@@ -303,7 +292,16 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
     if isinstance(expr, E.Coalesce):
         out = expr.dtype
         if isinstance(out, (T.StringType, T.BinaryType)):
-            raise UnsupportedExpressionError("string coalesce not yet on TPU")
+            from .eval_strings import as_strv, select_strings
+
+            vals = [as_strv(ev(e), cap) for e in expr.exprs]
+            valid = vals[0].validity
+            for v in vals[1:]:
+                valid = valid | v.validity
+            sel = jnp.full(cap, len(vals) - 1, jnp.int32)
+            for k in reversed(range(len(vals))):
+                sel = jnp.where(vals[k].validity, k, sel)
+            return select_strings(vals, sel, valid, cap)
         acc = None
         for e in expr.exprs:
             v = ev(e)
@@ -329,7 +327,15 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
     if isinstance(expr, E.If):
         out = expr.dtype
         if isinstance(out, (T.StringType, T.BinaryType)):
-            raise UnsupportedExpressionError("string if/case not yet on TPU")
+            from .eval_strings import as_strv, select_strings
+
+            p = ev(expr.predicate)
+            t = as_strv(ev(expr.true_value), cap)
+            f = as_strv(ev(expr.false_value), cap)
+            cond = p.validity & p.data
+            sel = jnp.where(cond, 0, 1).astype(jnp.int32)
+            valid = jnp.where(cond, t.validity, f.validity)
+            return select_strings([t, f], sel, valid, cap)
         p = ev(expr.predicate)
         t, f = ev(expr.true_value), ev(expr.false_value)
         td = _cast_data(t.data, expr.true_value.dtype if expr.true_value.dtype != T.NULL else out, out)
@@ -340,7 +346,24 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
     if isinstance(expr, E.CaseWhen):
         out = expr.dtype
         if isinstance(out, (T.StringType, T.BinaryType)):
-            raise UnsupportedExpressionError("string if/case not yet on TPU")
+            from .eval_strings import as_strv, select_strings
+
+            branch_vals = [as_strv(ev(v), cap) for _, v in expr.branches]
+            if expr.else_value is not None:
+                branch_vals.append(as_strv(ev(expr.else_value), cap))
+            else:
+                branch_vals.append(as_strv(None, cap))
+            k_else = len(expr.branches)
+            sel = jnp.full(cap, k_else, jnp.int32)
+            valid = branch_vals[k_else].validity
+            taken = jnp.zeros(cap, jnp.bool_)
+            for k, (cond_e, _) in enumerate(expr.branches):
+                cnd = ev(cond_e)
+                fire = ~taken & cnd.validity & cnd.data
+                sel = jnp.where(fire, k, sel)
+                valid = jnp.where(fire, branch_vals[k].validity, valid)
+                taken = taken | fire
+            return select_strings(branch_vals, sel, valid, cap)
         if expr.else_value is not None:
             e = ev(expr.else_value)
             edt = expr.else_value.dtype
@@ -364,8 +387,14 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
     if isinstance(expr, E.Cast):
         frm, to = expr.child.dtype, expr.to
         c = ev(expr.child)
-        if isinstance(c, StrV) or isinstance(to, (T.StringType, T.BinaryType)):
-            raise UnsupportedExpressionError("string casts not yet on TPU")
+        if isinstance(c, StrV):
+            from .eval_strings import lower_string_cast
+
+            return lower_string_cast(c, to, cap)
+        if isinstance(to, (T.StringType, T.BinaryType)):
+            from .eval_strings import lower_cast_to_string
+
+            return lower_cast_to_string(c, frm, cap)
         return ColV(_cast_data(c.data, frm, to), c.validity)
 
     # ----- math -----------------------------------------------------------
@@ -474,6 +503,18 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
         byte_len = c.offsets[1:] - c.offsets[:-1]
         cont_in_row = cs[c.offsets[1:]] - cs[c.offsets[:-1]]
         return ColV((byte_len - cont_in_row).astype(jnp.int32), c.validity)
+
+    from .eval_strings import lower_strings
+
+    sv = lower_strings(expr, ev, cap)
+    if sv is not None:
+        return sv
+
+    from .eval_datetime import lower_datetime
+
+    dv = lower_datetime(expr, ev, cap)
+    if dv is not None:
+        return dv
 
     raise UnsupportedExpressionError(f"no TPU lowering for {type(expr).__name__}")
 

@@ -1,0 +1,16 @@
+"""TPU lowerings for the date/time expression family.
+
+Reference analog: sql-plugin/.../sql/rapids/datetimeExpressions.scala
+(723 LoC) with the UTC-only gating of GpuOverrides.scala:562. Filled in by
+the datetime milestone; the dispatcher contract matches eval_strings.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+from . import expressions as E
+
+
+def lower_datetime(expr: E.Expression, ev: Callable, cap: int):
+    """Lower a datetime-family expression; None if ``expr`` isn't one."""
+    return None

@@ -1,0 +1,872 @@
+"""TPU lowerings for the string expression family.
+
+Reference analog: sql-plugin/.../sql/rapids/stringFunctions.scala (889 LoC)
+plus the string rows of GpuCast.scala (976 LoC). The reference dispatches
+each node to a cudf string kernel; here every node lowers to static-shape
+XLA programs built from the primitives in ops/strings.py, and traces inside
+the engine's single fused projection jit, so string predicates fuse with the
+surrounding arithmetic.
+
+Patterns (LIKE, replace search, locate substr, pads, delimiters) must be
+literals — the same restriction the reference applies (scalar-only rhs in
+GpuStartsWith/GpuLike/GpuStringReplace etc.); non-literal patterns tag the
+plan for CPU fallback.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..ops import strings as S
+from ..utils.bucketing import bucket_rows
+from . import expressions as E
+from .values import ColV, StrV, UnsupportedExpressionError
+
+_BIG = S.BIG
+
+
+def _char_cap(v: StrV) -> int:
+    return int(v.chars.shape[0])
+
+
+def as_strv(v, cap: int) -> StrV:
+    """Coerce a NULL-typed ColV (null literal) into an all-null empty StrV
+    so string Coalesce/If/CaseWhen can mix real strings with NULL."""
+    if isinstance(v, StrV):
+        return v
+    return StrV(
+        jnp.zeros(cap + 1, jnp.int32),
+        jnp.zeros(1, jnp.uint8),
+        jnp.zeros(cap, jnp.bool_),
+    )
+
+
+def lit_str(e: E.Expression, what: str) -> Optional[str]:
+    if not isinstance(e, E.Literal) or not isinstance(
+        e.data_type, (T.StringType, T.NullType)
+    ):
+        raise UnsupportedExpressionError(f"{what} must be a string literal")
+    return e.value
+
+
+def lit_int(e: E.Expression, what: str) -> Optional[int]:
+    if not isinstance(e, E.Literal) or isinstance(e.value, (str, bytes, float)):
+        raise UnsupportedExpressionError(f"{what} must be an integer literal")
+    return e.value
+
+
+def _all_null_col(cap: int, dtype=jnp.bool_) -> ColV:
+    return ColV(jnp.zeros(cap, dtype), jnp.zeros(cap, jnp.bool_))
+
+
+def _all_null_str(cap: int) -> StrV:
+    return as_strv(None, cap)
+
+
+def select_strings(choices: Sequence[StrV], sel: jax.Array,
+                   valid: jax.Array, cap: int) -> StrV:
+    """Per-row choice among string columns (If/CaseWhen/Coalesce)."""
+    out_cap = sum(_char_cap(c) for c in choices)
+    lens = jnp.stack([S.byte_lens(c.offsets) for c in choices])
+    rows = jnp.arange(cap, dtype=jnp.int32)
+    new_lens = jnp.where(valid, lens[sel, rows], 0)
+    new_offsets = S.offsets_of_lens(new_lens)
+    pos = jnp.arange(out_cap, dtype=jnp.int32)
+    rid = jnp.clip(jnp.searchsorted(new_offsets, pos, side="right") - 1, 0, cap - 1)
+    within = pos - new_offsets[rid]
+    out = jnp.zeros(out_cap, jnp.uint8)
+    for k, c in enumerate(choices):
+        src = jnp.clip(c.offsets[:-1][rid] + within, 0, _char_cap(c) - 1)
+        out = jnp.where(sel[rid] == k, c.chars[src], out)
+    out = jnp.where(pos < new_offsets[-1], out, jnp.uint8(0))
+    return StrV(new_offsets, out, valid)
+
+
+def compare_strings(expr: E.Expression, l: StrV, r: StrV, cap: int) -> ColV:
+    """Binary comparisons over strings: unsigned byte order (UTF8String)."""
+    lt, eq = S.compare(l, r)
+    gt = ~(lt | eq)
+    res = {
+        E.EqualTo: eq, E.EqualNullSafe: eq,
+        E.LessThan: lt, E.LessThanOrEqual: lt | eq,
+        E.GreaterThan: gt, E.GreaterThanOrEqual: gt | eq,
+    }[type(expr)]
+    if isinstance(expr, E.EqualNullSafe):
+        both_null = ~l.validity & ~r.validity
+        val = (l.validity & r.validity & res) | both_null
+        return ColV(val, jnp.ones(cap, jnp.bool_))
+    return ColV(res, l.validity & r.validity)
+
+
+def string_in(c: StrV, values, cap: int) -> ColV:
+    non_null = [v for v in values if v is not None]
+    has_null = len(non_null) != len(values)
+    match = jnp.zeros(cap, jnp.bool_)
+    for v in non_null:
+        match = match | S.equals_literal(c, str(v).encode("utf-8"))
+    valid = c.validity & (match | (not has_null))
+    return ColV(match, valid)
+
+
+# ---------------------------------------------------------------------------
+# per-expression lowerings
+# ---------------------------------------------------------------------------
+def _upper_lower(expr, c: StrV, upper: bool) -> StrV:
+    return StrV(
+        c.offsets, S.map_case(c.chars, c.offsets[-1], upper), c.validity
+    )
+
+
+def _initcap(c: StrV) -> StrV:
+    total = c.offsets[-1]
+    n = _char_cap(c)
+    low = S.map_case(c.chars, total, upper=False)
+    up = S.map_case(low, total, upper=True)
+    starts = S.char_starts(low, total)
+    prv = jnp.concatenate([jnp.full(1, 0x20, jnp.uint8), low[:-1]])
+    row_start = jnp.zeros(n, jnp.bool_).at[
+        jnp.clip(c.offsets[:-1], 0, n - 1)
+    ].set(True, mode="drop")
+    word = starts & (row_start | (prv == 0x20))
+    # continuation byte of a word-start 2-byte char keeps the mapped pair
+    word2 = word | (
+        jnp.concatenate([jnp.zeros(1, jnp.bool_), word[:-1]])
+        & ((low & 0xC0) == 0x80)
+    )
+    return StrV(c.offsets, jnp.where(word2, up, low), c.validity)
+
+
+def _substring(expr: E.Substring, c: StrV, cap: int) -> StrV:
+    pos = lit_int(expr.pos, "substring pos")
+    ln = lit_int(expr.len, "substring len")
+    if pos is None or ln is None:
+        return _all_null_str(cap)
+    nchars = S.char_counts(c)
+    # UTF8String.substringSQL: start = pos>0 ? pos-1 : (pos<0 ? n+pos : 0)
+    if pos > 0:
+        start = jnp.full(cap, pos - 1, jnp.int64)
+    elif pos < 0:
+        start = nchars.astype(jnp.int64) + pos
+    else:
+        start = jnp.zeros(cap, jnp.int64)
+    end = start + ln
+    s0 = jnp.clip(start, 0, nchars.astype(jnp.int64)).astype(jnp.int32)
+    e0 = jnp.clip(end, 0, nchars.astype(jnp.int64)).astype(jnp.int32)
+    e0 = jnp.maximum(e0, s0)
+    bs = S.char_to_byte(c, s0)
+    be = S.char_to_byte(c, e0)
+    new_lens = jnp.where(c.validity, be - bs, 0)
+    off, chars = S.take_slices(c, bs, new_lens, _char_cap(c))
+    return StrV(off, chars, c.validity)
+
+
+def _concat(pieces: List[StrV]) -> StrV:
+    out_cap = sum(_char_cap(p) for p in pieces)
+    off, chars, valid = S.concat(pieces, out_cap)
+    return StrV(off, chars, valid)
+
+
+def _trim(expr, c: StrV, cap: int) -> StrV:
+    trim_str = expr.trim_str
+    if trim_str is None:
+        tset = b" "
+    elif trim_str == "":
+        return c  # Spark: empty trim set is a no-op
+    else:
+        tset = trim_str.encode("utf-8")
+        if any(b >= 0x80 for b in tset):
+            raise UnsupportedExpressionError(
+                "trim with non-ASCII trim characters is not supported on TPU"
+            )
+    n = _char_cap(c)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    rid = S.row_ids(c.offsets, n)
+    within = pos - c.offsets[:-1][rid]
+    in_set = jnp.zeros(n, jnp.bool_)
+    for b in set(tset):
+        in_set = in_set | (c.chars == np.uint8(b))
+    keep = (pos < c.offsets[-1]) & ~in_set
+    lens = S.byte_lens(c.offsets)
+    first = jax.ops.segment_min(
+        jnp.where(keep, within, _BIG), rid, num_segments=cap,
+        indices_are_sorted=True)
+    last = jax.ops.segment_max(
+        jnp.where(keep, within, -1), rid, num_segments=cap,
+        indices_are_sorted=True)
+    first = jnp.where(first == _BIG, lens, first)  # all-trimmed row
+    if isinstance(expr, E.StringTrimLeft):
+        bs, nl = c.offsets[:-1] + first, lens - first
+    elif isinstance(expr, E.StringTrimRight):
+        bs, nl = c.offsets[:-1], last + 1
+    else:
+        bs, nl = c.offsets[:-1] + first, jnp.maximum(last + 1 - first, 0)
+    nl = jnp.where(c.validity, jnp.maximum(nl, 0), 0)
+    off, chars = S.take_slices(c, bs, nl, n)
+    return StrV(off, chars, c.validity)
+
+
+def _string_predicate(expr, c: StrV, cap: int) -> ColV:
+    pat = lit_str(expr.right, type(expr).__name__ + " pattern")
+    if pat is None:
+        return _all_null_col(cap)
+    pb = pat.encode("utf-8")
+    lens = S.byte_lens(c.offsets)
+    if not pb:
+        return ColV(jnp.ones(cap, jnp.bool_), c.validity)
+    n = _char_cap(c)
+    m = S.find_matches(c.chars, pb)
+    mp = len(pb)
+    off = c.offsets[:-1]
+    if isinstance(expr, E.StartsWith):
+        res = (lens >= mp) & m[jnp.clip(off, 0, n - 1)]
+    elif isinstance(expr, E.EndsWith):
+        res = (lens >= mp) & m[jnp.clip(off + lens - mp, 0, n - 1)]
+    else:  # Contains
+        P = S.prefix_counts(m)
+        hi = jnp.clip(off + jnp.maximum(lens - mp, 0) + 1, 0, n)
+        cnt = P[hi] - P[jnp.clip(off, 0, n)]
+        res = (lens >= mp) & (cnt > 0)
+    return ColV(res, c.validity)
+
+
+def _parse_like(pattern: str, escape: str) -> List[str]:
+    """Tokenize a LIKE pattern into literal chunks separated by '%' tokens,
+    or a char-wise list when only '_' wildcards appear. Raises Unsupported
+    for '%'+'_' mixtures; raises ValueError for invalid escapes (matching
+    Spark, which throws for a dangling/invalid escape)."""
+    toks: List[str] = []
+    cur: List[str] = []
+    it = iter(range(len(pattern)))
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == escape:
+            if i + 1 >= len(pattern):
+                raise ValueError(
+                    f"the pattern '{pattern}' is invalid, it is not allowed to "
+                    "end with the escape character")
+            nxt = pattern[i + 1]
+            if nxt not in ("_", "%", escape):
+                raise ValueError(
+                    f"the pattern '{pattern}' is invalid, the escape character "
+                    f"is not allowed to precede '{nxt}'")
+            cur.append(nxt)
+            i += 2
+            continue
+        if ch in ("%", "_"):
+            if cur:
+                toks.append("".join(cur))
+                cur = []
+            toks.append(ch)
+        else:
+            cur.append(ch)
+        i += 1
+    if cur:
+        toks.append("".join(cur))
+    return toks
+
+
+def _like(expr: E.Like, c: StrV, cap: int) -> ColV:
+    pattern = lit_str(expr.pattern, "LIKE pattern")
+    if pattern is None:
+        return _all_null_col(cap)
+    try:
+        toks = _parse_like(pattern, expr.escape)
+    except ValueError as e:
+        raise UnsupportedExpressionError(str(e))
+    has_pct = "%" in toks
+    has_us = "_" in toks
+    if has_pct and has_us:
+        raise UnsupportedExpressionError(
+            "LIKE patterns mixing % and _ are not supported on TPU")
+    lens = S.byte_lens(c.offsets)
+    n = _char_cap(c)
+    off = c.offsets[:-1]
+    if has_us:
+        # fixed-shape match: char count must equal pattern char count and
+        # every literal char must match at its char position
+        pat_chars: List[Optional[str]] = []
+        for t in toks:
+            if t == "_":
+                pat_chars.append(None)
+            else:
+                pat_chars.extend(t)
+        nchars = S.char_counts(c)
+        res = nchars == len(pat_chars)
+        for k, pc in enumerate(pat_chars):
+            if pc is None:
+                continue
+            bs = pc.encode("utf-8")
+            bp = S.char_to_byte(c, jnp.full(cap, k, jnp.int32))
+            for j, b in enumerate(bs):
+                res = res & (
+                    c.chars[jnp.clip(bp + j, 0, n - 1)] == np.uint8(b))
+            # char byte-length must match too (é vs a 2-byte char check)
+            nxt = S.char_to_byte(c, jnp.full(cap, k + 1, jnp.int32))
+            res = res & ((nxt - bp) == len(bs))
+        return ColV(res, c.validity)
+    # %-separated chunks, greedy left-to-right
+    chunks = [t for t in toks if t != "%"]
+    anchored_start = bool(toks) and toks[0] != "%"
+    anchored_end = bool(toks) and toks[-1] != "%"
+    if not chunks:
+        # pattern is '' or all-%
+        res = jnp.ones(cap, jnp.bool_) if has_pct else (lens == 0)
+        return ColV(res, c.validity)
+    if len(chunks) == 1 and anchored_start and anchored_end:
+        return ColV(
+            S.equals_literal(c, chunks[0].encode("utf-8")), c.validity)
+    res = jnp.ones(cap, jnp.bool_)
+    pos = off
+    rest = chunks
+    if anchored_start:
+        pb = chunks[0].encode("utf-8")
+        m = S.find_matches(c.chars, pb)
+        res = res & (lens >= len(pb)) & m[jnp.clip(off, 0, n - 1)]
+        pos = off + len(pb)
+        rest = chunks[1:]
+    tail = None
+    if anchored_end and rest:
+        tail = rest[-1]
+        rest = rest[:-1]
+    for ck in rest:
+        pb = ck.encode("utf-8")
+        m = S.find_matches(c.chars, pb)
+        nm = S.next_match(m)
+        q = nm[jnp.clip(pos, 0, n)]
+        ok = (q < _BIG) & ((q + len(pb)) <= (off + lens))
+        res = res & ok
+        pos = jnp.where(ok, q + len(pb), n + 1)
+    if tail is not None:
+        pb = tail.encode("utf-8")
+        m = S.find_matches(c.chars, pb)
+        tstart = off + lens - len(pb)
+        res = res & (lens >= len(pb)) & (tstart >= pos) & m[
+            jnp.clip(tstart, 0, n - 1)]
+    return ColV(res, c.validity)
+
+
+def _locate(expr: E.StringLocate, c: StrV, cap: int) -> ColV:
+    sub = lit_str(expr.substr, "locate substr")
+    start = lit_int(expr.start, "locate start")
+    ones = jnp.ones(cap, jnp.bool_)
+    if start is None:
+        # reference: null start -> 0 for every row, even null inputs
+        return ColV(jnp.zeros(cap, jnp.int32), ones)
+    if sub is None:
+        return _all_null_col(cap, jnp.int32)
+    if start < 1 or sub == "":
+        v = 1 if (start >= 1) else 0
+        return ColV(jnp.full(cap, v, jnp.int32), c.validity)
+    pb = sub.encode("utf-8")
+    n = _char_cap(c)
+    total = c.offsets[-1]
+    m = S.find_matches(c.chars, pb)
+    nm = S.next_match(m)
+    bstart = S.char_to_byte(c, jnp.full(cap, start - 1, jnp.int32))
+    q = nm[jnp.clip(bstart, 0, n)]
+    lens = S.byte_lens(c.offsets)
+    found = q <= (c.offsets[:-1] + lens - len(pb))
+    cp = S.char_prefix(c.chars, total)
+    res = jnp.where(
+        found,
+        cp[jnp.clip(q, 0, n)] - cp[jnp.clip(c.offsets[:-1], 0, n)] + 1,
+        0,
+    ).astype(jnp.int32)
+    return ColV(res, c.validity)
+
+
+def _replace(expr: E.StringReplace, c: StrV, cap: int) -> StrV:
+    search = lit_str(expr.search, "replace search")
+    repl = lit_str(expr.replacement, "replace replacement")
+    if search is None or repl is None:
+        return _all_null_str(cap)
+    sb, rb = search.encode("utf-8"), repl.encode("utf-8")
+    if not sb:
+        return c  # Spark: empty search returns the input unchanged
+    if S.has_border(sb):
+        raise UnsupportedExpressionError(
+            "replace with a self-overlapping search string is not supported "
+            "on TPU (order-dependent greedy matching)")
+    ms, mr = len(sb), len(rb)
+    n = _char_cap(c)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    rid = S.row_ids(c.offsets, n)
+    lens = S.byte_lens(c.offsets)
+    within = pos - c.offsets[:-1][rid]
+    m = S.find_matches(c.chars, sb)
+    m = m & ((within + ms) <= lens[rid])  # no cross-row matches
+    P = S.prefix_counts(m)
+    cnt = P[c.offsets[1:]] - P[c.offsets[:-1]]
+    new_lens = jnp.where(c.validity, lens + cnt * (mr - ms), 0)
+    new_offsets = S.offsets_of_lens(new_lens)
+    out_cap = n if mr <= ms else bucket_rows(n // ms * (mr - ms) + n)
+    in_match = (P[pos + 1] - P[jnp.clip(pos - ms + 1, 0, n)]) > 0
+    repl_before = P[pos] - P[c.offsets[:-1]][rid]
+    fwd = within + repl_before * (mr - ms)
+    in_data = pos < c.offsets[-1]
+    kept = in_data & ~in_match
+    base = new_offsets[:-1][rid] + fwd
+    out = jnp.zeros(out_cap, jnp.uint8)
+    out = out.at[jnp.where(kept, base, out_cap)].set(c.chars, mode="drop")
+    for k in range(mr):
+        out = out.at[jnp.where(m, base + k, out_cap)].set(
+            np.uint8(rb[k]), mode="drop")
+    return StrV(new_offsets, out, c.validity)
+
+
+def _pad(expr, c: StrV, cap: int, left: bool) -> StrV:
+    L = lit_int(expr.len, "pad length")
+    pad = lit_str(expr.pad, "pad string")
+    if L is None or pad is None:
+        return _all_null_str(cap)
+    n = _char_cap(c)
+    if L <= 0:
+        off = jnp.zeros(cap + 1, jnp.int32)
+        return StrV(off, jnp.zeros(1, jnp.uint8), c.validity)
+    pb = pad.encode("utf-8")
+    pad_offs = [0]
+    for ch in pad:
+        pad_offs.append(pad_offs[-1] + len(ch.encode("utf-8")))
+    pc = len(pad)
+    nchars = S.char_counts(c)
+    lens = S.byte_lens(c.offsets)
+    trunc = nchars >= L
+    tb = S.char_to_byte(c, jnp.full(cap, L, jnp.int32)) - c.offsets[:-1]
+    if pc:
+        need = jnp.maximum(L - nchars, 0)
+        full, rem = need // pc, need % pc
+        ptable = jnp.asarray(np.asarray(pad_offs, np.int32))
+        pad_bytes = full * len(pb) + ptable[rem]
+    else:
+        pad_bytes = jnp.zeros(cap, jnp.int32)
+    str_bytes = jnp.where(trunc, tb, lens)
+    out_lens = jnp.where(c.validity, str_bytes + jnp.where(trunc, 0, pad_bytes), 0)
+    new_offsets = S.offsets_of_lens(out_lens)
+    out_cap = bucket_rows(max(cap * 4 * L, 1))
+    opos = jnp.arange(out_cap, dtype=jnp.int32)
+    rid = jnp.clip(jnp.searchsorted(new_offsets, opos, side="right") - 1, 0, cap - 1)
+    w = opos - new_offsets[:-1][rid]
+    pl = jnp.where(trunc, 0, pad_bytes)[rid]
+    if left:
+        in_pad = w < pl
+        sw = w - pl
+    else:
+        in_pad = w >= str_bytes[rid]
+        sw = w
+    src = jnp.clip(c.offsets[:-1][rid] + sw, 0, n - 1)
+    out = c.chars[src]
+    if pc:
+        prep = jnp.asarray(np.frombuffer(pb, np.uint8))
+        pw = (w if left else (w - str_bytes[rid])) % len(pb)
+        out = jnp.where(in_pad, prep[jnp.clip(pw, 0, len(pb) - 1)], out)
+    out = jnp.where(opos < new_offsets[-1], out, jnp.uint8(0))
+    return StrV(new_offsets, out, c.validity)
+
+
+def _occurrence_matrix(m: jax.Array, rid: jax.Array, off_of_rid: jax.Array,
+                       P: jax.Array, cap: int, K: int) -> jax.Array:
+    """(cap, K) byte positions of each row's first K matches (BIG where the
+    row has fewer)."""
+    n = m.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    ordn = P[pos] - P[off_of_rid]
+    tgt_r = jnp.where(m & (ordn < K), rid, cap)
+    tgt_c = jnp.clip(ordn, 0, K - 1)
+    return jnp.full((cap, K), _BIG, jnp.int32).at[tgt_r, tgt_c].set(
+        pos, mode="drop")
+
+
+def _substring_index(expr: E.SubstringIndex, c: StrV, cap: int) -> StrV:
+    delim = lit_str(expr.delim, "substring_index delim")
+    count = lit_int(expr.count, "substring_index count")
+    if delim is None or count is None:
+        return _all_null_str(cap)
+    db = delim.encode("utf-8")
+    if len(db) != 1:
+        # same restriction as the reference (SubstringIndexMeta: "only a
+        # single character deliminator is supported")
+        raise UnsupportedExpressionError(
+            "substring_index only supports single-byte delimiters on TPU")
+    n = _char_cap(c)
+    lens = S.byte_lens(c.offsets)
+    if count == 0:
+        off, chars = S.take_slices(c, c.offsets[:-1], jnp.zeros(cap, jnp.int32), n)
+        return StrV(off, chars, c.validity)
+    m = S.find_matches(c.chars, db)
+    m = m & (jnp.arange(n, dtype=jnp.int32) < c.offsets[-1])
+    rid = S.row_ids(c.offsets, n)
+    P = S.prefix_counts(m)
+    cnt = P[c.offsets[1:]] - P[c.offsets[:-1]]
+    off = c.offsets[:-1]
+    if count > 0:
+        mat = _occurrence_matrix(m, rid, off[rid], P, cap, count)
+        end = jnp.where(cnt >= count, mat[:, count - 1], off + lens)
+        bs, nl = off, end - off
+    else:
+        K = -count
+        pos = jnp.arange(n, dtype=jnp.int32)
+        ord_end = (cnt[rid] - (P[pos] - P[off[rid]])) - 1
+        tgt_r = jnp.where(m & (ord_end < K) & (ord_end >= 0), rid, cap)
+        tgt_c = jnp.clip(ord_end, 0, K - 1)
+        mat = jnp.full((cap, K), _BIG, jnp.int32).at[tgt_r, tgt_c].set(
+            pos, mode="drop")
+        start = jnp.where(cnt >= K, mat[:, K - 1] + 1, off)
+        bs, nl = start, off + lens - start
+    nl = jnp.where(c.validity, jnp.maximum(nl, 0), 0)
+    noff, chars = S.take_slices(c, bs, nl, n)
+    return StrV(noff, chars, c.validity)
+
+
+def _split_part(expr: E.StringSplitPart, c: StrV, cap: int) -> StrV:
+    delim = lit_str(expr.delim, "split delimiter")
+    idx = lit_int(expr.index, "split index")
+    if delim is None or idx is None:
+        return _all_null_str(cap)
+    db = delim.encode("utf-8")
+    if not db:
+        raise UnsupportedExpressionError("split with empty delimiter")
+    if idx < 0:
+        raise UnsupportedExpressionError("split index must be >= 0")
+    if S.has_border(db):
+        raise UnsupportedExpressionError(
+            "split with a self-overlapping delimiter is not supported on TPU")
+    md = len(db)
+    n = _char_cap(c)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    rid = S.row_ids(c.offsets, n)
+    lens = S.byte_lens(c.offsets)
+    off = c.offsets[:-1]
+    within = pos - off[rid]
+    m = S.find_matches(c.chars, db)
+    m = m & ((within + md) <= lens[rid]) & (pos < c.offsets[-1])
+    P = S.prefix_counts(m)
+    cnt = P[c.offsets[1:]] - P[c.offsets[:-1]]
+    K = idx + 1
+    mat = _occurrence_matrix(m, rid, off[rid], P, cap, K)
+    start = off if idx == 0 else jnp.where(
+        cnt >= idx, mat[:, idx - 1] + md, _BIG)
+    end = jnp.where(cnt > idx, mat[:, idx], off + lens)
+    in_range = cnt >= idx  # idx < nparts = cnt + 1
+    valid = c.validity & in_range
+    nl = jnp.where(valid, jnp.maximum(end - jnp.minimum(start, end), 0), 0)
+    noff, chars = S.take_slices(c, jnp.where(in_range, start, 0), nl, n)
+    return StrV(noff, chars, valid)
+
+
+# ---------------------------------------------------------------------------
+# string casts (reference: GpuCast.scala string rows)
+# ---------------------------------------------------------------------------
+_TRUE_STRINGS = (b"t", b"true", b"y", b"yes", b"1")
+_FALSE_STRINGS = (b"f", b"false", b"n", b"no", b"0")
+
+
+def _trimmed_lower(c: StrV, cap: int) -> StrV:
+    """Whitespace-trimmed, lowercased copy (for string->bool/number)."""
+    low = S.map_case(c.chars, c.offsets[-1], upper=False)
+    tmp = StrV(c.offsets, low, c.validity)
+    n = _char_cap(c)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    rid = S.row_ids(c.offsets, n)
+    within = pos - c.offsets[:-1][rid]
+    # Java Character.isWhitespace over ASCII: \t \n \v \f \r and space
+    ws = (low == 0x20) | ((low >= 0x09) & (low <= 0x0D))
+    keep = (pos < c.offsets[-1]) & ~ws
+    lens = S.byte_lens(c.offsets)
+    first = jax.ops.segment_min(
+        jnp.where(keep, within, _BIG), rid, num_segments=cap,
+        indices_are_sorted=True)
+    last = jax.ops.segment_max(
+        jnp.where(keep, within, -1), rid, num_segments=cap,
+        indices_are_sorted=True)
+    first = jnp.where(first == _BIG, lens, first)
+    nl = jnp.where(c.validity, jnp.maximum(last + 1 - first, 0), 0)
+    off, chars = S.take_slices(tmp, c.offsets[:-1] + first, nl, n)
+    return StrV(off, chars, c.validity)
+
+
+def cast_string_to_bool(c: StrV, cap: int) -> ColV:
+    t = _trimmed_lower(c, cap)
+    is_true = jnp.zeros(cap, jnp.bool_)
+    is_false = jnp.zeros(cap, jnp.bool_)
+    for lit in _TRUE_STRINGS:
+        is_true = is_true | S.equals_literal(t, lit)
+    for lit in _FALSE_STRINGS:
+        is_false = is_false | S.equals_literal(t, lit)
+    return ColV(is_true, c.validity & (is_true | is_false))
+
+
+def cast_string_to_int(c: StrV, cap: int, to: T.DataType) -> ColV:
+    """Spark non-ANSI string->integral: trimmed, optional sign, digits only;
+    anything else (including overflow) -> null (UTF8String.toLong)."""
+    t = _trimmed_lower(c, cap)
+    n = _char_cap(t)
+    lens = S.byte_lens(t.offsets)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    rid = S.row_ids(t.offsets, n)
+    within = pos - t.offsets[:-1][rid]
+    in_data = pos < t.offsets[-1]
+    first = t.chars[jnp.clip(t.offsets[:-1], 0, n - 1)]
+    has_sign = (first == ord("-")) | (first == ord("+"))
+    neg = first == ord("-")
+    is_digit_pos = (t.chars >= ord("0")) & (t.chars <= ord("9"))
+    bad = in_data & ~is_digit_pos & ~((within == 0) & has_sign[rid])
+    nbad = jax.ops.segment_sum(
+        bad.astype(jnp.int32), rid, num_segments=cap, indices_are_sorted=True)
+    ndigits = lens - has_sign.astype(jnp.int32)
+    # significant digits (leading zeros don't count toward the 19-digit
+    # uint64 accumulation bound: '000...0123' stays parseable)
+    nz_first = jax.ops.segment_min(
+        jnp.where(in_data & is_digit_pos & (t.chars != ord("0")), within, _BIG),
+        rid, num_segments=cap, indices_are_sorted=True)
+    sig = jnp.where(nz_first == _BIG, 1, lens - nz_first)
+    ok = (nbad == 0) & (ndigits >= 1) & (sig <= 19)
+    # accumulate into uint64 via per-digit place values (static 19 unroll):
+    # digit at within w (after sign) has place ndigits-1-(w-sign)
+    place = ndigits[rid] - 1 - (within - has_sign[rid].astype(jnp.int32))
+    contrib = jnp.where(
+        in_data & is_digit_pos & (place >= 0) & (place < 19),
+        (t.chars - ord("0")).astype(jnp.uint64)
+        * jnp.asarray(10, jnp.uint64) ** jnp.clip(place, 0, 18).astype(jnp.uint64),
+        jnp.zeros(n, jnp.uint64),
+    )
+    mag = jax.ops.segment_sum(contrib, rid, num_segments=cap,
+                              indices_are_sorted=True)
+    # overflow: magnitude beyond int64 range (19 digits can reach 1e19-1
+    # > 2^63-1). uint64 accumulation is exact (max 19 nines < 2^64).
+    limit = jnp.where(neg, jnp.asarray(2**63, jnp.uint64),
+                      jnp.asarray(2**63 - 1, jnp.uint64))
+    ok = ok & (mag <= limit)
+    sval = jnp.where(neg, -(mag.astype(jnp.int64)), mag.astype(jnp.int64))
+    info = {"tinyint": np.int8, "smallint": np.int16, "int": np.int32,
+            "bigint": np.int64}
+    npdt = info[to.name]
+    if to.name != "bigint":
+        rng = np.iinfo(npdt)
+        ok = ok & (sval >= rng.min) & (sval <= rng.max)
+    return ColV(sval.astype(npdt), c.validity & ok)
+
+
+def cast_string_to_float(c: StrV, cap: int, to: T.DataType) -> ColV:
+    """string->float/double behind castStringToFloat.enabled (same gate and
+    same documented inexactness as the reference: digit accumulation, not
+    correctly-rounded strtod for >15 significant digits)."""
+    t = _trimmed_lower(c, cap)
+    n = _char_cap(t)
+    lens = S.byte_lens(t.offsets)
+    # specials ('inf'/'infinity'/'nan' after lowercase/trim, with sign)
+    res = jnp.zeros(cap, jnp.float64)
+    special = jnp.zeros(cap, jnp.bool_)
+    for lit, v in [(b"inf", np.inf), (b"+inf", np.inf), (b"-inf", -np.inf),
+                   (b"infinity", np.inf), (b"+infinity", np.inf),
+                   (b"-infinity", -np.inf), (b"nan", np.nan)]:
+        hit = S.equals_literal(t, lit)
+        res = jnp.where(hit, v, res)
+        special = special | hit
+    pos = jnp.arange(n, dtype=jnp.int32)
+    rid = S.row_ids(t.offsets, n)
+    within = pos - t.offsets[:-1][rid]
+    in_data = pos < t.offsets[-1]
+    ch = t.chars
+    first = ch[jnp.clip(t.offsets[:-1], 0, n - 1)]
+    has_sign = (first == ord("-")) | (first == ord("+"))
+    neg = first == ord("-")
+    is_digit = (ch >= ord("0")) & (ch <= ord("9"))
+    is_dot = ch == ord(".")
+    is_e = ch == ord("e")
+    # exponent marker position per row (at most one)
+    epos = jax.ops.segment_min(
+        jnp.where(in_data & is_e, within, _BIG), rid, num_segments=cap,
+        indices_are_sorted=True)
+    dotpos = jax.ops.segment_min(
+        jnp.where(in_data & is_dot, within, _BIG), rid, num_segments=cap,
+        indices_are_sorted=True)
+    n_e = jax.ops.segment_sum((in_data & is_e).astype(jnp.int32), rid,
+                              num_segments=cap, indices_are_sorted=True)
+    n_dot = jax.ops.segment_sum((in_data & is_dot).astype(jnp.int32), rid,
+                                num_segments=cap, indices_are_sorted=True)
+    mant_end = jnp.where(epos == _BIG, lens, epos)
+    # mantissa digit places: digits before mant_end, skipping the dot
+    in_mant = in_data & (within < mant_end[rid]) & is_digit
+    # digit index among mantissa digits (prefix count of mantissa digits)
+    mant_mark = in_mant.astype(jnp.int32)
+    Pm = S.prefix_counts(mant_mark)
+    mdig_total = Pm[t.offsets[1:]] - Pm[t.offsets[:-1]]  # approx: all digits
+    # count only digits before mant_end per row
+    md_before = jax.ops.segment_sum(
+        jnp.where(in_mant, 1, 0), rid, num_segments=cap,
+        indices_are_sorted=True)
+    del mdig_total
+    midx = Pm[pos] - Pm[t.offsets[:-1]][rid]  # ordinal of this mantissa digit
+    place = md_before[rid] - 1 - midx
+    # keep the 17 MOST SIGNIFICANT digits (ordinal counted from the first
+    # nonzero digit, so leading zeros don't consume the budget) at their
+    # true place: long mantissas keep their magnitude, only sub-ulp digits
+    # drop
+    nzidx = jax.ops.segment_min(
+        jnp.where(in_mant & (ch != ord("0")), midx, _BIG), rid,
+        num_segments=cap, indices_are_sorted=True)
+    contrib = jnp.where(
+        in_mant & ((midx - nzidx[rid]) < 17),
+        (ch - ord("0")).astype(jnp.float64)
+        * 10.0 ** place.astype(jnp.float64),
+        0.0)
+    mant = jax.ops.segment_sum(contrib, rid, num_segments=cap,
+                               indices_are_sorted=True)
+    # fraction digits = mantissa digits after the dot
+    frac = jnp.where(
+        dotpos < mant_end,
+        jax.ops.segment_sum(
+            jnp.where(in_mant & (within > dotpos[rid]), 1, 0), rid,
+            num_segments=cap, indices_are_sorted=True),
+        0)
+    # exponent value
+    e_first = ch[jnp.clip(t.offsets[:-1] + epos + 1, 0, n - 1)]
+    e_sign = jnp.where(epos < lens, (e_first == ord("-")), False)
+    e_has_sign = (e_first == ord("-")) | (e_first == ord("+"))
+    in_exp = in_data & (within > (epos[rid] + e_has_sign[rid].astype(jnp.int32)))
+    exp_dig_bad = jax.ops.segment_sum(
+        (in_exp & ~is_digit).astype(jnp.int32), rid, num_segments=cap,
+        indices_are_sorted=True)
+    ndexp = jnp.where(
+        epos == _BIG, 0,
+        lens - epos - 1 - e_has_sign.astype(jnp.int32))
+    Pe = S.prefix_counts((in_exp & is_digit).astype(jnp.int32) > 0)
+    eidx = Pe[pos] - Pe[t.offsets[:-1]][rid]
+    eplace = ndexp[rid] - 1 - eidx
+    econtrib = jnp.where(
+        in_exp & is_digit & (eplace < 9),
+        (ch - ord("0")).astype(jnp.int32) * 10 ** jnp.clip(eplace, 0, 8),
+        0)
+    eval_ = jax.ops.segment_sum(econtrib, rid, num_segments=cap,
+                                indices_are_sorted=True)
+    eval_ = jnp.where(e_sign, -eval_, eval_)
+    scale = eval_ - frac
+    val = mant * jnp.power(10.0, scale.astype(jnp.float64))
+    val = jnp.where(neg, -val, val)
+    # validity: digits/dot/sign/e only, <=1 dot, <=1 e, >=1 mantissa digit,
+    # exponent digits valid and >=1 when e present
+    bad = in_data & ~is_digit & ~is_dot & ~is_e \
+        & ~((within == 0) & has_sign[rid]) \
+        & ~((within == (epos[rid] + 1)) & e_has_sign[rid])
+    nbad = jax.ops.segment_sum(bad.astype(jnp.int32), rid, num_segments=cap,
+                               indices_are_sorted=True)
+    ok = (
+        (nbad == 0) & (n_dot <= 1) & (n_e <= 1) & (md_before >= 1)
+        & ((epos == _BIG) | (ndexp >= 1))
+        & (exp_dig_bad == 0)
+        & ((dotpos == _BIG) | (dotpos < mant_end))
+    )
+    out = jnp.where(special, res, val)
+    ok = ok | special
+    npdt = np.float32 if isinstance(to, T.FloatType) else np.float64
+    return ColV(out.astype(npdt), c.validity & ok)
+
+
+_DIGIT_POWS = np.asarray([10**k for k in range(19)], np.uint64)
+
+
+def cast_int_to_string(c: ColV, cap: int, frm: T.DataType) -> StrV:
+    """Integral -> decimal string (always-on in the reference)."""
+    x = c.data.astype(jnp.int64)
+    neg = x < 0
+    # abs via uint64 to survive INT64_MIN
+    mag = jnp.where(neg, (~x.astype(jnp.uint64)) + 1, x.astype(jnp.uint64))
+    pows = jnp.asarray(_DIGIT_POWS)
+    digits = (mag[:, None] // pows[None, :]) % 10  # (cap, 19) LSD-first
+    # highest nonzero digit index -> digit count (1 for zero)
+    hi = 18 - jnp.argmax(jnp.flip(digits, axis=1) != 0, axis=1)
+    nd = jnp.where(mag == 0, 1, hi + 1).astype(jnp.int32)
+    lens = jnp.where(c.validity, nd + neg.astype(jnp.int32), 0)
+    new_offsets = S.offsets_of_lens(lens)
+    out_cap = bucket_rows(max(cap * 20, 128))
+    pos = jnp.arange(out_cap, dtype=jnp.int32)
+    rid = jnp.clip(jnp.searchsorted(new_offsets, pos, side="right") - 1, 0, cap - 1)
+    w = pos - new_offsets[:-1][rid]
+    sign_len = neg[rid].astype(jnp.int32)
+    k = nd[rid] - 1 - (w - sign_len)  # digit place, MSD first
+    dig = digits[rid, jnp.clip(k, 0, 18)].astype(jnp.uint8) + ord("0")
+    out = jnp.where((w == 0) & neg[rid], np.uint8(ord("-")), dig)
+    out = jnp.where(pos < new_offsets[-1], out, jnp.uint8(0))
+    return StrV(new_offsets, out, c.validity)
+
+
+def cast_bool_to_string(c: ColV, cap: int) -> StrV:
+    lens = jnp.where(c.validity, jnp.where(c.data, 4, 5), 0)
+    new_offsets = S.offsets_of_lens(lens)
+    out_cap = bucket_rows(max(cap * 5, 128))
+    tpat = jnp.asarray(np.frombuffer(b"true\x00", np.uint8))
+    fpat = jnp.asarray(np.frombuffer(b"false", np.uint8))
+    pos = jnp.arange(out_cap, dtype=jnp.int32)
+    rid = jnp.clip(jnp.searchsorted(new_offsets, pos, side="right") - 1, 0, cap - 1)
+    w = jnp.clip(pos - new_offsets[:-1][rid], 0, 4)
+    out = jnp.where(c.data[rid], tpat[w], fpat[w])
+    out = jnp.where(pos < new_offsets[-1], out, jnp.uint8(0))
+    return StrV(new_offsets, out, c.validity)
+
+
+# ---------------------------------------------------------------------------
+# dispatcher
+# ---------------------------------------------------------------------------
+def lower_strings(expr: E.Expression, ev: Callable, cap: int):
+    """Lower a string-family expression; None if ``expr`` isn't one."""
+    if isinstance(expr, (E.Upper, E.Lower)):
+        return _upper_lower(expr, ev(expr.child), isinstance(expr, E.Upper))
+    if isinstance(expr, E.InitCap):
+        return _initcap(ev(expr.child))
+    if isinstance(expr, E.Substring):
+        return _substring(expr, ev(expr.str), cap)
+    if isinstance(expr, E.Concat):
+        return _concat([as_strv(ev(e), cap) for e in expr.children_])
+    if isinstance(expr, (E.StringTrim, E.StringTrimLeft, E.StringTrimRight)):
+        return _trim(expr, ev(expr.column), cap)
+    if isinstance(expr, (E.StartsWith, E.EndsWith, E.Contains)):
+        return _string_predicate(expr, ev(expr.left), cap)
+    if isinstance(expr, E.Like):
+        return _like(expr, ev(expr.left), cap)
+    if isinstance(expr, E.StringLocate):
+        return _locate(expr, ev(expr.str), cap)
+    if isinstance(expr, E.StringReplace):
+        return _replace(expr, ev(expr.str), cap)
+    if isinstance(expr, E.StringLPad):
+        return _pad(expr, ev(expr.str), cap, left=True)
+    if isinstance(expr, E.StringRPad):
+        return _pad(expr, ev(expr.str), cap, left=False)
+    if isinstance(expr, E.SubstringIndex):
+        return _substring_index(expr, ev(expr.str), cap)
+    if isinstance(expr, E.StringSplitPart):
+        return _split_part(expr, ev(expr.str), cap)
+    return None
+
+
+def lower_string_cast(c: StrV, to: T.DataType, cap: int):
+    """Casts FROM string."""
+    if isinstance(to, (T.StringType,)):
+        return c
+    if isinstance(to, T.BooleanType):
+        return cast_string_to_bool(c, cap)
+    if to.name in ("tinyint", "smallint", "int", "bigint"):
+        return cast_string_to_int(c, cap, to)
+    if to.is_floating:
+        return cast_string_to_float(c, cap, to)
+    raise UnsupportedExpressionError(
+        f"cast string -> {to.simpleString} is not supported on TPU")
+
+
+def lower_cast_to_string(c: ColV, frm: T.DataType, cap: int):
+    """Casts TO string from fixed-width types."""
+    if isinstance(frm, T.BooleanType):
+        return cast_bool_to_string(c, cap)
+    if frm.name in ("tinyint", "smallint", "int", "bigint"):
+        return cast_int_to_string(c, cap, frm)
+    if frm.is_floating:
+        raise UnsupportedExpressionError(
+            "cast float -> string is not supported on TPU (would require "
+            "Java shortest-repr formatting; the reference gates this behind "
+            "spark.rapids.sql.castFloatToString.enabled for the same reason)")
+    raise UnsupportedExpressionError(
+        f"cast {frm.simpleString} -> string is not supported on TPU")

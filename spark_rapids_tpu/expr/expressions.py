@@ -663,17 +663,216 @@ class ShiftRightUnsigned(Expression):
 
 
 # ---------------------------------------------------------------------------
-# Strings — minimal slice here; full set in ops/strings (M10)
+# Strings (reference: sql/rapids/stringFunctions.scala, 889 LoC)
 # ---------------------------------------------------------------------------
 @dataclasses.dataclass(frozen=True)
 class Length(Expression):
-    """Character length (reference: stringFunctions.scala GpuLength)."""
+    """Character length (reference: stringFunctions.scala GpuLength:52)."""
 
     child: Expression
 
     @property
     def dtype(self):
         return T.INT
+
+
+@dataclasses.dataclass(frozen=True)
+class _UnaryString(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+class Upper(_UnaryString):
+    """reference: GpuUpper (stringFunctions.scala:36)"""
+
+
+class Lower(_UnaryString):
+    """reference: GpuLower (stringFunctions.scala:44)"""
+
+
+class InitCap(_UnaryString):
+    """reference: GpuInitCap (stringFunctions.scala:405); like the
+    reference, incompatible for some Unicode (here: code points >= U+0250
+    pass through unmapped)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Substring(Expression):
+    """reference: GpuSubstring (stringFunctions.scala:336). pos/len follow
+    UTF8String.substringSQL: 1-based, pos<=0 and negative positions per
+    Spark; character (not byte) indexing."""
+
+    str: Expression
+    pos: Expression
+    len: Expression
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+@dataclasses.dataclass(frozen=True)
+class Concat(Expression):
+    """reference: GpuConcat (stringFunctions.scala:265): null if any input
+    is null."""
+
+    children_: Tuple[Expression, ...]
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+@dataclasses.dataclass(frozen=True)
+class _TrimBase(Expression):
+    column: Expression
+    trim_str: Optional[str] = None  # None = trim ASCII space (Spark default)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+class StringTrim(_TrimBase):
+    """reference: GpuStringTrim (stringFunctions.scala:211)"""
+
+
+class StringTrimLeft(_TrimBase):
+    """reference: GpuStringTrimLeft (stringFunctions.scala:229)"""
+
+
+class StringTrimRight(_TrimBase):
+    """reference: GpuStringTrimRight (stringFunctions.scala:247)"""
+
+
+@dataclasses.dataclass(frozen=True)
+class _BinaryStringPredicate(Expression):
+    """left: string column; right must be a string literal (same restriction
+    as the reference's GpuStartsWith/GpuEndsWith/GpuContains which require a
+    scalar rhs)."""
+
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+class StartsWith(_BinaryStringPredicate):
+    """reference: GpuStartsWith (stringFunctions.scala:149)"""
+
+
+class EndsWith(_BinaryStringPredicate):
+    """reference: GpuEndsWith (stringFunctions.scala:180)"""
+
+
+class Contains(_BinaryStringPredicate):
+    """reference: GpuContains (stringFunctions.scala:305)"""
+
+
+@dataclasses.dataclass(frozen=True)
+class Like(Expression):
+    """SQL LIKE with %/_ wildcards; pattern must be a literal (reference:
+    GpuLike stringFunctions.scala:506)."""
+
+    left: Expression
+    pattern: Expression
+    escape: str = "\\"
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+@dataclasses.dataclass(frozen=True)
+class StringLocate(Expression):
+    """locate(substr, str, start): 1-based char position of the first
+    occurrence at/after start; 0 = not found (reference: GpuStringLocate
+    stringFunctions.scala:62 — substr and start must be literals)."""
+
+    substr: Expression
+    str: Expression
+    start: Expression
+
+    @property
+    def dtype(self):
+        return T.INT
+
+
+@dataclasses.dataclass(frozen=True)
+class StringReplace(Expression):
+    """replace(str, search, replacement) with literal search/replacement
+    (reference: GpuStringReplace stringFunctions.scala:412)."""
+
+    str: Expression
+    search: Expression
+    replacement: Expression
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+@dataclasses.dataclass(frozen=True)
+class StringLPad(Expression):
+    """reference: GpuStringLPad (stringFunctions.scala:776); len and pad
+    must be literals."""
+
+    str: Expression
+    len: Expression
+    pad: Expression
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+@dataclasses.dataclass(frozen=True)
+class StringRPad(Expression):
+    """reference: GpuStringRPad (stringFunctions.scala:786)"""
+
+    str: Expression
+    len: Expression
+    pad: Expression
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+@dataclasses.dataclass(frozen=True)
+class SubstringIndex(Expression):
+    """substring_index(str, delim, count) (reference: GpuSubstringIndex
+    stringFunctions.scala:639); delim/count literals."""
+
+    str: Expression
+    delim: Expression
+    count: Expression
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+@dataclasses.dataclass(frozen=True)
+class StringSplitPart(Expression):
+    """split(str, delim)[index] fused into one node — the engine's analog of
+    the reference's GpuStringSplit (stringFunctions.scala:832) + array
+    getitem, pending full array-type columns. delim is a literal treated as
+    a plain string (the reference applies the same regex-as-literal guard,
+    GpuOverrides.canRegexpBeTreatedLikeARegularString); index >= 0."""
+
+    str: Expression
+    delim: Expression
+    index: Expression
+
+    @property
+    def dtype(self):
+        return T.STRING
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,29 @@
+"""Trace-time value representation shared by the expression lowerings.
+
+Split out of eval.py so specialised lowering modules (eval_strings.py,
+later eval_datetime.py) can share the types without import cycles.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax
+
+
+class ColV(NamedTuple):
+    data: jax.Array
+    validity: jax.Array
+
+
+class StrV(NamedTuple):
+    offsets: jax.Array
+    chars: jax.Array
+    validity: jax.Array
+
+
+Val = Union[ColV, StrV]
+
+
+class UnsupportedExpressionError(Exception):
+    """Raised when a tree can't lower to TPU; planner uses this to fall back
+    (reference: RapidsMeta.willNotWorkOnGpu)."""

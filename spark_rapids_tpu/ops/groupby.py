@@ -175,6 +175,10 @@ def sort_groupby(
         key_cols, key_dtypes, orders, num_rows, str_max_lens
     )
     live_in = live_of(num_rows, cap)
+    # dead rows sort last (pad_rank is the leading sort key), so liveness in
+    # sorted order is the permuted mask — equivalently a prefix of n_live.
+    # Using the RAW mask here mislabels rows whenever the mask isn't already
+    # a prefix (e.g. after a fused filter) — a real dropped-row bug.
     live = jnp.take(live_in, perm, mode="clip")
     sorted_keys = gather(key_cols, perm, live)
     sorted_vals: List[Optional[ColV]] = []
@@ -185,7 +189,7 @@ def sort_groupby(
             g = gather([v], perm, live)[0]
             assert isinstance(g, ColV)
             sorted_vals.append(g)
-    seg, nseg = segment_ids_from_radix_keys(radix, num_rows)
+    seg, nseg = segment_ids_from_radix_keys(radix, live)
 
     # representative row (first) of each segment, for key output
     idx = jnp.arange(cap, dtype=jnp.int32)

@@ -1,0 +1,289 @@
+"""String kernels over Arrow offsets+chars columns, XLA-native.
+
+Reference analog: the cudf string kernels consumed by
+sql-plugin/.../sql/rapids/stringFunctions.scala (substring, locate, concat,
+pad, replace, LIKE, trim, case mapping) and GpuCast.scala's string casts.
+cudf implements these as per-row CUDA kernels over the same Arrow layout
+(offsets int32 + chars uint8). There is no cudf on TPU, so this module
+re-designs each operation as a *static-shape, whole-column* XLA program:
+
+  * per-byte row ids via vectorized searchsorted over the offsets array;
+  * per-row reductions (first mismatch, first non-space, match counts) via
+    segment_min/segment_sum with sorted segment ids;
+  * pattern search as a shifted-compare over the whole chars buffer with a
+    static unroll over the (literal) pattern bytes;
+  * ragged outputs built by one gather pass over the output byte space
+    (out position -> source position), never per-row Python.
+
+Everything here traces inside the engine's single fused projection jit
+(expr/eval.py), so XLA fuses string predicates with the surrounding
+arithmetic — there is no kernel-per-op dispatch like the CUDA path.
+
+UTF-8: Spark compares strings as unsigned bytes (UTF8String.compareTo) and
+indexes by *character*; both are honored — byte-wise compares, and char
+indexing via a cumsum over non-continuation bytes. Case mapping covers
+code points < 0x250 (ASCII + Latin supplements, the byte-length-preserving
+range); beyond that bytes pass through unchanged (documented incompat, like
+the reference's GpuInitCap incompatibility notes).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..expr.values import StrV as Str  # (offsets, chars, validity)
+
+BIG = np.int32(2**31 - 1)
+
+
+# ---------------------------------------------------------------------------
+# layout primitives
+# ---------------------------------------------------------------------------
+def byte_lens(offsets: jax.Array) -> jax.Array:
+    return offsets[1:] - offsets[:-1]
+
+
+def row_ids(offsets: jax.Array, nbytes: int) -> jax.Array:
+    """Row id per byte position of the chars buffer (padding bytes clamp to
+    the last row; callers mask with ``in_data``)."""
+    cap = offsets.shape[0] - 1
+    pos = jnp.arange(nbytes, dtype=jnp.int32)
+    return jnp.clip(jnp.searchsorted(offsets, pos, side="right") - 1, 0, cap - 1)
+
+
+def char_starts(chars: jax.Array, total: jax.Array) -> jax.Array:
+    """True at bytes that begin a UTF-8 code point, False past ``total``."""
+    n = chars.shape[0]
+    in_data = jnp.arange(n, dtype=jnp.int32) < total
+    return ((chars & 0xC0) != 0x80) & in_data
+
+
+def char_prefix(chars: jax.Array, total: jax.Array) -> jax.Array:
+    """(nbytes+1,) exclusive prefix count of char-start bytes: the number of
+    characters strictly before byte p is ``char_prefix[p]``."""
+    starts = char_starts(chars, total)
+    return jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(starts.astype(jnp.int32))]
+    )
+
+
+def char_positions(chars: jax.Array, total: jax.Array) -> jax.Array:
+    """(nbytes,) map char ordinal -> byte position of that char's first byte.
+
+    Built with a scatter: start byte p has ordinal char_prefix[p]; unused
+    slots hold ``total`` so out-of-range ordinals land at the data end.
+    """
+    n = chars.shape[0]
+    starts = char_starts(chars, total)
+    cp = char_prefix(chars, total)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    tgt = jnp.where(starts, cp[:-1], n)  # out-of-bounds -> dropped
+    return (
+        jnp.full(n, total, dtype=jnp.int32).at[tgt].set(pos, mode="drop")
+    )
+
+
+def char_counts(s: Str) -> jax.Array:
+    """Per-row character counts (Spark length())."""
+    total = s.offsets[-1]
+    cp = char_prefix(s.chars, total)
+    return cp[s.offsets[1:]] - cp[s.offsets[:-1]]
+
+
+def char_to_byte(s: Str, char_idx: jax.Array) -> jax.Array:
+    """Per-row: byte position of character ``char_idx`` (0-based within the
+    row), clamped to the row end for out-of-range ordinals."""
+    total = s.offsets[-1]
+    cp = char_prefix(s.chars, total)
+    pos = char_positions(s.chars, total)
+    nchars = cp[s.offsets[1:]] - cp[s.offsets[:-1]]
+    first = cp[s.offsets[:-1]]
+    k = jnp.clip(char_idx, 0, nchars)
+    n = s.chars.shape[0]
+    raw = pos[jnp.clip(first + k, 0, n - 1)]
+    return jnp.where(k >= nchars, s.offsets[1:], raw).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# comparison
+# ---------------------------------------------------------------------------
+def compare(a: Str, b: Str) -> Tuple[jax.Array, jax.Array]:
+    """(lt, eq) per row — unsigned byte-wise, Spark UTF8String.compareTo.
+
+    One aligned-gather pass over a's chars buffer: byte j of a is matched
+    with the byte at the same within-row position of b, the first mismatch
+    per row found with segment_min, then a single byte compare decides.
+    """
+    cap = a.offsets.shape[0] - 1
+    na, nb = a.chars.shape[0], b.chars.shape[0]
+    la, lb = byte_lens(a.offsets), byte_lens(b.offsets)
+    rid = row_ids(a.offsets, na)
+    pos = jnp.arange(na, dtype=jnp.int32)
+    within = pos - a.offsets[rid]
+    common = jnp.minimum(la, lb)[rid]
+    in_cmp = (within < common) & (pos < a.offsets[-1])
+    bb = b.chars[jnp.clip(b.offsets[rid] + within, 0, nb - 1)]
+    mism = in_cmp & (a.chars != bb)
+    first = jax.ops.segment_min(
+        jnp.where(mism, within, BIG), rid, num_segments=cap,
+        indices_are_sorted=True,
+    )
+    has = first < BIG
+    av = a.chars[jnp.clip(a.offsets[:-1] + first, 0, na - 1)]
+    bv = b.chars[jnp.clip(b.offsets[:-1] + first, 0, nb - 1)]
+    lt = jnp.where(has, av < bv, la < lb)
+    eq = ~has & (la == lb)
+    return lt, eq
+
+
+def equals_literal(s: Str, lit: bytes) -> jax.Array:
+    """Per-row equality against a host-side literal (string IN lists)."""
+    lens = byte_lens(s.offsets)
+    if len(lit) == 0:
+        return lens == 0
+    m = find_matches(s.chars, lit)
+    n = s.chars.shape[0]
+    at = m[jnp.clip(s.offsets[:-1], 0, n - 1)]
+    return (lens == len(lit)) & at
+
+
+# ---------------------------------------------------------------------------
+# literal pattern search
+# ---------------------------------------------------------------------------
+def find_matches(chars: jax.Array, pat: bytes) -> jax.Array:
+    """match[p] = chars[p:p+len(pat)] == pat. Static unroll over the pattern
+    bytes (a shifted compare per byte); positions whose window runs past the
+    buffer are False."""
+    n = chars.shape[0]
+    m = len(pat)
+    assert m >= 1
+    padded = jnp.concatenate([chars, jnp.zeros(m, jnp.uint8)])
+    out = jnp.ones(n, jnp.bool_)
+    for k, byte in enumerate(pat):
+        out = out & (jax.lax.dynamic_slice_in_dim(padded, k, n) == np.uint8(byte))
+    limit = n - m
+    return out & (jnp.arange(n, dtype=jnp.int32) <= limit)
+
+
+def prefix_counts(mask: jax.Array) -> jax.Array:
+    """(n+1,) exclusive prefix sums of a bool mask."""
+    return jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(mask.astype(jnp.int32))]
+    )
+
+
+def next_match(match: jax.Array) -> jax.Array:
+    """(n+1,) nm[p] = smallest q >= p with match[q], else BIG (reverse
+    running minimum)."""
+    n = match.shape[0]
+    idx = jnp.where(match, jnp.arange(n, dtype=jnp.int32), BIG)
+    rm = jax.lax.cummin(idx, reverse=True)
+    return jnp.concatenate([rm, jnp.full(1, BIG, jnp.int32)])
+
+
+def has_border(pat: bytes) -> bool:
+    """True if the pattern has a proper border (can self-overlap), in which
+    case greedy non-overlapping replace is order-dependent and the planner
+    falls back (reference falls back for regex-special patterns similarly)."""
+    m = len(pat)
+    return any(pat[: m - d] == pat[d:] for d in range(1, m))
+
+
+# ---------------------------------------------------------------------------
+# ragged output builders
+# ---------------------------------------------------------------------------
+def _out_rows(new_offsets: jax.Array, out_cap: int) -> Tuple[jax.Array, jax.Array]:
+    cap = new_offsets.shape[0] - 1
+    pos = jnp.arange(out_cap, dtype=jnp.int32)
+    rid = jnp.clip(
+        jnp.searchsorted(new_offsets, pos, side="right") - 1, 0, cap - 1
+    )
+    return rid, pos - new_offsets[rid]
+
+
+def offsets_of_lens(new_lens: jax.Array) -> jax.Array:
+    return jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(new_lens.astype(jnp.int32))]
+    )
+
+
+def take_slices(s: Str, start_bytes: jax.Array, new_lens: jax.Array,
+                out_cap: int) -> Tuple[jax.Array, jax.Array]:
+    """Build (new_offsets, out_chars) where each output row is the
+    contiguous byte slice [start_bytes, start_bytes + new_lens) of the
+    source buffer. Serves substring / trim / substring_index / split-part."""
+    new_offsets = offsets_of_lens(new_lens)
+    rid, within = _out_rows(new_offsets, out_cap)
+    src = jnp.clip(start_bytes[rid] + within, 0, s.chars.shape[0] - 1)
+    out = jnp.where(
+        jnp.arange(out_cap, dtype=jnp.int32) < new_offsets[-1],
+        s.chars[src], jnp.uint8(0),
+    )
+    return new_offsets, out
+
+
+def concat(pieces: Sequence[Str], out_cap: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Spark concat(): per-row byte concatenation; null if ANY input null.
+    Returns (new_offsets, out_chars, validity)."""
+    valid = functools.reduce(jnp.logical_and, [p.validity for p in pieces])
+    lens = [byte_lens(p.offsets) for p in pieces]
+    total = functools.reduce(jnp.add, lens)
+    total = jnp.where(valid, total, 0)
+    new_offsets = offsets_of_lens(total)
+    rid, within = _out_rows(new_offsets, out_cap)
+    out = jnp.zeros(out_cap, jnp.uint8)
+    cum = jnp.zeros_like(rid)
+    for p, ln in zip(pieces, lens):
+        w = within - cum
+        sel = (w >= 0) & (w < ln[rid])
+        src = jnp.clip(p.offsets[:-1][rid] + w, 0, p.chars.shape[0] - 1)
+        out = jnp.where(sel, p.chars[src], out)
+        cum = cum + ln[rid]
+    out = jnp.where(
+        jnp.arange(out_cap, dtype=jnp.int32) < new_offsets[-1], out, jnp.uint8(0)
+    )
+    return new_offsets, out, valid
+
+
+# ---------------------------------------------------------------------------
+# case mapping (code points < 0x250 — byte-length preserving range)
+# ---------------------------------------------------------------------------
+@functools.lru_cache(maxsize=4)
+def _case_luts(upper: bool) -> np.ndarray:
+    lut = np.arange(0x250, dtype=np.int32)
+    for cp in range(0x250):
+        c = chr(cp)
+        m = c.upper() if upper else c.lower()
+        if len(m) == 1 and ord(m) < 0x250 and (
+            len(m.encode("utf-8")) == len(c.encode("utf-8"))
+        ):
+            lut[cp] = ord(m)
+    return lut
+
+
+def map_case(chars: jax.Array, total: jax.Array, upper: bool) -> jax.Array:
+    """Byte-length-preserving simple case mapping. ASCII and 2-byte
+    sequences below U+0250 are mapped; everything else passes through."""
+    lut = jnp.asarray(_case_luts(upper))
+    n = chars.shape[0]
+    is_ascii = chars < 0x80
+    is2 = (chars & 0xE0) == 0xC0
+    nxt = jnp.concatenate([chars[1:], jnp.zeros(1, jnp.uint8)])
+    prv = jnp.concatenate([jnp.zeros(1, jnp.uint8), chars[:-1]])
+    cp2 = ((chars & 0x1F).astype(jnp.int32) << 6) | (nxt & 0x3F).astype(jnp.int32)
+    mapped2 = lut[jnp.clip(cp2, 0, 0x24F)]
+    in_range2 = is2 & (cp2 < 0x250)
+    # continuation byte of a mapped 2-byte char: recompute from prev
+    prev_cp2 = ((prv & 0x1F).astype(jnp.int32) << 6) | (chars & 0x3F).astype(jnp.int32)
+    prev_is2 = (prv & 0xE0) == 0xC0
+    prev_mapped = lut[jnp.clip(prev_cp2, 0, 0x24F)]
+    prev_in = prev_is2 & (prev_cp2 < 0x250) & ((chars & 0xC0) == 0x80)
+    out = chars
+    out = jnp.where(is_ascii, lut[jnp.clip(chars.astype(jnp.int32), 0, 0x7F)].astype(jnp.uint8), out)
+    out = jnp.where(in_range2, (0xC0 | (mapped2 >> 6)).astype(jnp.uint8), out)
+    out = jnp.where(prev_in, (0x80 | (prev_mapped & 0x3F)).astype(jnp.uint8), out)
+    return jnp.where(jnp.arange(n, dtype=jnp.int32) < total, out, chars)

@@ -23,6 +23,9 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple, Type
 from .. import types as T
 from ..conf import (
     DECIMAL_ENABLED,
+    ENABLE_CAST_STRING_TO_FLOAT,
+    ENABLE_CAST_STRING_TO_INTEGER,
+    ENABLE_CAST_STRING_TO_TIMESTAMP,
     EXPLAIN,
     IMPROVED_FLOAT_OPS,
     RapidsConf,
@@ -127,6 +130,24 @@ for _cls, _name, _desc in [
     (E.ShiftRight, "ShiftRight", "shift right"),
     (E.ShiftRightUnsigned, "ShiftRightUnsigned", "unsigned shift right"),
     (E.Length, "Length", "string character length"),
+    (E.Upper, "Upper", "uppercase conversion"),
+    (E.Lower, "Lower", "lowercase conversion"),
+    (E.InitCap, "InitCap", "capitalize each word"),
+    (E.Substring, "Substring", "substring by character position"),
+    (E.Concat, "Concat", "string concatenation"),
+    (E.StringTrim, "StringTrim", "trim both ends"),
+    (E.StringTrimLeft, "StringTrimLeft", "trim leading chars"),
+    (E.StringTrimRight, "StringTrimRight", "trim trailing chars"),
+    (E.StartsWith, "StartsWith", "prefix test"),
+    (E.EndsWith, "EndsWith", "suffix test"),
+    (E.Contains, "Contains", "substring containment test"),
+    (E.Like, "Like", "SQL LIKE pattern match"),
+    (E.StringLocate, "StringLocate", "substring position (1-based)"),
+    (E.StringReplace, "StringReplace", "replace all occurrences"),
+    (E.StringLPad, "StringLPad", "left-pad to length"),
+    (E.StringRPad, "StringRPad", "right-pad to length"),
+    (E.SubstringIndex, "SubstringIndex", "substring before/after delimiter"),
+    (E.StringSplitPart, "StringSplit", "split on delimiter + index"),
     (A.AggregateExpression, "AggregateExpression", "aggregate holder"),
     (A.Count, "Count", "count aggregate"),
     (A.Sum, "Sum", "sum aggregate"),
@@ -191,8 +212,42 @@ def check_expression(
                 err = _check_type(bound.dtype, conf)
                 if err:
                     reasons.append(err)
+                reasons.extend(_gated_cast_reasons(bound, conf))
             except (TypeError, ValueError, KeyError) as e:
                 reasons.append(str(e))
+    return reasons
+
+
+def _gated_cast_reasons(bound: E.Expression, conf: RapidsConf) -> List[str]:
+    """Conf-gated cast pairs (reference: RapidsConf.scala:487-533 — risky
+    cast kernels exist but tag the plan for fallback unless enabled)."""
+    reasons: List[str] = []
+
+    def visit(node: E.Expression):
+        if isinstance(node, E.Cast) and isinstance(
+            node.child.dtype, T.StringType
+        ):
+            to = node.to
+            if to.name in ("tinyint", "smallint", "int", "bigint") and not conf.get(
+                ENABLE_CAST_STRING_TO_INTEGER
+            ):
+                reasons.append(
+                    "casting string to integral types is disabled; set "
+                    "spark.rapids.tpu.sql.castStringToInteger.enabled=true")
+            if to.is_floating and not conf.get(ENABLE_CAST_STRING_TO_FLOAT):
+                reasons.append(
+                    "casting string to float is disabled; set "
+                    "spark.rapids.tpu.sql.castStringToFloat.enabled=true")
+            if isinstance(to, T.TimestampType) and not conf.get(
+                ENABLE_CAST_STRING_TO_TIMESTAMP
+            ):
+                reasons.append(
+                    "casting string to timestamp is disabled; set "
+                    "spark.rapids.tpu.sql.castStringToTimestamp.enabled=true")
+        for c in node.children:
+            visit(c)
+
+    visit(bound)
     return reasons
 
 

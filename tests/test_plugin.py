@@ -112,6 +112,20 @@ class TestDifferential:
 
         assert_tpu_and_cpu_equal(build, conf=FLOAT_AGG_CONF, approx_float=True)
 
+    def test_filter_string_key_aggregate_pipeline(self):
+        # regression: the sort-groupby path (string keys) mislabeled row
+        # liveness when the fused filter produced a non-prefix mask,
+        # dropping a row and emitting a phantom null-key group
+        def build(s):
+            return (
+                make_df(s, n=503, parts=2)
+                .where(E.IsNotNull(col("k")))
+                .group_by("s")
+                .agg(A.agg(A.Count(None), "n"), A.agg(A.Sum(col("a")), "sa"))
+            )
+
+        assert_tpu_and_cpu_equal(build)
+
     def test_union_limit(self):
         def build(s):
             d = make_df(s, n=50, parts=1)

@@ -1,0 +1,348 @@
+"""Differential string-expression tests: TPU lowering vs CPU interpreter.
+
+Mirrors the reference's string coverage (stringFunctions.scala via
+integration_tests string_test.py + CastOpSuite string rows), applied through
+the same two-engine diff used by test_expressions.py.
+"""
+import random
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import ColumnarBatch, schema_of
+from spark_rapids_tpu.cpu import eval_expression_rows
+from spark_rapids_tpu.expr import bind_references, col, evaluate_projection, lit
+from spark_rapids_tpu.expr import expressions as E
+from spark_rapids_tpu.expr.eval import tpu_supports
+
+from data_gen import approx_equal
+
+N = 96
+
+# alphabet keeps case-mapped chars inside the TPU's U+0250 mapped range and
+# avoids length-changing mappings (ß -> SS), the documented incompat
+_ALPHA = "abcdefgXYZ 019.,%_üÜéÉñÑÿŸ\t-"
+
+
+def gen_strings(n, rng, null_prob=0.15):
+    specials = ["", "a", "X", "NULL", "  pad  ", "aXbXc", "üñé", "x" * 40,
+                "a.b.c", "%lit%", "1", "-42", " 7 ", "3.5", "true", "no"]
+    out = []
+    for _ in range(n):
+        r = rng.random()
+        if r < null_prob:
+            out.append(None)
+        elif r < null_prob + 0.25:
+            out.append(rng.choice(specials))
+        else:
+            k = rng.randint(0, 14)
+            out.append("".join(rng.choice(_ALPHA) for _ in range(k)))
+    return out
+
+
+STR_SCHEMA = schema_of(s=T.STRING, t=T.STRING)
+
+
+def make_batch(seed, null_prob=0.15):
+    rng = random.Random(seed)
+    data = {
+        "s": gen_strings(N, rng, null_prob),
+        "t": gen_strings(N, rng, null_prob),
+    }
+    return ColumnarBatch.from_pydict(data, STR_SCHEMA), data
+
+
+def check(expr, seed=0, null_prob=0.15):
+    batch, data = make_batch(seed, null_prob)
+    bound = bind_references(expr, STR_SCHEMA)
+    [tpu_col] = evaluate_projection([bound], batch)
+    tpu_vals = tpu_col.to_pylist()
+    rows = list(zip(data["s"], data["t"]))
+    cpu_vals = eval_expression_rows(bound, rows)
+    assert len(tpu_vals) == len(cpu_vals)
+    for i, (tv, cv) in enumerate(zip(tpu_vals, cpu_vals)):
+        assert approx_equal(tv, cv), (
+            f"row {i}: tpu={tv!r} cpu={cv!r} expr={expr} inputs={rows[i]!r}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# comparisons / membership / conditionals
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("op", [
+    E.EqualTo, E.EqualNullSafe, E.LessThan, E.LessThanOrEqual,
+    E.GreaterThan, E.GreaterThanOrEqual,
+])
+def test_string_comparisons(op):
+    check(op(col("s"), col("t")), seed=101)
+    check(op(col("s"), lit("aXbXc")), seed=102)
+
+
+def test_string_in():
+    check(E.In(col("s"), ("a", "X", "üñé", "")), seed=103)
+    check(E.In(col("s"), ("a", None, "x" * 40)), seed=104)
+
+
+def test_string_conditionals():
+    p = E.GreaterThan(E.Length(col("s")), lit(3))
+    check(E.If(p, col("s"), col("t")), seed=105)
+    check(E.If(p, col("s"), lit(None)), seed=106)
+    check(E.Coalesce((col("s"), col("t"), lit("zz"))), seed=107, null_prob=0.5)
+    check(
+        E.CaseWhen(
+            ((p, col("t")), (E.EqualTo(col("s"), lit("a")), lit("ONE"))),
+            else_value=lit("other"),
+        ),
+        seed=108,
+    )
+    check(E.CaseWhen(((p, col("t")),)), seed=109)
+
+
+# ---------------------------------------------------------------------------
+# case / length / substring / concat / trim
+# ---------------------------------------------------------------------------
+def test_upper_lower_initcap():
+    check(E.Upper(col("s")), seed=110)
+    check(E.Lower(col("s")), seed=111)
+    check(E.InitCap(col("s")), seed=112)
+
+
+def test_length():
+    check(E.Length(col("s")), seed=113)
+
+
+@pytest.mark.parametrize("pos,ln", [
+    (1, 3), (2, 100), (0, 2), (-3, 2), (-100, 3), (5, -1), (3, 0),
+    (-1, 5), (2, 2**31 - 1),
+])
+def test_substring(pos, ln):
+    check(E.Substring(col("s"), lit(pos), lit(ln)), seed=hash((pos, ln)) & 0xFFF)
+
+
+def test_substring_null_args():
+    check(E.Substring(col("s"), lit(None), lit(2)), seed=114)
+
+
+def test_concat():
+    check(E.Concat((col("s"), col("t"))), seed=115)
+    check(E.Concat((col("s"), lit("-"), col("t"), lit("!"))), seed=116)
+    check(E.Concat((col("s"), lit(None))), seed=117)
+
+
+def test_trim_family():
+    check(E.StringTrim(col("s")), seed=118)
+    check(E.StringTrimLeft(col("s")), seed=119)
+    check(E.StringTrimRight(col("s")), seed=120)
+    check(E.StringTrim(col("s"), "ab "), seed=121)
+    check(E.StringTrimLeft(col("s"), "aX"), seed=122)
+    check(E.StringTrimRight(col("s"), "c."), seed=123)
+
+
+# ---------------------------------------------------------------------------
+# predicates / like / locate
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("pat", ["a", "X", "", "aX", "üñ", "  ", "x" * 40])
+def test_starts_ends_contains(pat):
+    sd = hash(pat) & 0xFFF
+    check(E.StartsWith(col("s"), lit(pat)), seed=sd)
+    check(E.EndsWith(col("s"), lit(pat)), seed=sd + 1)
+    check(E.Contains(col("s"), lit(pat)), seed=sd + 2)
+
+
+def test_predicate_null_pattern():
+    check(E.StartsWith(col("s"), lit(None)), seed=124)
+
+
+@pytest.mark.parametrize("pat", [
+    "%X%", "a%", "%c", "a%c", "a%b%c", "aXbXc", "", "%", "%%", "_", "a_",
+    "a_c", "___", "%üñ%", "100\\%", "a\\_c",
+])
+def test_like(pat):
+    check(E.Like(col("s"), lit(pat)), seed=hash(pat) & 0xFFF)
+
+
+def test_like_null_pattern():
+    check(E.Like(col("s"), lit(None)), seed=125)
+
+
+@pytest.mark.parametrize("sub,start", [
+    ("X", 1), ("a", 2), ("üñ", 1), ("", 1), ("X", 0), ("b", 3), ("x" * 40, 1),
+])
+def test_locate(sub, start):
+    check(E.StringLocate(lit(sub), col("s"), lit(start)),
+          seed=hash((sub, start)) & 0xFFF)
+
+
+def test_locate_nulls():
+    check(E.StringLocate(lit(None), col("s"), lit(1)), seed=126)
+    check(E.StringLocate(lit("a"), col("s"), lit(None)), seed=127)
+
+
+# ---------------------------------------------------------------------------
+# replace / pad / substring_index / split
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("search,repl", [
+    ("X", "-"), ("a", ""), ("aX", "=="), ("b", "bbb"), ("üñ", "u"),
+])
+def test_replace(search, repl):
+    check(E.StringReplace(col("s"), lit(search), lit(repl)),
+          seed=hash((search, repl)) & 0xFFF)
+
+
+def test_replace_empty_search_is_identity():
+    check(E.StringReplace(col("s"), lit(""), lit("zz")), seed=128)
+
+
+def test_replace_self_overlapping_falls_back():
+    ok, why = tpu_supports(
+        E.StringReplace(col("s"), lit("aa"), lit("b")), STR_SCHEMA)
+    assert not ok and "self-overlapping" in why
+
+
+@pytest.mark.parametrize("ln,pad", [
+    (7, "*"), (3, "xy"), (0, "*"), (10, ""), (6, "üñ"), (12, "ab"),
+])
+def test_pads(ln, pad):
+    sd = hash((ln, pad)) & 0xFFF
+    check(E.StringLPad(col("s"), lit(ln), lit(pad)), seed=sd)
+    check(E.StringRPad(col("s"), lit(ln), lit(pad)), seed=sd + 1)
+
+
+@pytest.mark.parametrize("count", [1, 2, 0, -1, -2])
+def test_substring_index(count):
+    check(E.SubstringIndex(col("s"), lit("."), lit(count)),
+          seed=hash(count) & 0xFFF)
+    check(E.SubstringIndex(col("s"), lit("X"), lit(count)),
+          seed=(hash(count) + 7) & 0xFFF)
+
+
+@pytest.mark.parametrize("idx", [0, 1, 2, 5])
+def test_split_part(idx):
+    check(E.StringSplitPart(col("s"), lit("X"), lit(idx)),
+          seed=hash(idx) & 0xFFF)
+    check(E.StringSplitPart(col("s"), lit("."), lit(idx)),
+          seed=(hash(idx) + 3) & 0xFFF)
+
+
+# ---------------------------------------------------------------------------
+# casts
+# ---------------------------------------------------------------------------
+def _check_cast_from_strings(values, to):
+    schema = schema_of(s=T.STRING)
+    batch = ColumnarBatch.from_pydict({"s": values}, schema)
+    bound = bind_references(E.Cast(col("s"), to), schema)
+    [r] = evaluate_projection([bound], batch)
+    cpu = eval_expression_rows(bound, [(v,) for v in values])
+    for i, (tv, cv) in enumerate(zip(r.to_pylist(), cpu)):
+        assert approx_equal(tv, cv), f"cast {values[i]!r}: tpu={tv!r} cpu={cv!r}"
+
+
+def test_cast_string_to_int():
+    vals = ["42", "-7", "+13", "  99 ", "", "abc", "3.5", "12x", None,
+            "2147483647", "2147483648", "-2147483648", "-2147483649",
+            "0", "-0", "00123", "+", "-", "128", "-129", " \t10\n"]
+    _check_cast_from_strings(vals, T.INT)
+    _check_cast_from_strings(vals, T.LONG)
+    _check_cast_from_strings(vals, T.BYTE)
+    _check_cast_from_strings(
+        ["9223372036854775807", "9223372036854775808",
+         "-9223372036854775808", "-9223372036854775809"], T.LONG)
+
+
+def test_cast_string_to_bool():
+    vals = ["true", "TRUE", "t", "y", "yes", "1", "false", "F", "n", "NO",
+            "0", " true ", "tr", "2", "", None]
+    _check_cast_from_strings(vals, T.BOOLEAN)
+
+
+def test_cast_string_to_float():
+    vals = ["1.5", "-2.25", "3", ".5", "5.", "1e3", "2.5e-2", "1E2",
+            "-0.125", " 7.5 ", "inf", "-Infinity", "NaN", "abc", "1.2.3",
+            "1e", "", None, "+4.5", "1e+2"]
+    _check_cast_from_strings(vals, T.DOUBLE)
+    _check_cast_from_strings(vals, T.FLOAT)
+
+
+def test_cast_int_to_string():
+    schema = schema_of(a=T.LONG, b=T.INT, c=T.BYTE)
+    vals = {
+        "a": [0, 1, -1, 2**63 - 1, -(2**63), 42, None, 1000000],
+        "b": [0, -2147483648, 2147483647, 7, None, -99, 10, 100],
+        "c": [0, -128, 127, None, 5, -5, 99, -100],
+    }
+    batch = ColumnarBatch.from_pydict(vals, schema)
+    for name in ("a", "b", "c"):
+        bound = bind_references(E.Cast(col(name), T.STRING), schema)
+        [r] = evaluate_projection([bound], batch)
+        expect = [None if v is None else str(v) for v in vals[name]]
+        assert r.to_pylist() == expect
+
+
+def test_cast_bool_to_string():
+    schema = schema_of(p=T.BOOLEAN)
+    batch = ColumnarBatch.from_pydict({"p": [True, False, None]}, schema)
+    bound = bind_references(E.Cast(col("p"), T.STRING), schema)
+    [r] = evaluate_projection([bound], batch)
+    assert r.to_pylist() == ["true", "false", None]
+
+
+def test_cast_gates_in_planner():
+    """String->numeric casts are conf-gated off by default, like the
+    reference (RapidsConf.scala:487-533)."""
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.plugin.overrides import check_expression
+
+    schema = schema_of(s=T.STRING)
+    conf = RapidsConf({})
+    r = check_expression(E.Cast(col("s"), T.INT), schema, conf)
+    assert r and "castStringToInteger" in r[0]
+    r = check_expression(E.Cast(col("s"), T.DOUBLE), schema, conf)
+    assert r and "castStringToFloat" in r[0]
+    on = RapidsConf({
+        "spark.rapids.tpu.sql.castStringToInteger.enabled": True})
+    assert check_expression(E.Cast(col("s"), T.INT), schema, on) == []
+    # always-on direction
+    assert check_expression(
+        E.Cast(E.Length(col("s")), T.STRING), schema, conf) == []
+
+
+def test_cast_string_long_digit_runs():
+    """Leading zeros don't count toward the 19-digit bound; >17-digit
+    mantissas keep their magnitude."""
+    _check_cast_from_strings(
+        ["00000000000000000000123", "0000000000000000000000"], T.INT)
+    _check_cast_from_strings(
+        ["12345678901234567890123", "0.000000000000000000005",
+         "00000000000000000001.5"], T.DOUBLE)
+
+
+def test_trim_empty_trimstr_is_noop():
+    check(E.StringTrim(col("s"), ""), seed=129)
+
+
+def test_java_float_repr():
+    """CPU fallback float->string matches Java Double/Float.toString."""
+    from spark_rapids_tpu.cpu.interpreter import _java_double_str
+
+    assert _java_double_str(12345678.9, False) == "1.23456789E7"
+    assert _java_double_str(1.23456789e-4, False) == "1.23456789E-4"
+    assert _java_double_str(5.0, False) == "5.0"
+    assert _java_double_str(-0.0, False) == "-0.0"
+    assert _java_double_str(1e7, False) == "1.0E7"
+    assert _java_double_str(0.001, False) == "0.001"
+    assert _java_double_str(float("inf"), False) == "Infinity"
+    import struct
+
+    f11 = struct.unpack("f", struct.pack("f", 1.1))[0]
+    assert _java_double_str(f11, True) == "1.1"
+
+
+def test_fused_string_pipeline():
+    """Strings fuse with arithmetic in one projection (the TPU-first win)."""
+    e = E.If(
+        E.And(E.StartsWith(col("s"), lit("a")),
+              E.GreaterThan(E.Length(col("t")), lit(2))),
+        E.Upper(E.Concat((col("s"), lit("-"), col("t")))),
+        E.StringRPad(E.StringTrim(col("s")), lit(8), lit(".")),
+    )
+    check(e, seed=200)
