@@ -37,6 +37,7 @@ def _wrap_int(v: int, name: str) -> int:
 
 
 _JAVA_WS = "\t\n\x0b\x0c\r "
+_EPOCH_ORD = 719163  # datetime.date(1970, 1, 1).toordinal()
 
 
 def _cast_from_string(s: str, to: T.DataType) -> Any:
@@ -69,6 +70,40 @@ def _cast_from_string(s: str, to: T.DataType) -> Any:
         else:
             return None
         return _f32(v) if isinstance(to, T.FloatType) else v
+    if isinstance(to, T.DateType):
+        m = re.fullmatch(r"(\d{4})(?:-(\d{1,2})(?:-(\d{1,2}))?)?", t)
+        if not m:
+            return None
+        import datetime as _dt
+
+        try:
+            d = _dt.date(int(m.group(1)), int(m.group(2) or 1),
+                         int(m.group(3) or 1))
+        except ValueError:
+            return None
+        return d.toordinal() - _EPOCH_ORD
+    if isinstance(to, T.TimestampType):
+        m = re.fullmatch(
+            r"(\d{4})(?:-(\d{1,2})(?:-(\d{1,2})"
+            r"(?:[ tT](\d{1,2}):(\d{1,2}):(\d{1,2})(?:\.(\d{1,6}))?)?)?)?", t)
+        if not m:
+            return None
+        import datetime as _dt
+
+        try:
+            d = _dt.date(int(m.group(1)), int(m.group(2) or 1),
+                         int(m.group(3) or 1))
+        except ValueError:
+            return None
+        days = d.toordinal() - _EPOCH_ORD
+        h = int(m.group(4) or 0)
+        mi = int(m.group(5) or 0)
+        s = int(m.group(6) or 0)
+        if h > 23 or mi > 59 or s > 59:
+            return None
+        frac = (m.group(7) or "").ljust(6, "0")
+        return (days * 86400 + h * 3600 + mi * 60 + s) * 1_000_000 + int(
+            frac or 0)
     raise NotImplementedError(f"cpu cast string -> {to}")
 
 
@@ -101,6 +136,20 @@ def _java_double_str(v: float, single: bool) -> str:
 def _cast_to_string(v: Any, frm: T.DataType) -> str:
     if isinstance(frm, T.BooleanType):
         return "true" if v else "false"
+    if isinstance(frm, T.DateType):
+        import datetime as _dt
+
+        d = _dt.date.fromordinal(_EPOCH_ORD + v)
+        return f"{d.year:04d}-{d.month:02d}-{d.day:02d}"
+    if isinstance(frm, T.TimestampType):
+        import datetime as _dt
+
+        ts = _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=v)
+        base = (f"{ts.year:04d}-{ts.month:02d}-{ts.day:02d} "
+                f"{ts.hour:02d}:{ts.minute:02d}:{ts.second:02d}")
+        if ts.microsecond:
+            return base + f".{ts.microsecond:06d}".rstrip("0")
+        return base
     if frm.name in _INT_RANGES:
         return str(v)
     if frm.is_floating:
@@ -117,6 +166,29 @@ def _java_cast(v: Any, frm: T.DataType, to: T.DataType) -> Any:
         return _cast_from_string(v, to)
     if isinstance(to, T.StringType):
         return _cast_to_string(v, frm)
+    if isinstance(frm, T.DateType) and isinstance(to, T.TimestampType):
+        return v * 86_400_000_000
+    if isinstance(frm, T.TimestampType) and isinstance(to, T.DateType):
+        return v // 86_400_000_000
+    if isinstance(frm, T.TimestampType):
+        if isinstance(to, T.BooleanType):
+            return v != 0  # micros != 0 (Spark timestampToBoolean)
+        if to.is_floating:
+            f = v / 1e6
+            return _f32(f) if isinstance(to, T.FloatType) else f
+        return _wrap_int(v // 1_000_000, to.name)
+    if isinstance(to, T.TimestampType):
+        if frm.is_floating:
+            if math.isnan(v) or math.isinf(v):
+                return None  # Spark doubleToTimestamp nulls non-finite
+            x = v * 1e6
+            # Scala Double.toLong saturates
+            if x >= 2**63 - 1:
+                return 2**63 - 1
+            if x <= -(2**63):
+                return -(2**63)
+            return int(x)
+        return v * 1_000_000
     if isinstance(to, T.BooleanType):
         return v != 0
     if isinstance(frm, T.BooleanType):
@@ -673,6 +745,112 @@ def eval_row(expr: E.Expression, row: Sequence[Any]) -> Any:
             return None
         parts = v.split(d)
         return parts[i] if 0 <= i < len(parts) else None
+
+    # ----- date/time (python datetime as the independent oracle; TPU side
+    # uses civil-calendar integer math) ------------------------------------
+    if isinstance(expr, E._DateUnary):
+        v = ev(expr.child)
+        if v is None:
+            return None
+        import datetime as _dt
+
+        if isinstance(expr, (E.Hour, E.Minute, E.Second)):
+            ts = _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=v)
+            return {E.Hour: ts.hour, E.Minute: ts.minute,
+                    E.Second: ts.second}[type(expr)]
+        days = v if isinstance(expr.child.dtype, T.DateType) else (
+            v // 86_400_000_000)
+        d = _dt.date.fromordinal(_EPOCH_ORD + days)
+        if isinstance(expr, E.Year):
+            return d.year
+        if isinstance(expr, E.Quarter):
+            return (d.month - 1) // 3 + 1
+        if isinstance(expr, E.Month):
+            return d.month
+        if isinstance(expr, E.DayOfMonth):
+            return d.day
+        if isinstance(expr, E.DayOfYear):
+            return d.timetuple().tm_yday
+        if isinstance(expr, E.DayOfWeek):
+            return d.isoweekday() % 7 + 1  # 1 = Sunday
+        if isinstance(expr, E.WeekDay):
+            return d.weekday()  # 0 = Monday
+
+    if isinstance(expr, (E.DateAdd, E.DateSub)):
+        s, n = ev(expr.start_date), ev(expr.days)
+        if s is None or n is None:
+            return None
+        return _wrap_int(s + (n if isinstance(expr, E.DateAdd) else -n), "int")
+
+    if isinstance(expr, E.DateDiff):
+        e_, s_ = ev(expr.end_date), ev(expr.start_date)
+        if e_ is None or s_ is None:
+            return None
+
+        def _days(v, dt):
+            return v // 86_400_000_000 if isinstance(dt, T.TimestampType) else v
+
+        return _days(e_, expr.end_date.dtype) - _days(s_, expr.start_date.dtype)
+
+    if isinstance(expr, E.LastDay):
+        v = ev(expr.start_date)
+        if v is None:
+            return None
+        import calendar
+        import datetime as _dt
+
+        d = _dt.date.fromordinal(_EPOCH_ORD + v)
+        last = calendar.monthrange(d.year, d.month)[1]
+        return d.replace(day=last).toordinal() - _EPOCH_ORD
+
+    if isinstance(expr, E.UnixTimestamp):
+        v = ev(expr.child)
+        if v is None:
+            return None
+        if isinstance(expr.child.dtype, T.TimestampType):
+            return v // 1_000_000
+        if isinstance(expr.child.dtype, T.DateType):
+            return v * 86400
+        raise NotImplementedError(
+            "unix_timestamp over non-date/timestamp inputs")
+
+    if isinstance(expr, E.FromUnixTime):
+        v, fmt = ev(expr.sec), ev(expr.format)
+        if v is None or fmt is None:
+            return None
+        if fmt != "yyyy-MM-dd HH:mm:ss":
+            raise NotImplementedError(f"from_unixtime format {fmt!r}")
+        import datetime as _dt
+
+        ts = _dt.datetime(1970, 1, 1) + _dt.timedelta(seconds=v)
+        return (f"{ts.year:04d}-{ts.month:02d}-{ts.day:02d} "
+                f"{ts.hour:02d}:{ts.minute:02d}:{ts.second:02d}")
+
+    if isinstance(expr, E.TimeAdd):
+        v = ev(expr.start)
+        if v is None:
+            return None
+        return v + expr.days * 86_400_000_000 + expr.microseconds
+
+    if isinstance(expr, E.TruncDate):
+        v, fmt = ev(expr.date), ev(expr.fmt)
+        if v is None or fmt is None:
+            return None
+        import datetime as _dt
+
+        f = fmt.lower()
+        d = _dt.date.fromordinal(_EPOCH_ORD + v)
+        if f in ("year", "yyyy", "yy"):
+            d = d.replace(month=1, day=1)
+        elif f == "quarter":
+            d = d.replace(month=((d.month - 1) // 3) * 3 + 1, day=1)
+        elif f in ("month", "mon", "mm"):
+            d = d.replace(day=1)
+        elif f == "week":
+            d = d - _dt.timedelta(days=d.weekday())
+        else:
+            return None
+        return d.toordinal() - _EPOCH_ORD
 
     raise NotImplementedError(f"cpu interpreter: {type(expr).__name__}")
 

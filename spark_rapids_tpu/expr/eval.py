@@ -48,6 +48,33 @@ def _cast_data(data: jax.Array, frm: DataType, to: DataType) -> jax.Array:
     """Value cast with Java/Spark numeric semantics (reference: GpuCast.scala)."""
     if frm == to:
         return data
+    # date/timestamp pairs (GpuCast.scala datetime rows): DATE = days int32,
+    # TIMESTAMP = micros int64, UTC
+    if isinstance(frm, T.DateType) and isinstance(to, T.TimestampType):
+        return data.astype(jnp.int64) * 86_400_000_000
+    if isinstance(frm, T.TimestampType) and isinstance(to, T.DateType):
+        return jnp.floor_divide(data, 86_400_000_000).astype(jnp.int32)
+    if isinstance(frm, T.TimestampType):
+        if isinstance(to, T.BooleanType):
+            return data != 0  # micros != 0 (Spark timestampToBoolean)
+        if to.is_floating:
+            return (data.astype(jnp.float64) / 1e6).astype(to.to_numpy())
+        return jnp.floor_divide(data, 1_000_000).astype(to.to_numpy())
+    if isinstance(to, T.TimestampType):
+        if frm.is_floating:
+            # Scala (d * 1e6).toLong saturates; non-finite handled (nulled)
+            # by the Cast lowering itself
+            x = data.astype(jnp.float64) * 1e6
+            in_range = jnp.isfinite(x) & (jnp.abs(x) < float(2**63))
+            i = jnp.where(in_range, x, 0.0).astype(jnp.int64)
+            i = jnp.where(x >= float(2**63), jnp.int64(2**63 - 1), i)
+            return jnp.where(
+                jnp.isfinite(x) & (x <= float(-(2**63))),
+                jnp.int64(-(2**63)), i)
+        return data.astype(jnp.int64) * 1_000_000  # integral seconds
+    if isinstance(frm, T.DateType) or isinstance(to, T.DateType):
+        raise UnsupportedExpressionError(
+            f"cast {frm.simpleString} -> {to.simpleString} is not supported")
     if isinstance(to, T.BooleanType):
         return data != 0
     if isinstance(frm, T.BooleanType):
@@ -395,7 +422,10 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
             from .eval_strings import lower_cast_to_string
 
             return lower_cast_to_string(c, frm, cap)
-        return ColV(_cast_data(c.data, frm, to), c.validity)
+        valid = c.validity
+        if frm.is_floating and isinstance(to, T.TimestampType):
+            valid = valid & jnp.isfinite(c.data)  # Spark: NaN/inf -> null
+        return ColV(_cast_data(c.data, frm, to), valid)
 
     # ----- math -----------------------------------------------------------
     if isinstance(expr, E._UnaryMathDouble):

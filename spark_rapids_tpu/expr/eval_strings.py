@@ -497,6 +497,12 @@ def _substring_index(expr: E.SubstringIndex, c: StrV, cap: int) -> StrV:
     if count == 0:
         off, chars = S.take_slices(c, c.offsets[:-1], jnp.zeros(cap, jnp.int32), n)
         return StrV(off, chars, c.validity)
+    if abs(count) > n:
+        # more delimiters requested than the buffer can hold: the result is
+        # always the whole string (also caps the (cap, K) occurrence matrix)
+        noff, chars = S.take_slices(
+            c, c.offsets[:-1], jnp.where(c.validity, lens, 0), n)
+        return StrV(noff, chars, c.validity)
     m = S.find_matches(c.chars, db)
     m = m & (jnp.arange(n, dtype=jnp.int32) < c.offsets[-1])
     rid = S.row_ids(c.offsets, n)
@@ -537,6 +543,11 @@ def _split_part(expr: E.StringSplitPart, c: StrV, cap: int) -> StrV:
             "split with a self-overlapping delimiter is not supported on TPU")
     md = len(db)
     n = _char_cap(c)
+    if idx > n // md:
+        # index beyond any possible part count -> all null (also caps the
+        # (cap, K) occurrence matrix allocation)
+        return StrV(jnp.zeros(cap + 1, jnp.int32), jnp.zeros(1, jnp.uint8),
+                    jnp.zeros(cap, jnp.bool_))
     pos = jnp.arange(n, dtype=jnp.int32)
     rid = S.row_ids(c.offsets, n)
     lens = S.byte_lens(c.offsets)
@@ -692,15 +703,10 @@ def cast_string_to_float(c: StrV, cap: int, to: T.DataType) -> ColV:
     mant_end = jnp.where(epos == _BIG, lens, epos)
     # mantissa digit places: digits before mant_end, skipping the dot
     in_mant = in_data & (within < mant_end[rid]) & is_digit
-    # digit index among mantissa digits (prefix count of mantissa digits)
-    mant_mark = in_mant.astype(jnp.int32)
-    Pm = S.prefix_counts(mant_mark)
-    mdig_total = Pm[t.offsets[1:]] - Pm[t.offsets[:-1]]  # approx: all digits
-    # count only digits before mant_end per row
+    Pm = S.prefix_counts(in_mant)
     md_before = jax.ops.segment_sum(
         jnp.where(in_mant, 1, 0), rid, num_segments=cap,
         indices_are_sorted=True)
-    del mdig_total
     midx = Pm[pos] - Pm[t.offsets[:-1]][rid]  # ordinal of this mantissa digit
     place = md_before[rid] - 1 - midx
     # keep the 17 MOST SIGNIFICANT digits (ordinal counted from the first
@@ -853,6 +859,14 @@ def lower_string_cast(c: StrV, to: T.DataType, cap: int):
         return cast_string_to_int(c, cap, to)
     if to.is_floating:
         return cast_string_to_float(c, cap, to)
+    if isinstance(to, T.DateType):
+        from .eval_datetime import parse_date
+
+        return parse_date(c, cap)
+    if isinstance(to, T.TimestampType):
+        from .eval_datetime import parse_timestamp
+
+        return parse_timestamp(c, cap)
     raise UnsupportedExpressionError(
         f"cast string -> {to.simpleString} is not supported on TPU")
 
@@ -863,6 +877,14 @@ def lower_cast_to_string(c: ColV, frm: T.DataType, cap: int):
         return cast_bool_to_string(c, cap)
     if frm.name in ("tinyint", "smallint", "int", "bigint"):
         return cast_int_to_string(c, cap, frm)
+    if isinstance(frm, T.DateType):
+        from .eval_datetime import format_date
+
+        return format_date(c, cap)
+    if isinstance(frm, T.TimestampType):
+        from .eval_datetime import format_timestamp
+
+        return format_timestamp(c, cap)
     if frm.is_floating:
         raise UnsupportedExpressionError(
             "cast float -> string is not supported on TPU (would require "

@@ -876,6 +876,169 @@ class StringSplitPart(Expression):
 
 
 # ---------------------------------------------------------------------------
+# Date/time (reference: sql/rapids/datetimeExpressions.scala, 723 LoC;
+# UTC-only like the reference — GpuOverrides.scala:562-564 rejects non-UTC
+# sessions). DATE = int32 days since epoch, TIMESTAMP = int64 microseconds.
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class _DateUnary(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return T.INT
+
+
+class Year(_DateUnary):
+    """reference: GpuYear (datetimeExpressions.scala:112)"""
+
+
+class Quarter(_DateUnary):
+    """reference: GpuQuarter (datetimeExpressions.scala:254)"""
+
+
+class Month(_DateUnary):
+    """reference: GpuMonth (datetimeExpressions.scala:269)"""
+
+
+class DayOfMonth(_DateUnary):
+    """reference: GpuDayOfMonth (datetimeExpressions.scala:274)"""
+
+
+class DayOfYear(_DateUnary):
+    """reference: GpuDayOfYear (datetimeExpressions.scala:279)"""
+
+
+class DayOfWeek(_DateUnary):
+    """1 = Sunday .. 7 = Saturday (reference: GpuDayOfWeek
+    datetimeExpressions.scala:63)."""
+
+
+class WeekDay(_DateUnary):
+    """0 = Monday .. 6 = Sunday (reference: GpuWeekDay
+    datetimeExpressions.scala:51)."""
+
+
+class Hour(_DateUnary):
+    """reference: GpuHour (datetimeExpressions.scala:102), UTC only"""
+
+
+class Minute(_DateUnary):
+    """reference: GpuMinute (datetimeExpressions.scala:82), UTC only"""
+
+
+class Second(_DateUnary):
+    """reference: GpuSecond (datetimeExpressions.scala:92), UTC only"""
+
+
+@dataclasses.dataclass(frozen=True)
+class DateAdd(Expression):
+    """reference: GpuDateAdd (datetimeExpressions.scala:701)"""
+
+    start_date: Expression
+    days: Expression
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+
+@dataclasses.dataclass(frozen=True)
+class DateSub(Expression):
+    """reference: GpuDateSub (datetimeExpressions.scala:690)"""
+
+    start_date: Expression
+    days: Expression
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+
+@dataclasses.dataclass(frozen=True)
+class DateDiff(Expression):
+    """end - start in days (reference: GpuDateDiff
+    datetimeExpressions.scala:206)."""
+
+    end_date: Expression
+    start_date: Expression
+
+    @property
+    def dtype(self):
+        return T.INT
+
+
+@dataclasses.dataclass(frozen=True)
+class LastDay(Expression):
+    """Last day of the month (reference: GpuLastDay
+    datetimeExpressions.scala:711)."""
+
+    start_date: Expression
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+
+@dataclasses.dataclass(frozen=True)
+class UnixTimestamp(Expression):
+    """Seconds since epoch of a DATE/TIMESTAMP column (reference:
+    GpuUnixTimestamp datetimeExpressions.scala:543; string parsing is the
+    gated GpuToTimestamp path, not supported here)."""
+
+    child: Expression
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+
+class ToUnixTimestamp(UnixTimestamp):
+    """reference: GpuToUnixTimestamp (datetimeExpressions.scala:558)"""
+
+
+@dataclasses.dataclass(frozen=True)
+class FromUnixTime(Expression):
+    """Format seconds-since-epoch as a string; only the default
+    'yyyy-MM-dd HH:mm:ss' format (reference: GpuFromUnixTime
+    datetimeExpressions.scala:603 has the same literal-format restriction)."""
+
+    sec: Expression
+    format: Expression
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeAdd(Expression):
+    """timestamp + literal interval with zero months (reference: GpuTimeAdd
+    datetimeExpressions.scala:178 — same months==0 restriction).
+    ``days``/``microseconds`` are the interval payload."""
+
+    start: Expression
+    days: int
+    microseconds: int
+
+    @property
+    def dtype(self):
+        return T.TIMESTAMP
+
+
+@dataclasses.dataclass(frozen=True)
+class TruncDate(Expression):
+    """trunc(date, fmt) for fmt in year/yyyy/yy/quarter/month/mon/mm/week."""
+
+    date: Expression
+    fmt: Expression
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+
+# ---------------------------------------------------------------------------
 # Binding / resolution
 # ---------------------------------------------------------------------------
 def bind_references(expr: Expression, schema: T.StructType) -> Expression:

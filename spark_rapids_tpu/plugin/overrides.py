@@ -148,6 +148,25 @@ for _cls, _name, _desc in [
     (E.StringRPad, "StringRPad", "right-pad to length"),
     (E.SubstringIndex, "SubstringIndex", "substring before/after delimiter"),
     (E.StringSplitPart, "StringSplit", "split on delimiter + index"),
+    (E.Year, "Year", "year of date/timestamp"),
+    (E.Quarter, "Quarter", "quarter of year"),
+    (E.Month, "Month", "month of date/timestamp"),
+    (E.DayOfMonth, "DayOfMonth", "day of month"),
+    (E.DayOfYear, "DayOfYear", "day of year"),
+    (E.DayOfWeek, "DayOfWeek", "day of week (1=Sunday)"),
+    (E.WeekDay, "WeekDay", "day of week (0=Monday)"),
+    (E.Hour, "Hour", "hour of timestamp (UTC)"),
+    (E.Minute, "Minute", "minute of timestamp (UTC)"),
+    (E.Second, "Second", "second of timestamp (UTC)"),
+    (E.DateAdd, "DateAdd", "add days to date"),
+    (E.DateSub, "DateSub", "subtract days from date"),
+    (E.DateDiff, "DateDiff", "days between dates"),
+    (E.LastDay, "LastDay", "last day of month"),
+    (E.UnixTimestamp, "UnixTimestamp", "seconds since epoch"),
+    (E.ToUnixTimestamp, "ToUnixTimestamp", "seconds since epoch"),
+    (E.FromUnixTime, "FromUnixTime", "format seconds since epoch"),
+    (E.TimeAdd, "TimeAdd", "timestamp + interval"),
+    (E.TruncDate, "TruncDate", "truncate date to unit"),
     (A.AggregateExpression, "AggregateExpression", "aggregate holder"),
     (A.Count, "Count", "count aggregate"),
     (A.Sum, "Sum", "sum aggregate"),

@@ -1,0 +1,260 @@
+"""Differential date/time expression tests: TPU civil-calendar math vs the
+python-datetime CPU oracle.
+
+Mirrors the reference's date_time_test.py coverage (datetimeExpressions.scala)
+including leap years, epoch boundaries, and pre-epoch floor semantics.
+"""
+import datetime
+import random
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import ColumnarBatch, schema_of
+from spark_rapids_tpu.cpu import eval_expression_rows
+from spark_rapids_tpu.expr import bind_references, col, evaluate_projection, lit
+from spark_rapids_tpu.expr import expressions as E
+
+from data_gen import approx_equal
+
+N = 96
+_EPOCH = datetime.date(1970, 1, 1).toordinal()
+
+# oracle uses python datetime: years 1..9999 -> days in [-719162, 2932896]
+_DAY_LO, _DAY_HI = -719162, 2932896
+_US_LO = _DAY_LO * 86_400_000_000
+_US_HI = (_DAY_HI + 1) * 86_400_000_000 - 1
+
+_EDGE_DAYS = [0, -1, 1, -719162, 2932896,
+              datetime.date(2000, 2, 29).toordinal() - _EPOCH,
+              datetime.date(1900, 2, 28).toordinal() - _EPOCH,
+              datetime.date(2100, 3, 1).toordinal() - _EPOCH,
+              datetime.date(1969, 12, 31).toordinal() - _EPOCH]
+_EDGE_US = [0, -1, 1, 86_400_000_000, -86_400_000_001, 1_000_000,
+            -999_999, 946684800123456, -12345678901234]
+
+
+def gen_dates(n, rng, null_prob=0.15):
+    out = []
+    for _ in range(n):
+        r = rng.random()
+        if r < null_prob:
+            out.append(None)
+        elif r < null_prob + 0.25:
+            out.append(rng.choice(_EDGE_DAYS))
+        else:
+            out.append(rng.randint(-100_000, 100_000))
+    return out
+
+
+def gen_ts(n, rng, null_prob=0.15):
+    out = []
+    for _ in range(n):
+        r = rng.random()
+        if r < null_prob:
+            out.append(None)
+        elif r < null_prob + 0.25:
+            out.append(rng.choice(_EDGE_US))
+        else:
+            out.append(rng.randint(-5_000_000_000_000_000, 5_000_000_000_000_000))
+    return out
+
+
+SCHEMA = schema_of(dt=T.DATE, ts=T.TIMESTAMP, n=T.INT)
+
+
+def make_batch(seed, null_prob=0.15):
+    rng = random.Random(seed)
+    data = {
+        "dt": gen_dates(N, rng, null_prob),
+        "ts": gen_ts(N, rng, null_prob),
+        "n": [None if rng.random() < 0.1 else rng.randint(-1000, 1000)
+              for _ in range(N)],
+    }
+    return ColumnarBatch.from_pydict(data, SCHEMA), data
+
+
+def check(expr, seed=0):
+    batch, data = make_batch(seed)
+    bound = bind_references(expr, SCHEMA)
+    [tpu_col] = evaluate_projection([bound], batch)
+    tpu_vals = tpu_col.to_pylist()
+    rows = list(zip(data["dt"], data["ts"], data["n"]))
+    cpu_vals = eval_expression_rows(bound, rows)
+    for i, (tv, cv) in enumerate(zip(tpu_vals, cpu_vals)):
+        assert approx_equal(tv, cv), (
+            f"row {i}: tpu={tv!r} cpu={cv!r} expr={expr} inputs={rows[i]!r}")
+
+
+@pytest.mark.parametrize("op", [
+    E.Year, E.Quarter, E.Month, E.DayOfMonth, E.DayOfYear, E.DayOfWeek,
+    E.WeekDay,
+])
+def test_date_fields(op):
+    check(op(col("dt")), seed=hash(op.__name__) & 0xFFF)
+    check(op(col("ts")), seed=(hash(op.__name__) + 1) & 0xFFF)
+
+
+@pytest.mark.parametrize("op", [E.Hour, E.Minute, E.Second])
+def test_time_fields(op):
+    check(op(col("ts")), seed=hash(op.__name__) & 0xFFF)
+
+
+def test_date_arith():
+    check(E.DateAdd(col("dt"), col("n")), seed=301)
+    check(E.DateSub(col("dt"), col("n")), seed=302)
+    check(E.DateAdd(col("dt"), lit(365)), seed=303)
+    check(E.DateDiff(col("dt"), lit(0)), seed=304)
+    check(E.DateDiff(E.Literal(18321, T.DATE), col("dt")), seed=305)
+    check(E.LastDay(col("dt")), seed=306)
+
+
+def test_unix_roundtrip():
+    check(E.UnixTimestamp(col("ts")), seed=310)
+    check(E.UnixTimestamp(col("dt")), seed=311)
+    check(E.ToUnixTimestamp(col("ts")), seed=312)
+    check(E.FromUnixTime(E.UnixTimestamp(col("ts")),
+                         lit("yyyy-MM-dd HH:mm:ss")), seed=313)
+
+
+def test_time_add():
+    check(E.TimeAdd(col("ts"), 3, 5_500_000), seed=320)
+    check(E.TimeAdd(col("ts"), -1, -1), seed=321)
+
+
+@pytest.mark.parametrize("fmt", ["year", "YY", "month", "MON", "quarter",
+                                 "week", "bogus"])
+def test_trunc(fmt):
+    check(E.TruncDate(col("dt"), lit(fmt)), seed=hash(fmt) & 0xFFF)
+
+
+# ---------------------------------------------------------------------------
+# casts
+# ---------------------------------------------------------------------------
+def test_cast_date_timestamp():
+    check(E.Cast(col("dt"), T.TIMESTAMP), seed=330)
+    check(E.Cast(col("ts"), T.DATE), seed=331)
+    check(E.Cast(col("ts"), T.LONG), seed=332)
+    check(E.Cast(col("ts"), T.DOUBLE), seed=333)
+    check(E.Cast(col("n"), T.TIMESTAMP), seed=334)
+
+
+def test_cast_datetime_to_string():
+    check(E.Cast(col("dt"), T.STRING), seed=340)
+    check(E.Cast(col("ts"), T.STRING), seed=341)
+
+
+def _check_cast_strings(values, to):
+    schema = schema_of(s=T.STRING)
+    batch = ColumnarBatch.from_pydict({"s": values}, schema)
+    bound = bind_references(E.Cast(col("s"), to), schema)
+    [r] = evaluate_projection([bound], batch)
+    cpu = eval_expression_rows(bound, [(v,) for v in values])
+    for i, (tv, cv) in enumerate(zip(r.to_pylist(), cpu)):
+        assert approx_equal(tv, cv), (
+            f"cast {values[i]!r}: tpu={tv!r} cpu={cv!r}")
+
+
+def test_cast_string_to_date():
+    _check_cast_strings(
+        ["2020-02-29", "2019-02-29", "2020-1-5", "2020-13-01", "2020-00-10",
+         "1999-12-31", "2020", "2020-06", " 2020-06-15 ", "garbage",
+         "20-01-01", "2020-01-00", "2020-01-32", "0001-01-01", "9999-12-31",
+         "", None, "2020-01-01-05", "2020--01"], T.DATE)
+
+
+def test_cast_string_to_timestamp():
+    _check_cast_strings(
+        ["2020-02-29 13:14:15", "2020-02-29T13:14:15", "2020-02-29",
+         "2020-02-29 13:14:15.5", "2020-02-29 13:14:15.123456",
+         "2020-02-29 25:00:00", "2020-02-29 13:60:00", "1969-12-31 23:59:59",
+         "2020", "2020-06", "bad", "", None, "2020-02-29 1:2:3",
+         "2020-01 10:20:30", "2020 1:2:3"],  # time needs a FULL date
+        T.TIMESTAMP)
+
+
+def test_cast_edge_pairs():
+    """Review regressions: ts->bool uses micros, float->ts nulls
+    non-finite and saturates."""
+    schema = schema_of(ts=T.TIMESTAMP, d=T.DOUBLE)
+    vals = {"ts": [500_000, 0, -1, None],
+            "d": [float("nan"), float("inf"), 1.5, -2.5e200]}
+    batch = ColumnarBatch.from_pydict(vals, schema)
+    rows = list(zip(vals["ts"], vals["d"]))
+    for e in (E.Cast(col("ts"), T.BOOLEAN), E.Cast(col("d"), T.TIMESTAMP)):
+        bound = bind_references(e, schema)
+        [r] = evaluate_projection([bound], batch)
+        cpu = eval_expression_rows(bound, rows)
+        assert r.to_pylist() == cpu, (e, r.to_pylist(), cpu)
+
+
+def test_cast_string_date_round_trip():
+    batch, data = make_batch(350)
+    e = E.Cast(E.Cast(col("dt"), T.STRING), T.DATE)
+    bound = bind_references(e, SCHEMA)
+    [r] = evaluate_projection([bound], batch)
+    for got, want in zip(r.to_pylist(), data["dt"]):
+        if want is not None and -719162 <= want <= 2932896:
+            assert got == want
+
+
+def test_datetime_in_predicates():
+    """Date expressions fuse with comparisons/filters (q5-style predicate)."""
+    check(E.And(E.GreaterThanOrEqual(E.Year(col("dt")), lit(2000)),
+                E.LessThan(E.Month(col("dt")), lit(7))), seed=360)
+    check(E.If(E.EqualTo(E.Quarter(col("dt")), lit(1)),
+               E.DateAdd(col("dt"), lit(90)), col("dt")), seed=361)
+
+
+def test_q5_like_date_query_from_parquet(tmp_path):
+    """TPC-DS q5-style: parquet scan -> date-range filter -> aggregate, the
+    end-to-end shape from SURVEY.md §7 step 4, now with real date
+    predicates."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from harness import assert_tpu_and_cpu_equal
+    from spark_rapids_tpu.expr import aggregates as A
+
+    rng = random.Random(7)
+    n = 3000
+    base = datetime.date(1998, 1, 1).toordinal() - _EPOCH
+    t = pa.table({
+        "sold_date": pa.array(
+            [base + rng.randint(0, 1500) if rng.random() > 0.03 else None
+             for _ in range(n)], pa.date32()),
+        "store": pa.array([rng.randint(1, 12) for _ in range(n)], pa.int32()),
+        "profit": pa.array([rng.randint(-500, 2000) for _ in range(n)],
+                           pa.int64()),
+    })
+    pq.write_table(t, str(tmp_path / "sales.parquet"), row_group_size=512)
+
+    lo = E.Literal(base + 200, T.DATE)
+
+    def build(s):
+        df = s.read.parquet(str(tmp_path))
+        return (
+            df.where(E.And(
+                E.GreaterThanOrEqual(col("sold_date"), lo),
+                E.LessThanOrEqual(
+                    col("sold_date"), E.DateAdd(lo, lit(30)))))
+            .with_column("yr", E.Year(col("sold_date")))
+            .group_by("store")
+            .agg(A.agg(A.Count(None), "cnt"),
+                 A.agg(A.Sum(col("profit")), "total"))
+        )
+
+    assert_tpu_and_cpu_equal(build)
+
+
+def test_planner_gates_string_to_timestamp():
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.plugin.overrides import check_expression
+
+    schema = schema_of(s=T.STRING)
+    conf = RapidsConf({})
+    r = check_expression(E.Cast(col("s"), T.TIMESTAMP), schema, conf)
+    assert r and "castStringToTimestamp" in r[0]
+    on = RapidsConf({"spark.rapids.tpu.sql.castStringToTimestamp.enabled": True})
+    assert check_expression(E.Cast(col("s"), T.TIMESTAMP), schema, on) == []
+    # string->date is NOT gated (always-on in the reference)
+    assert check_expression(E.Cast(col("s"), T.DATE), schema, conf) == []

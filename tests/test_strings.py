@@ -208,6 +208,14 @@ def test_pads(ln, pad):
     check(E.StringRPad(col("s"), lit(ln), lit(pad)), seed=sd + 1)
 
 
+def test_huge_count_literals_stay_bounded():
+    """Review regression: count/idx far beyond any possible occurrence
+    count must not size the occurrence matrix (4TB allocation)."""
+    check(E.SubstringIndex(col("s"), lit("."), lit(10**6)), seed=130)
+    check(E.SubstringIndex(col("s"), lit("."), lit(-(10**6))), seed=131)
+    check(E.StringSplitPart(col("s"), lit("."), lit(10**6)), seed=132)
+
+
 @pytest.mark.parametrize("count", [1, 2, 0, -1, -2])
 def test_substring_index(count):
     check(E.SubstringIndex(col("s"), lit("."), lit(count)),
