@@ -172,6 +172,16 @@ MEMORY_DEBUG = conf(
 # ---------------------------------------------------------------------------
 # Shuffle (reference: RapidsConf.scala:687-786)
 # ---------------------------------------------------------------------------
+SHUFFLE_MESH_SIZE = conf(
+    "spark.rapids.tpu.shuffle.meshSize", 0,
+    "Number of devices in the exchange mesh (0 = all local devices).")
+SHUFFLE_MODE = conf(
+    "spark.rapids.tpu.shuffle.mode", "auto",
+    "Exchange lowering: 'ici' lowers shuffle-bounded stages to one SPMD "
+    "shard_map program over the device mesh (collectives over ICI), 'host' "
+    "uses the single-host exchange, 'auto' picks ici when >1 device is "
+    "visible. Reference analog: spark.rapids.shuffle.transport.enabled.",
+    valid_values=("auto", "host", "ici"))
 SHUFFLE_TRANSPORT_CLASS = conf(
     "spark.rapids.tpu.shuffle.transport.class", "device",
     "Transport for exchange pieces: 'device' (pieces stay TPU-resident in "

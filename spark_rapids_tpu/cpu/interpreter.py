@@ -188,6 +188,8 @@ def _java_cast(v: Any, frm: T.DataType, to: T.DataType) -> Any:
             if x <= -(2**63):
                 return -(2**63)
             return int(x)
+        if isinstance(frm, T.BooleanType):
+            return 1 if v else 0  # Spark: true -> 1 MICROsecond
         return v * 1_000_000
     if isinstance(to, T.BooleanType):
         return v != 0

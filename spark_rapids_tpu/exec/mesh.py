@@ -1,0 +1,435 @@
+"""Mesh-lowered exchange stages: whole shuffle-bounded plan fragments as ONE
+shard_map program over the device mesh.
+
+Reference analog: the accelerated shuffle path the planner actually selects
+(RapidsShuffleInternalManager.scala:58-150 + the UCX transport): there, a
+PARTIAL aggregate, a device-cached shuffle write, an RDMA fetch, and a FINAL
+aggregate are four separately-scheduled stages. Here the planner lowers the
+whole exchange-bounded stage — partial aggregate -> all_to_all -> final
+merge -> result projection, or local-sort -> sampled range exchange -> merge
+sort, or hash-exchange both sides -> local join — into ONE jitted SPMD
+computation over a jax.sharding.Mesh (parallel/distributed.py), with child
+partition i living on mesh shard i % n. XLA schedules the ICI collectives
+against compute; nothing touches the host between the child batches and the
+stage output.
+
+Columns crossing the mesh must be fixed-width (the collective exchange's
+contract); the planner keeps string-bearing stages on the single-host
+exchange path (exec/exchange.py).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+try:
+    from jax import shard_map as _shard_map_impl  # jax >= 0.6
+    _SM_KW = {"check_vma": False}
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+    _SM_KW = {"check_rep": False}
+
+
+def shard_map(f, mesh, in_specs, out_specs, **_ignored):
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **_SM_KW)
+
+from .. import types as T
+from ..columnar import ColumnarBatch, DeviceColumn
+from ..conf import RapidsConf
+from ..expr import aggregates as A
+from ..expr import expressions as E
+from ..expr.eval import ColV, lower
+from ..ops.sort import SortOrder
+from ..parallel import distributed as D
+from ..parallel.mesh import AXIS, get_mesh, row_sharding
+from ..types import StructField, StructType
+from ..utils.bucketing import bucket_rows
+from . import aggregate as XA
+from .base import TOTAL_TIME, TpuExec, timed
+
+P = jax.sharding.PartitionSpec
+
+
+def _np_of(arr) -> np.ndarray:
+    return np.asarray(jax.device_get(arr))
+
+
+class _MeshStage(TpuExec):
+    """Base: stage child partitions onto the mesh, run one SPMD program,
+    emit one output partition per shard."""
+
+    def __init__(self, conf: RapidsConf, children: Sequence[TpuExec]):
+        super().__init__(conf, children)
+        from ..conf import SHUFFLE_MESH_SIZE
+
+        self.mesh = get_mesh(conf.get(SHUFFLE_MESH_SIZE) or None)
+        self.n_shards = int(self.mesh.devices.size)
+        self._outputs: Optional[List[Optional[ColumnarBatch]]] = None
+
+    @property
+    def num_partitions(self) -> int:
+        return self.n_shards
+
+    # -- staging -----------------------------------------------------------
+    def _stage_child(self, child: TpuExec) -> Tuple[List[jax.Array], np.ndarray, int]:
+        """Materialize every child partition and lay rows onto the mesh:
+        returns (global (n*cap,) data/validity arrays per column, per-shard
+        counts, per-shard cap). Child partition p maps to shard p % n."""
+        schema = child.output_schema
+        per_shard: List[List[ColumnarBatch]] = [[] for _ in range(self.n_shards)]
+        for p in range(child.num_partitions):
+            for b in child.execute_partition(p):
+                per_shard[p % self.n_shards].append(b)
+        counts = np.zeros(self.n_shards, np.int32)
+        rows_per_shard = [
+            sum(int(b.num_rows) for b in bs) for bs in per_shard
+        ]
+        cap = bucket_rows(max(max(rows_per_shard), 1),
+                          self.conf.shape_bucket_min)
+        ncols = len(schema.fields)
+        datas = [
+            np.zeros((self.n_shards, cap), f.dataType.to_numpy())
+            for f in schema.fields
+        ]
+        valids = [np.zeros((self.n_shards, cap), bool) for _ in range(ncols)]
+        for s, bs in enumerate(per_shard):
+            pos = 0
+            for b in bs:
+                n = int(b.num_rows)
+                for j, c in enumerate(b.columns):
+                    datas[j][s, pos:pos + n] = _np_of(c.data)[:n]
+                    valids[j][s, pos:pos + n] = _np_of(c.validity)[:n]
+                pos += n
+            counts[s] = pos
+        sh = row_sharding(self.mesh)
+        out: List[jax.Array] = []
+        for j in range(ncols):
+            out.append(jax.device_put(datas[j].reshape(-1), sh))
+            out.append(jax.device_put(valids[j].reshape(-1), sh))
+        return out, counts, cap
+
+    def _emit(self, schema: StructType, global_cols: Sequence[jax.Array],
+              counts: np.ndarray, cap: int) -> List[Optional[ColumnarBatch]]:
+        """Split (n*cap,) outputs back into per-shard batches."""
+        outs: List[Optional[ColumnarBatch]] = []
+        for s in range(self.n_shards):
+            n = int(counts[s])
+            cols = []
+            for j, f in enumerate(schema.fields):
+                d = global_cols[2 * j][s * cap:(s + 1) * cap]
+                v = global_cols[2 * j + 1][s * cap:(s + 1) * cap]
+                cols.append(DeviceColumn(f.dataType, n, d, v))
+            outs.append(ColumnarBatch(cols, schema, n))
+        return outs
+
+    def _materialize(self) -> None:
+        raise NotImplementedError
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        if self._outputs is None:
+            with timed(self.metrics[TOTAL_TIME]):
+                self._materialize()
+        b = self._outputs[index]
+        if b is not None and b.num_rows > 0:
+            yield self.record_batch(b)
+
+    def describe(self):
+        return f"{self.node_name}(mesh={self.n_shards})"
+
+
+_PROGRAM_CACHE: dict = {}
+
+
+def _cached_program(key, builder):
+    fn = _PROGRAM_CACHE.get(key)
+    if fn is None:
+        if len(_PROGRAM_CACHE) > 256:
+            _PROGRAM_CACHE.clear()
+        fn = _PROGRAM_CACHE[key] = builder()
+    return fn
+
+
+class TpuMeshAggregateExec(_MeshStage):
+    """partial-agg -> hash all_to_all -> final merge -> result projection,
+    one SPMD program (reference plan: GpuHashAggregateExec(PARTIAL) ->
+    GpuShuffleExchangeExec -> GpuHashAggregateExec(FINAL)).
+
+    The buffer layout / update-merge op split is borrowed from a PARTIAL
+    TpuHashAggregateExec (never executed — only its bound metadata)."""
+
+    def __init__(self, conf, group_exprs, agg_exprs, child):
+        _MeshStage.__init__(self, conf, [child])
+        self.group_exprs = list(group_exprs)
+        self.agg_exprs = list(agg_exprs)
+        plan = XA.TpuHashAggregateExec(
+            conf, group_exprs, agg_exprs, child, mode=A.PARTIAL)
+        self._key_fields = plan._key_fields
+        self._bound_keys = plan._bound_keys
+        self._bound_funcs = plan._bound_funcs
+        self._buf_fields = plan._buf_fields
+        self._buf_slices = plan._buf_slices
+        self._update_exprs = plan._update_exprs
+        self._update_ops = plan._update_ops
+        self._merge_ops = plan._merge_ops
+        fields = list(self._key_fields)
+        for ae, f in zip(self.agg_exprs, self._bound_funcs):
+            fields.append(StructField(ae.resolved_name(), f.dtype, True))
+        self._schema = StructType(tuple(fields))
+
+    def _key_dtypes(self):
+        return tuple(f.dataType for f in self._key_fields)
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def describe(self):
+        keys = ", ".join(str(k) for k in self.group_exprs)
+        return f"TpuMeshAggregateExec(mesh={self.n_shards}, keys=[{keys}])"
+
+    def _materialize(self) -> None:
+        child = self.children[0]
+        global_cols, counts, cap = self._stage_child(child)
+        nk = len(self._key_fields)
+        key_dtypes = list(self._key_dtypes())
+        bound_keys = tuple(self._bound_keys)
+        update_exprs = tuple(self._update_exprs)
+        update_ops = tuple(self._update_ops)
+        merge_ops = tuple(self._merge_ops)
+        buf_fields = tuple(self._buf_fields)
+        bound_funcs = tuple(self._bound_funcs)
+        buf_slices = tuple(self._buf_slices)
+        n_shards = self.n_shards
+        mesh = self.mesh
+
+        def build():
+            def shard_fn(*flat):
+                *colflat, cnt = flat
+                cols = [
+                    ColV(colflat[2 * j], colflat[2 * j + 1])
+                    for j in range(len(colflat) // 2)
+                ]
+                n = cnt[0]
+                keys = [lower(b, cols, cap) for b in bound_keys]
+                vals = [
+                    None if e is None else lower(e, cols, cap)
+                    for e in update_exprs
+                ]
+                rkeys, raggs, rn = D.dist_groupby(
+                    keys, key_dtypes, vals, list(update_ops),
+                    list(merge_ops), n, AXIS, n_shards)
+                # result projection over [keys..., buffers...], per shard
+                allv = list(rkeys) + list(raggs)
+                rcap = allv[0].validity.shape[0] if allv else 1
+                exprs: List[E.Expression] = [
+                    E.BoundReference(i, f.dataType, f.nullable)
+                    for i, f in enumerate(self._key_fields)
+                ]
+                for f, (s, e) in zip(bound_funcs, buf_slices):
+                    refs = tuple(
+                        E.BoundReference(nk + j, buf_fields[j].dataType, True)
+                        for j in range(s, e)
+                    )
+                    exprs.append(f.evaluate(refs))
+                outs = [lower(x, allv, rcap) for x in exprs]
+                flat_out = []
+                for o in outs:
+                    flat_out.append(o.data)
+                    flat_out.append(o.validity)
+                flat_out.append(rn.reshape(1))
+                return tuple(flat_out)
+
+            nin = len(global_cols)
+            fn = shard_map(
+                shard_fn, mesh=mesh,
+                in_specs=tuple([P(AXIS)] * nin + [P(AXIS)]),
+                out_specs=P(AXIS),
+            )
+            return jax.jit(fn)
+
+        sig = tuple((str(a.dtype), a.shape) for a in global_cols)
+        fn = _cached_program(
+            ("agg", self.fusion_sig(), sig, cap, n_shards), build)
+        cnt_in = jax.device_put(
+            np.asarray(counts, np.int32), row_sharding(mesh))
+        res = fn(*global_cols, cnt_in)
+        *out_cols, out_counts = res
+        rcap = out_cols[0].shape[0] // n_shards
+        self._outputs = self._emit(
+            self._schema, list(out_cols), _np_of(out_counts), rcap)
+
+    def fusion_sig(self):
+        return (
+            tuple(self._bound_keys), tuple(self._update_exprs),
+            tuple(self._update_ops), tuple(self._merge_ops),
+        )
+
+
+class TpuMeshSortExec(_MeshStage):
+    """local sort -> sampled range all_to_all -> merge sort, one SPMD
+    program (reference plan: GpuRangePartitioning exchange + GpuSortExec);
+    output partition i globally precedes partition i+1."""
+
+    def __init__(self, conf, sort_ordinals: Sequence[int],
+                 orders: Sequence[Tuple[bool, bool]], child: TpuExec):
+        _MeshStage.__init__(self, conf, [child])
+        self.key_indices = list(sort_ordinals)
+        self.orders = [SortOrder(a, nf) for a, nf in orders]
+        self._schema = child.output_schema
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def _materialize(self) -> None:
+        child = self.children[0]
+        global_cols, counts, cap = self._stage_child(child)
+        key_dtypes = [
+            self._schema.fields[i].dataType for i in self.key_indices
+        ]
+        n_shards, mesh = self.n_shards, self.mesh
+        key_ix, orders = list(self.key_indices), list(self.orders)
+
+        def build():
+            def shard_fn(*flat):
+                *colflat, cnt = flat
+                cols = [
+                    ColV(colflat[2 * j], colflat[2 * j + 1])
+                    for j in range(len(colflat) // 2)
+                ]
+                out, rn = D.dist_sort(
+                    cols, key_ix, key_dtypes, orders, cnt[0], AXIS, n_shards)
+                flat_out = []
+                for o in out:
+                    flat_out.append(o.data)
+                    flat_out.append(o.validity)
+                flat_out.append(rn.reshape(1))
+                return tuple(flat_out)
+
+            nin = len(global_cols)
+            return jax.jit(shard_map(
+                shard_fn, mesh=mesh,
+                in_specs=tuple([P(AXIS)] * (nin + 1)),
+                out_specs=P(AXIS)))
+
+        sig = tuple((str(a.dtype), a.shape) for a in global_cols)
+        fn = _cached_program(
+            ("sort", tuple(key_ix), tuple((o.ascending, o.nulls_first)
+                                          for o in orders), sig, n_shards),
+            build)
+        cnt_in = jax.device_put(np.asarray(counts, np.int32), row_sharding(mesh))
+        res = fn(*global_cols, cnt_in)
+        *out_cols, out_counts = res
+        rcap = out_cols[0].shape[0] // n_shards
+        self._outputs = self._emit(
+            self._schema, list(out_cols), _np_of(out_counts), rcap)
+
+
+class TpuMeshHashJoinExec(_MeshStage):
+    """hash all_to_all both sides -> local join, one SPMD program
+    (reference plan: two GpuShuffleExchangeExecs feeding
+    GpuShuffledHashJoinExec). Inner equi-joins, no residual condition."""
+
+    def __init__(self, conf, left: TpuExec, right: TpuExec,
+                 left_ordinals: Sequence[int], right_ordinals: Sequence[int]):
+        _MeshStage.__init__(self, conf, [left, right])
+        self.left_ix = list(left_ordinals)
+        self.right_ix = list(right_ordinals)
+        lf = left.output_schema.fields
+        rf = right.output_schema.fields
+        self._schema = StructType(tuple(lf) + tuple(rf))
+        self._key_dtypes = [
+            left.output_schema.fields[i].dataType for i in self.left_ix
+        ]
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def _materialize(self) -> None:
+        left, right = self.children
+        l_cols, l_counts, lcap = self._stage_child(left)
+        r_cols, r_counts, rcap = self._stage_child(right)
+        n_shards, mesh = self.n_shards, self.mesh
+        l_ix, r_ix, kd = list(self.left_ix), list(self.right_ix), list(
+            self._key_dtypes)
+        nl = len(left.output_schema.fields)
+        out_cap = bucket_rows(
+            max(lcap, rcap) * 2, self.conf.shape_bucket_min)
+
+        for attempt in range(8):
+            def build(out_cap=out_cap):
+                def shard_fn(*flat):
+                    lflat = flat[: 2 * nl]
+                    rflat = flat[2 * nl:-2]
+                    lcnt, rcnt = flat[-2], flat[-1]
+                    lc = [ColV(lflat[2 * j], lflat[2 * j + 1])
+                          for j in range(nl)]
+                    rc = [ColV(rflat[2 * j], rflat[2 * j + 1])
+                          for j in range(len(rflat) // 2)]
+                    out, cnt, ok = D.dist_hash_join(
+                        lc, l_ix, rc, r_ix, kd, lcnt[0], rcnt[0],
+                        AXIS, n_shards, out_cap)
+                    flat_out = []
+                    for o in out:
+                        flat_out.append(o.data)
+                        flat_out.append(o.validity)
+                    flat_out.append(cnt.reshape(1))
+                    flat_out.append(ok.reshape(1))
+                    return tuple(flat_out)
+
+                nin = 2 * nl + len(r_cols) + 2
+                return jax.jit(shard_map(
+                    shard_fn, mesh=mesh,
+                    in_specs=tuple([P(AXIS)] * nin),
+                    out_specs=P(AXIS)))
+
+            sig = (
+                tuple((str(a.dtype), a.shape) for a in l_cols),
+                tuple((str(a.dtype), a.shape) for a in r_cols),
+            )
+            fn = _cached_program(
+                ("join", tuple(l_ix), tuple(r_ix), sig, out_cap, n_shards),
+                build)
+            sh = row_sharding(mesh)
+            res = fn(*l_cols, *r_cols,
+                     jax.device_put(np.asarray(l_counts, np.int32), sh),
+                     jax.device_put(np.asarray(r_counts, np.int32), sh))
+            *out_cols, out_counts, oks = res
+            if bool(np.all(_np_of(oks))):
+                ocap = out_cols[0].shape[0] // n_shards
+                self._outputs = self._emit(
+                    self._schema, list(out_cols), _np_of(out_counts), ocap)
+                return
+            # overflow: double the per-shard output capacity and recompile
+            # (the reference's bounce-buffer windowing retries similarly)
+            out_cap *= 2
+        raise RuntimeError("mesh join output capacity retry limit exceeded")
+
+
+# ---------------------------------------------------------------------------
+# planner eligibility
+# ---------------------------------------------------------------------------
+def mesh_mode(conf: RapidsConf) -> str:
+    from ..conf import SHUFFLE_MODE
+
+    return conf.get(SHUFFLE_MODE)
+
+
+def mesh_available(conf: RapidsConf) -> bool:
+    mode = mesh_mode(conf)
+    if mode == "host":
+        return False
+    if mode == "ici":
+        return True
+    from ..parallel.mesh import device_count
+
+    return device_count() > 1
+
+
+def fixed_width_schema(schema: StructType) -> bool:
+    return all(T.is_fixed_width(f.dataType) for f in schema.fields)

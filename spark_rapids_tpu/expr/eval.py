@@ -71,6 +71,8 @@ def _cast_data(data: jax.Array, frm: DataType, to: DataType) -> jax.Array:
             return jnp.where(
                 jnp.isfinite(x) & (x <= float(-(2**63))),
                 jnp.int64(-(2**63)), i)
+        if isinstance(frm, T.BooleanType):
+            return data.astype(jnp.int64)  # Spark: true -> 1 MICROsecond
         return data.astype(jnp.int64) * 1_000_000  # integral seconds
     if isinstance(frm, T.DateType) or isinstance(to, T.DateType):
         raise UnsupportedExpressionError(

@@ -12,7 +12,7 @@ results for pre-epoch values everywhere.
 """
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Callable, Tuple
 
 import jax.numpy as jnp
 import numpy as np

@@ -546,8 +546,7 @@ def _split_part(expr: E.StringSplitPart, c: StrV, cap: int) -> StrV:
     if idx > n // md:
         # index beyond any possible part count -> all null (also caps the
         # (cap, K) occurrence matrix allocation)
-        return StrV(jnp.zeros(cap + 1, jnp.int32), jnp.zeros(1, jnp.uint8),
-                    jnp.zeros(cap, jnp.bool_))
+        return _all_null_str(cap)
     pos = jnp.arange(n, dtype=jnp.int32)
     rid = S.row_ids(c.offsets, n)
     lens = S.byte_lens(c.offsets)

@@ -1,0 +1,44 @@
+"""Device mesh construction for the SPMD exchange path.
+
+Reference analog: GpuShuffleEnv / the UCX transport bring-up
+(GpuShuffleEnv.scala:26-107, shuffle-plugin UCX.scala:53-130) — on TPU the
+"transport" is the mesh itself: one jax.sharding.Mesh over the local
+devices, collectives riding ICI. There is no connection establishment, no
+management port, no bounce-buffer pool to size; XLA owns the wire.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+AXIS = "shards"
+
+_MESH_CACHE: dict = {}
+
+
+def device_count() -> int:
+    return jax.local_device_count()
+
+
+def get_mesh(n: Optional[int] = None) -> "jax.sharding.Mesh":
+    """A 1-D mesh over the first ``n`` local devices (default: all)."""
+    devs = jax.devices()
+    n = n or len(devs)
+    key = (n, tuple(id(d) for d in devs[:n]))
+    m = _MESH_CACHE.get(key)
+    if m is None:
+        m = jax.sharding.Mesh(np.array(devs[:n]), (AXIS,))
+        _MESH_CACHE[key] = m
+    return m
+
+
+def shard_spec() -> "jax.sharding.PartitionSpec":
+    return jax.sharding.PartitionSpec(AXIS)
+
+
+def row_sharding(mesh) -> "jax.sharding.NamedSharding":
+    """Rows split over the shard axis (leading dim)."""
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(AXIS))

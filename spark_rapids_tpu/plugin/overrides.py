@@ -449,11 +449,37 @@ def _shuffle_partitions(conf, child) -> int:
     return n if n > 0 else child.num_partitions
 
 
+def _mesh_eligible(conf, *schemas) -> bool:
+    """True when the exchange-bounded stage can lower to ONE shard_map
+    program over the device mesh (exec/mesh.py): mesh mode on and every
+    column crossing the collective is fixed-width."""
+    from ..exec.mesh import fixed_width_schema, mesh_available
+
+    return mesh_available(conf) and all(
+        fixed_width_schema(s) for s in schemas)
+
+
 def _convert_aggregate(cpu: C.CpuHashAggregateExec, conf, children):
     child = children[0]
     if child.num_partitions == 1:
         return XA.TpuHashAggregateExec(
             conf, cpu.group_exprs, cpu.agg_exprs, child, A.COMPLETE)
+    # mesh path: the whole partial->exchange->final stage as one shard_map
+    # program over ICI (the accelerated-shuffle analog the planner selects,
+    # RapidsShuffleInternalManager.scala:58-150)
+    if cpu.group_exprs and _mesh_eligible(conf, child.output_schema):
+        try:
+            key_dts = [
+                E.bind_references(g, child.output_schema).dtype
+                for g in cpu.group_exprs
+            ]
+        except (ValueError, KeyError):
+            key_dts = [T.STRING]
+        if all(T.is_fixed_width(dt) for dt in key_dts):
+            from ..exec.mesh import TpuMeshAggregateExec
+
+            return TpuMeshAggregateExec(
+                conf, cpu.group_exprs, cpu.agg_exprs, child)
     # partial per partition -> key-hash exchange -> final merge per reduce
     # partition (reference: GpuHashAggregateExec partial/final split +
     # GpuShuffleExchangeExec; group keys are partition-disjoint after the
@@ -514,6 +540,16 @@ def _convert_sort(cpu: C.CpuSortExec, conf, children):
     except (ValueError, KeyError):
         bound = []
     P = _shuffle_partitions(conf, child)
+    if (
+        bound and all(isinstance(b, E.BoundReference) for b in bound)
+        and _mesh_eligible(conf, schema)
+    ):
+        # mesh path: local sort -> sampled range all_to_all -> merge sort
+        # as one shard_map program
+        from ..exec.mesh import TpuMeshSortExec
+
+        return TpuMeshSortExec(
+            conf, [b.ordinal for b in bound], cpu.orders, child)
     if bound and all(isinstance(b, E.BoundReference) for b in bound) and P > 1:
         part = RangePartitioning(
             [b.ordinal for b in bound],
@@ -597,6 +633,17 @@ def _convert_join(cpu: C.CpuJoinExec, conf, children):
             # keep those single-partition until the planner inserts casts
             and all(l.dtype == r.dtype for l, r in zip(lb, rb))
         )
+        if (
+            plain and cpu.join_type == "inner" and cpu.condition is None
+            and _mesh_eligible(conf, left.output_schema, right.output_schema)
+        ):
+            # mesh path: hash-exchange both sides + local join, one
+            # shard_map program
+            from ..exec.mesh import TpuMeshHashJoinExec
+
+            return TpuMeshHashJoinExec(
+                conf, left, right,
+                [b.ordinal for b in lb], [b.ordinal for b in rb])
         if plain and P > 1:
             lpart = HashPartitioning([b.ordinal for b in lb], P)
             rpart = HashPartitioning([b.ordinal for b in rb], P)

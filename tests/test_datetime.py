@@ -173,6 +173,18 @@ def test_cast_string_to_timestamp():
         T.TIMESTAMP)
 
 
+def test_cast_bool_to_timestamp_micros():
+    """Spark maps true -> 1 MICROsecond (pinned constant: the oracle shares
+    the implementation risk, so a differential test can't catch this)."""
+    schema = schema_of(p=T.BOOLEAN)
+    batch = ColumnarBatch.from_pydict({"p": [True, False, None]}, schema)
+    bound = bind_references(E.Cast(col("p"), T.TIMESTAMP), schema)
+    [r] = evaluate_projection([bound], batch)
+    assert r.to_pylist() == [1, 0, None]
+    assert eval_expression_rows(bound, [(True,), (False,), (None,)]) == \
+        [1, 0, None]
+
+
 def test_cast_edge_pairs():
     """Review regressions: ts->bool uses micros, float->ts nulls
     non-finite and saturates."""

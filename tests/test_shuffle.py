@@ -254,7 +254,10 @@ def test_shuffle_partitions_conf_sets_reducer_count():
     from spark_rapids_tpu.sql.session import TpuSession
 
     data = _rand_kv(300, 15, seed=31)
-    s = TpuSession({"spark.rapids.tpu.sql.shuffle.partitions": 7})
+    # reducer-count conf applies to the single-host exchange; the mesh path
+    # derives its shard count from the device mesh instead
+    s = TpuSession({"spark.rapids.tpu.sql.shuffle.partitions": 7,
+                    "spark.rapids.tpu.shuffle.mode": "host"})
     df = s.create_dataframe(data, _KV_SCHEMA, num_partitions=2)
     out = df.group_by("k").agg(A.agg(A.Count(), "c")).collect()
     # find the exchange in the executed plan
