@@ -164,16 +164,13 @@ class DeviceColumn:
         n = int(self.length)
         validity = np.asarray(jax.device_get(self.validity))[:n]
         if self.is_string:
+            from .batch import decode_string_rows
+
             offsets = np.asarray(jax.device_get(self.offsets))
             chars = np.asarray(jax.device_get(self.chars))
-            data = np.empty(n, dtype=object)
-            raw = chars.tobytes()
-            for i in range(n):
-                if validity[i]:
-                    b = raw[int(offsets[i]) : int(offsets[i + 1])]
-                    data[i] = b if isinstance(self.dtype, BinaryType) else b.decode("utf-8")
-                else:
-                    data[i] = None
+            data = decode_string_rows(
+                chars, offsets, validity, n,
+                binary=isinstance(self.dtype, BinaryType))
             return HostColumn(self.dtype, data, validity)
         data = np.asarray(jax.device_get(self.data))[:n].copy()
         return HostColumn(self.dtype, data, validity)

@@ -98,9 +98,20 @@ class TpuExec:
         raise NotImplementedError(type(self).__name__)
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
-        """All partitions, serially (driver-side collect path)."""
+        """All partitions, serially (driver-side collect path).
+
+        Each partition holds the TPU concurrency semaphore while its device
+        work runs (reference: GpuSemaphore.acquireIfNecessary before the
+        first device allocation of a task, released at task end)."""
+        from ..memory import TpuSemaphore
+
+        sem = TpuSemaphore.initialize(self.conf)
         for p in range(self.num_partitions):
-            yield from self.execute_partition(p)
+            sem.acquire_if_necessary()
+            try:
+                yield from self.execute_partition(p)
+            finally:
+                sem.release_if_necessary()
 
     #: True when lower_batch may clear liveness bits (filters); tells the
     #: chain driver a final compaction is needed for standalone output

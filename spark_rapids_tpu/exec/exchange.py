@@ -159,6 +159,7 @@ class TpuShuffleExchangeExec(TpuExec):
         self.transport = transport or make_transport(conf)
         self.shuffle_id = new_shuffle_id()
         self._map_done = False
+        self._consumed: set = set()
         self._map_lock = threading.Lock()
         self._jits: Dict[tuple, object] = {}
         self.metrics[PARTITION_SIZE] = self.metric(PARTITION_SIZE)
@@ -339,6 +340,14 @@ class TpuShuffleExchangeExec(TpuExec):
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
         self._run_map_side()
         pieces = self.transport.fetch(self.shuffle_id, index)
+        self._consumed.add(index)
+        if len(self._consumed) >= self.num_partitions:
+            # every reduce partition fetched once: drop the cached pieces
+            # (the reference ties shuffle buffer lifetime to the stage) and
+            # reset the map latch so a re-execution rebuilds them
+            self.transport.release(self.shuffle_id)
+            self._consumed.clear()
+            self._map_done = False
         if not pieces:
             return
         schema = self.output_schema
@@ -388,8 +397,19 @@ class TpuBroadcastExchangeExec(TpuExec):
 
                     built = deserialize_batch(serialize_batch(
                         built, self.conf.get(SHUFFLE_COMPRESSION_CODEC)))
-                self._built = built
-            return self._built
+                if built is not None:
+                    # broadcast batches are registered spillable, like the
+                    # reference's SerializeConcatHostBuffersDeserializeBatch
+                    # living in the catalog (GpuBroadcastExchangeExec.scala);
+                    # only the handle keeps a reference, so a spill really
+                    # frees the device copy
+                    from ..memory import SpillableColumnarBatch
+
+                    self._spillable = SpillableColumnarBatch(built)
+                self._built = True  # latch: build attempted
+            if getattr(self, "_spillable", None) is not None:
+                return self._spillable.get_batch()
+            return None
 
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
         b = self.materialize()

@@ -29,6 +29,28 @@ from ..ops import join as join_ops
 from ..ops.sort import max_string_len, sort_with_radix_keys, SortOrder
 from ..types import StructField, StructType
 from ..utils.bucketing import bucket_rows
+
+
+class _SpillableBuild:
+    """Join build side as catalog-registered spillable buffers: the sorted
+    build columns + radix words + liveness round-trip device<->host under
+    pressure and re-materialize at probe time (reference:
+    SpillableColumnarBatch around the concatenated build table)."""
+
+    def __init__(self, cols, words, live):
+        from ..memory import ACTIVE_BATCHING_PRIORITY, SpillableVals
+        from ..memory.catalog import SpillableHandle
+
+        self._cols = SpillableVals(cols, ACTIVE_BATCHING_PRIORITY)
+        aux = {f"w{i}": w for i, w in enumerate(words)}
+        aux["live"] = live
+        self._aux = SpillableHandle(aux, ACTIVE_BATCHING_PRIORITY)
+        self._nw = len(words)
+
+    def get(self):
+        cols = self._cols.get_vals()
+        a = self._aux.materialize()
+        return cols, [a[f"w{i}"] for i in range(self._nw)], a["live"]
 from .base import (
     NUM_OUTPUT_BATCHES,
     TOTAL_TIME,
@@ -224,15 +246,22 @@ class TpuShuffledHashJoinExec(TpuExec):
             ("build", batch_signature(batch), cap, sml), prep)
         sorted_cols, sorted_words, count, live_all = fn(
             vals_of_batch(batch), count_scalar(n))
-        built = (
-            batch, sorted_cols, sorted_words, int(count), cap, sml, live_all)
+        # the build side is registered with the buffer catalog so memory
+        # pressure can spill it between build and probe (reference:
+        # SpillableColumnarBatch around the concatenated build table,
+        # GpuShuffledHashJoinExec)
+        sb = _SpillableBuild(sorted_cols, sorted_words, live_all)
+        # the raw concatenated batch must NOT ride in the tuple: the handle
+        # is the only reference so a spill actually frees the device copy
+        built = (sb, int(count), cap, sml)
         self._built[index] = built
         return built
 
     # -- probe -------------------------------------------------------------
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
-        (build_batch, build_cols, build_words, build_count, build_cap, bsml,
-         build_live_all) = self._get_build(index if self.partitioned else None)
+        (sb, build_count, build_cap, bsml) = self._get_build(
+            index if self.partitioned else None)
+        build_cols, build_words, build_live_all = sb.get()
         build_schema = self._build.output_schema
         matched_any = (
             jnp.zeros(build_cap, jnp.bool_) if self.join_type == "full" else None
@@ -306,27 +335,53 @@ class TpuShuffledHashJoinExec(TpuExec):
         if total == 0:
             return None, matched
         out_cap = bucket_rows(total, self.conf.shape_bucket_min)
-        p, build_row, slot_live = join_ops.expansion_plan(aux, lo, out_cap)
-        # rows with zero real matches (left join padding) read "no build row"
-        pad_slot = slot_live & (jnp.take(counts, p, mode="clip") == 0)
-        build_live = slot_live & ~pad_slot
 
-        def str_caps(cols, rows, live_mask):
-            caps = []
-            for c in cols:
-                if isinstance(c, StrV):
-                    lens = c.offsets[1:] - c.offsets[:-1]
-                    need = jnp.sum(jnp.where(
-                        live_mask, jnp.take(lens, rows, mode="clip"), 0))
-                    caps.append(bucket_rows(max(1, int(need)), 128))
-            return caps
+        has_strings = any(isinstance(c, StrV) for c in build_cols) or any(
+            c.is_string for c in pbatch.columns)
+        if has_strings:
+            # string outputs need host-synced byte capacities; keep the
+            # original eager path for those
+            p, build_row, slot_live = join_ops.expansion_plan(aux, lo, out_cap)
+            pad_slot = slot_live & (jnp.take(counts, p, mode="clip") == 0)
+            build_live = slot_live & ~pad_slot
 
-        probe_side = filter_gather.gather(
-            vals_of_batch(pbatch), p, slot_live,
-            str_caps(vals_of_batch(pbatch), p, slot_live))
-        build_side = filter_gather.gather(
-            build_cols, build_row, build_live,
-            str_caps(build_cols, build_row, build_live))
+            def str_caps(cols, rows, live_mask):
+                caps = []
+                for c in cols:
+                    if isinstance(c, StrV):
+                        lens = c.offsets[1:] - c.offsets[:-1]
+                        need = jnp.sum(jnp.where(
+                            live_mask, jnp.take(lens, rows, mode="clip"), 0))
+                        caps.append(bucket_rows(max(1, int(need)), 128))
+                return caps
+
+            probe_side = filter_gather.gather(
+                vals_of_batch(pbatch), p, slot_live,
+                str_caps(vals_of_batch(pbatch), p, slot_live))
+            build_side = filter_gather.gather(
+                build_cols, build_row, build_live,
+                str_caps(build_cols, build_row, build_live))
+        else:
+            # fixed-width: the whole expansion (plan + pad mask + both
+            # gathers) is ONE jitted program — eager per-op dispatch over
+            # out_cap-sized arrays dominated join wallclock otherwise
+            def expand_phase(pvals, bcols, lo_, counts_, aux_):
+                p, build_row, slot_live = join_ops.expansion_plan(
+                    aux_, lo_, out_cap)
+                pad_slot = slot_live & (
+                    jnp.take(counts_, p, mode="clip") == 0)
+                build_live = slot_live & ~pad_slot
+                return (
+                    filter_gather.gather(pvals, p, slot_live),
+                    filter_gather.gather(bcols, build_row, build_live),
+                )
+
+            ekey = ("expand", batch_signature(pbatch), out_cap,
+                    len(build_cols),
+                    tuple(int(c.data.shape[0]) for c in build_cols))
+            fne = self._jit_cache_get(ekey, expand_phase)
+            probe_side, build_side = fne(
+                vals_of_batch(pbatch), list(build_cols), lo, counts, aux)
         left_side, right_side = (
             (build_side, probe_side) if self._swap else (probe_side, build_side)
         )

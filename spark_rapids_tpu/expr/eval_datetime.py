@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
+from ..ops import strings as S
 from ..utils.bucketing import bucket_rows
 from . import expressions as E
 from .values import ColV, StrV, UnsupportedExpressionError
@@ -229,8 +230,7 @@ def format_date(c: ColV, cap: int) -> StrV:
         [jnp.zeros(1, jnp.int32), jnp.cumsum(lens)])
     out_cap = bucket_rows(max(cap * 11, 128))
     pos = jnp.arange(out_cap, dtype=jnp.int32)
-    rid = jnp.clip(jnp.searchsorted(new_offsets, pos, side="right") - 1,
-                   0, cap - 1)
+    rid = S.rows_of_positions(new_offsets, pos.shape[0])
     w = pos - new_offsets[:-1][rid]
     sgn = neg[rid].astype(jnp.int32)
     yw = ydig[rid].astype(jnp.int32)
@@ -282,8 +282,7 @@ def format_timestamp(c: ColV, cap: int, with_fraction: bool = True) -> StrV:
     new_offsets = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(lens)])
     out_cap = bucket_rows(max(cap * 27, 128))
     pos = jnp.arange(out_cap, dtype=jnp.int32)
-    rid = jnp.clip(jnp.searchsorted(new_offsets, pos, side="right") - 1,
-                   0, cap - 1)
+    rid = S.rows_of_positions(new_offsets, pos.shape[0])
     w = pos - new_offsets[:-1][rid]
     sgn = neg[rid].astype(jnp.int32)
     yw = ydig[rid].astype(jnp.int32)

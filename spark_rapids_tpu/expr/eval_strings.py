@@ -76,7 +76,7 @@ def select_strings(choices: Sequence[StrV], sel: jax.Array,
     new_lens = jnp.where(valid, lens[sel, rows], 0)
     new_offsets = S.offsets_of_lens(new_lens)
     pos = jnp.arange(out_cap, dtype=jnp.int32)
-    rid = jnp.clip(jnp.searchsorted(new_offsets, pos, side="right") - 1, 0, cap - 1)
+    rid = S.rows_of_positions(new_offsets, pos.shape[0])
     within = pos - new_offsets[rid]
     out = jnp.zeros(out_cap, jnp.uint8)
     for k, c in enumerate(choices):
@@ -449,7 +449,7 @@ def _pad(expr, c: StrV, cap: int, left: bool) -> StrV:
     new_offsets = S.offsets_of_lens(out_lens)
     out_cap = bucket_rows(max(cap * 4 * L, 1))
     opos = jnp.arange(out_cap, dtype=jnp.int32)
-    rid = jnp.clip(jnp.searchsorted(new_offsets, opos, side="right") - 1, 0, cap - 1)
+    rid = S.rows_of_positions(new_offsets, opos.shape[0])
     w = opos - new_offsets[:-1][rid]
     pl = jnp.where(trunc, 0, pad_bytes)[rid]
     if left:
@@ -790,7 +790,7 @@ def cast_int_to_string(c: ColV, cap: int, frm: T.DataType) -> StrV:
     new_offsets = S.offsets_of_lens(lens)
     out_cap = bucket_rows(max(cap * 20, 128))
     pos = jnp.arange(out_cap, dtype=jnp.int32)
-    rid = jnp.clip(jnp.searchsorted(new_offsets, pos, side="right") - 1, 0, cap - 1)
+    rid = S.rows_of_positions(new_offsets, pos.shape[0])
     w = pos - new_offsets[:-1][rid]
     sign_len = neg[rid].astype(jnp.int32)
     k = nd[rid] - 1 - (w - sign_len)  # digit place, MSD first
@@ -807,7 +807,7 @@ def cast_bool_to_string(c: ColV, cap: int) -> StrV:
     tpat = jnp.asarray(np.frombuffer(b"true\x00", np.uint8))
     fpat = jnp.asarray(np.frombuffer(b"false", np.uint8))
     pos = jnp.arange(out_cap, dtype=jnp.int32)
-    rid = jnp.clip(jnp.searchsorted(new_offsets, pos, side="right") - 1, 0, cap - 1)
+    rid = S.rows_of_positions(new_offsets, pos.shape[0])
     w = jnp.clip(pos - new_offsets[:-1][rid], 0, 4)
     out = jnp.where(c.data[rid], tpat[w], fpat[w])
     out = jnp.where(pos < new_offsets[-1], out, jnp.uint8(0))

@@ -1,0 +1,33 @@
+"""Device & memory runtime (SURVEY.md L1 / §2.7).
+
+Reference analog: the RapidsBufferCatalog / SpillableColumnarBatch /
+GpuSemaphore subsystem. XLA owns the physical allocator on TPU, so this
+layer does what RMM's pool + event handler did by *accounting*: registered
+buffers count toward a budget, and pressure drains them host/disk-ward.
+"""
+from .catalog import (
+    ACTIVE_BATCHING_PRIORITY,
+    BufferCatalog,
+    HOST_MEMORY_BUFFER_SPILL_PRIORITY,
+    INPUT_FROM_SHUFFLE_PRIORITY,
+    SpillableHandle,
+    TIER_DEVICE,
+    TIER_DISK,
+    TIER_HOST,
+)
+from .semaphore import TpuSemaphore
+from .spillable import SpillableColumnarBatch, SpillableVals
+
+__all__ = [
+    "ACTIVE_BATCHING_PRIORITY",
+    "BufferCatalog",
+    "HOST_MEMORY_BUFFER_SPILL_PRIORITY",
+    "INPUT_FROM_SHUFFLE_PRIORITY",
+    "SpillableHandle",
+    "SpillableColumnarBatch",
+    "SpillableVals",
+    "TIER_DEVICE",
+    "TIER_DISK",
+    "TIER_HOST",
+    "TpuSemaphore",
+]

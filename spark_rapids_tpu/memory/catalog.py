@@ -1,0 +1,289 @@
+"""Buffer catalog with tiered DEVICE -> HOST -> DISK spill.
+
+Reference analog: RapidsBufferCatalog.scala:34-109 (central registry of
+spillable buffers keyed by id), RapidsBufferStore.scala:40 (store chain with
+synchronous spill on allocation pressure), SpillPriorities.scala:26, and
+DeviceMemoryEventHandler.scala:33 (allocation-failure callback draining the
+stores). There is no RMM on TPU — XLA owns the allocator — so pressure is
+tracked by *accounting*: every registered buffer adds its byte size to the
+device-tier total, and `request()` (called before large materializations)
+drains lowest-priority buffers to host/disk until the configured budget
+holds. jax arrays whose last reference drops are freed by XLA, so "spill"
+here means: copy to host numpy (or an .npz on disk), drop the device
+reference, and rematerialize on demand.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional
+
+from ..conf import (
+    HBM_POOL_FRACTION,
+    HBM_RESERVE,
+    HOST_SPILL_STORAGE_SIZE,
+    MEMORY_DEBUG,
+    RapidsConf,
+    SPILL_ENABLED,
+    conf,
+)
+
+log = logging.getLogger("spark_rapids_tpu.memory")
+
+HBM_BUDGET_BYTES = conf(
+    "spark.rapids.tpu.memory.hbm.budgetBytes", 0,
+    "Explicit spill budget for catalog-tracked device buffers; 0 derives "
+    "it from allocFraction * device memory (or unlimited when the backend "
+    "reports no memory stats).")
+
+# tier ordering (reference: RapidsBuffer.scala:54-61 StorageTier)
+TIER_DEVICE = 0
+TIER_HOST = 1
+TIER_DISK = 2
+
+# spill priorities (reference: SpillPriorities.scala:26)
+HOST_MEMORY_BUFFER_SPILL_PRIORITY = -100
+INPUT_FROM_SHUFFLE_PRIORITY = -50
+ACTIVE_BATCHING_PRIORITY = 0
+
+
+class SpillMetrics:
+    def __init__(self):
+        self.device_to_host = 0
+        self.host_to_disk = 0
+        self.spilled_bytes = 0
+
+
+class BufferCatalog:
+    """Process-wide registry of spillable buffers.
+
+    Buffers register with a byte size and spill priority; `request(bytes)`
+    synchronously spills lowest-priority device buffers until the budget
+    accommodates the new allocation (reference:
+    RapidsBufferStore.synchronousSpill)."""
+
+    _instance: Optional["BufferCatalog"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, conf_: Optional[RapidsConf] = None):
+        self.conf = conf_ or RapidsConf({})
+        self._lock = threading.RLock()
+        self._buffers: Dict[int, "SpillableHandle"] = {}
+        self._next_id = 0
+        self._device_bytes = 0
+        self._host_bytes = 0
+        self.metrics = SpillMetrics()
+        self._spill_dir: Optional[str] = None
+        self._budget = self._derive_budget()
+
+    # -- singleton (reference: RapidsBufferCatalog.singleton) --------------
+    @classmethod
+    def get(cls) -> "BufferCatalog":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = BufferCatalog()
+            return cls._instance
+
+    @classmethod
+    def reset(cls, conf_: Optional[RapidsConf] = None) -> "BufferCatalog":
+        """Re-initialize (tests / executor restart)."""
+        with cls._instance_lock:
+            cls._instance = BufferCatalog(conf_)
+            return cls._instance
+
+    def _derive_budget(self) -> Optional[int]:
+        explicit = self.conf.get(HBM_BUDGET_BYTES)
+        if explicit:
+            return int(explicit)
+        try:
+            import jax
+
+            stats = jax.devices()[0].memory_stats()
+            limit = stats.get("bytes_limit") if stats else None
+        except Exception:  # pragma: no cover - backend-dependent
+            limit = None
+        if not limit:
+            return None  # unlimited: accounting only
+        frac = self.conf.get(HBM_POOL_FRACTION)
+        reserve = self.conf.get(HBM_RESERVE)
+        return max(int(limit * frac) - reserve, 1 << 20)
+
+    # -- registration ------------------------------------------------------
+    def register(self, handle: "SpillableHandle") -> int:
+        with self._lock:
+            bid = self._next_id
+            self._next_id += 1
+            self._buffers[bid] = handle
+            self._device_bytes += handle.size
+            if self.conf.get(MEMORY_DEBUG):
+                log.info("register buffer %d (%d B, prio %d): device=%d B",
+                         bid, handle.size, handle.priority, self._device_bytes)
+        self.request(0)
+        return bid
+
+    def unregister(self, bid: int) -> None:
+        with self._lock:
+            h = self._buffers.pop(bid, None)
+            if h is None:
+                return
+            if h.tier == TIER_DEVICE:
+                self._device_bytes -= h.size
+            elif h.tier == TIER_HOST:
+                self._host_bytes -= h.size
+
+    def on_unspill(self, h: "SpillableHandle", from_host: bool) -> None:
+        with self._lock:
+            if from_host:
+                self._host_bytes -= h.size
+            self._device_bytes += h.size
+        # the just-materialized buffer is the one in use: spill OTHERS to
+        # make room (the reference pins via addReference during access)
+        self.request(0, exclude=h)
+
+    # -- pressure ----------------------------------------------------------
+    def request(self, nbytes: int, exclude: Optional["SpillableHandle"] = None
+                ) -> None:
+        """Make room for an upcoming allocation of ``nbytes`` (the
+        DeviceMemoryEventHandler analog, invoked proactively)."""
+        if self._budget is None or not self.conf.get(SPILL_ENABLED):
+            return
+        # victims are picked under the catalog lock but spilled OUTSIDE it:
+        # each spill takes the handle's own lock, and materialize() takes
+        # handle-then-catalog — never holding one while acquiring the other
+        # in the opposite order avoids a lock-order inversion
+        with self._lock:
+            need = self._device_bytes + nbytes - self._budget
+            victims = sorted(
+                (h for h in self._buffers.values()
+                 if h.tier == TIER_DEVICE and not h.pinned
+                 and h is not exclude),
+                key=lambda h: h.priority,
+            ) if need > 0 else []
+        for h in victims:
+            if need <= 0:
+                break
+            freed = h.spill_to_host()
+            if freed:
+                with self._lock:
+                    self._device_bytes -= freed
+                    self._host_bytes += freed
+                    self.metrics.device_to_host += 1
+                    self.metrics.spilled_bytes += freed
+                need -= freed
+                if self.conf.get(MEMORY_DEBUG):
+                    log.info("spilled %d B to host (device=%d B)",
+                             freed, self._device_bytes)
+        # host tier over its cap: push oldest to disk
+        host_cap = self.conf.get(HOST_SPILL_STORAGE_SIZE)
+        if self._host_bytes > host_cap:
+            with self._lock:
+                hosts = sorted(
+                    (h for h in self._buffers.values()
+                     if h.tier == TIER_HOST),
+                    key=lambda h: h.priority,
+                )
+            for h in hosts:
+                if self._host_bytes <= host_cap:
+                    break
+                freed = h.spill_to_disk(self._disk_dir())
+                if freed:
+                    with self._lock:
+                        self._host_bytes -= freed
+                        self.metrics.host_to_disk += 1
+
+    def _disk_dir(self) -> str:
+        if self._spill_dir is None:
+            self._spill_dir = tempfile.mkdtemp(prefix="srtpu_spill_")
+        return self._spill_dir
+
+    @property
+    def device_bytes(self) -> int:
+        return self._device_bytes
+
+
+class SpillableHandle:
+    """One spillable buffer set: named jax arrays that can round-trip
+    DEVICE -> HOST (numpy) -> DISK (.npz) and back (reference:
+    RapidsBuffer.scala:63-140 acquire/addReference/free + the per-tier
+    RapidsBuffer implementations)."""
+
+    def __init__(self, arrays: Dict[str, "object"], priority: int = 0,
+                 catalog: Optional[BufferCatalog] = None):
+        self._catalog = catalog or BufferCatalog.get()
+        self._device: Optional[Dict[str, object]] = dict(arrays)
+        self._host: Optional[Dict[str, object]] = None
+        self._disk_path: Optional[str] = None
+        self.tier = TIER_DEVICE
+        self.priority = priority
+        self.pinned = False
+        self.size = sum(a.size * a.dtype.itemsize for a in arrays.values())
+        self._closed = False
+        self._tlock = threading.RLock()  # guards tier transitions
+        self._id = self._catalog.register(self)
+
+    # -- tier transitions (each holds the handle lock; the catalog never
+    # holds ITS lock while calling in here — see BufferCatalog.request) ----
+    def spill_to_host(self) -> int:
+        with self._tlock:
+            if self.tier != TIER_DEVICE or self._closed:
+                return 0
+            import jax
+            import numpy as np
+
+            self._host = {
+                k: np.asarray(jax.device_get(v))
+                for k, v in self._device.items()
+            }
+            self._device = None
+            self.tier = TIER_HOST
+            return self.size
+
+    def spill_to_disk(self, dirpath: str) -> int:
+        with self._tlock:
+            if self.tier != TIER_HOST or self._closed:
+                return 0
+            import numpy as np
+
+            self._disk_path = os.path.join(dirpath, f"buf{self._id}.npz")
+            np.savez(self._disk_path, **self._host)
+            self._host = None
+            self.tier = TIER_DISK
+            return self.size
+
+    def materialize(self) -> Dict[str, object]:
+        """Bring the arrays back on device (re-registering the device
+        bytes); the reference analog is SpillableColumnarBatch
+        .getColumnarBatch re-materializing from whatever tier."""
+        with self._tlock:
+            if self._closed:
+                raise ValueError("buffer already closed")
+            if self.tier == TIER_DEVICE:
+                return self._device
+            import jax.numpy as jnp
+            import numpy as np
+
+            from_disk = self.tier == TIER_DISK
+            if from_disk:
+                with np.load(self._disk_path) as z:
+                    self._host = {k: z[k] for k in z.files}
+                os.unlink(self._disk_path)
+                self._disk_path = None
+            dev = {k: jnp.asarray(v) for k, v in self._host.items()}
+            self._device = dev
+            self._host = None
+            self.tier = TIER_DEVICE
+        self._catalog.on_unspill(self, from_host=not from_disk)
+        return dev
+
+    # -- lifecycle (Arm idiom: with_resource(SpillableHandle(...))) --------
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._catalog.unregister(self._id)
+        self._device = None
+        self._host = None
+        if self._disk_path and os.path.exists(self._disk_path):
+            os.unlink(self._disk_path)

@@ -1,0 +1,66 @@
+"""TPU concurrency semaphore.
+
+Reference analog: GpuSemaphore.scala:27-106 — caps how many tasks hold the
+device at once (spark.rapids.sql.concurrentGpuTasks); acquired before the
+first device allocation of a task, re-entrant per task, released at I/O
+waits and task end. Here "task" = thread: each driver/executor thread
+executing partitions acquires once; nested execs piggyback on the
+thread-local count."""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..conf import CONCURRENT_TPU_TASKS, RapidsConf
+
+
+class TpuSemaphore:
+    _instance: Optional["TpuSemaphore"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, permits: int):
+        self.permits = permits
+        self._sem = threading.BoundedSemaphore(permits)
+        self._local = threading.local()
+
+    @classmethod
+    def initialize(cls, conf: Optional[RapidsConf] = None) -> "TpuSemaphore":
+        with cls._lock:
+            if cls._instance is None:
+                c = conf or RapidsConf({})
+                cls._instance = TpuSemaphore(c.get(CONCURRENT_TPU_TASKS))
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> "TpuSemaphore":
+        return cls.initialize()
+
+    @classmethod
+    def reset(cls, conf: Optional[RapidsConf] = None) -> "TpuSemaphore":
+        with cls._lock:
+            cls._instance = None
+        return cls.initialize(conf)
+
+    # -- reference API: acquireIfNecessary / releaseIfNecessary ------------
+    def acquire_if_necessary(self) -> None:
+        depth = getattr(self._local, "depth", 0)
+        if depth == 0:
+            self._sem.acquire()
+        self._local.depth = depth + 1
+
+    def release_if_necessary(self) -> None:
+        depth = getattr(self._local, "depth", 0)
+        if depth <= 0:
+            return
+        depth -= 1
+        self._local.depth = depth
+        if depth == 0:
+            self._sem.release()
+
+    def __enter__(self):
+        self.acquire_if_necessary()
+        return self
+
+    def __exit__(self, *exc):
+        self.release_if_necessary()
+        return False

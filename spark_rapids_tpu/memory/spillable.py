@@ -1,0 +1,108 @@
+"""SpillableColumnarBatch: a batch handle that survives device pressure.
+
+Reference analog: SpillableColumnarBatch.scala:28-118 — wraps a batch in a
+catalog-registered buffer; `get_batch()` re-materializes from whatever tier
+it currently lives on. Used for join build sides, broadcast batches, and
+cached shuffle pieces (the reference registers the same three)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..columnar import ColumnarBatch, DeviceColumn
+from .catalog import ACTIVE_BATCHING_PRIORITY, BufferCatalog, SpillableHandle
+
+
+class SpillableVals:
+    """Spillable handle over a raw Val list (ColV/StrV) — the working-set
+    form used by shuffle pieces and join build sides, where no schema is
+    attached yet."""
+
+    def __init__(self, vals, priority: int = ACTIVE_BATCHING_PRIORITY,
+                 catalog: Optional[BufferCatalog] = None):
+        from ..expr.values import StrV
+
+        arrays = {}
+        self._layout: List[str] = []
+        for i, v in enumerate(vals):
+            if isinstance(v, StrV):
+                arrays[f"c{i}_offsets"] = v.offsets
+                arrays[f"c{i}_chars"] = v.chars
+                arrays[f"c{i}_validity"] = v.validity
+                self._layout.append("s")
+            else:
+                arrays[f"c{i}_data"] = v.data
+                arrays[f"c{i}_validity"] = v.validity
+                self._layout.append("f")
+        self._handle = SpillableHandle(arrays, priority, catalog)
+
+    @property
+    def size_bytes(self) -> int:
+        return self._handle.size
+
+    @property
+    def tier(self) -> int:
+        return self._handle.tier
+
+    def get_vals(self):
+        from ..expr.values import ColV, StrV
+
+        arrs = self._handle.materialize()
+        out = []
+        for i, kind in enumerate(self._layout):
+            if kind == "s":
+                out.append(StrV(arrs[f"c{i}_offsets"], arrs[f"c{i}_chars"],
+                                arrs[f"c{i}_validity"]))
+            else:
+                out.append(ColV(arrs[f"c{i}_data"], arrs[f"c{i}_validity"]))
+        return out
+
+    def close(self) -> None:
+        self._handle.close()
+
+
+class SpillableColumnarBatch:
+    def __init__(self, batch: ColumnarBatch,
+                 priority: int = ACTIVE_BATCHING_PRIORITY,
+                 catalog: Optional[BufferCatalog] = None):
+        self.schema = batch.schema
+        self.num_rows = batch.num_rows
+        arrays = {}
+        self._layout: List[str] = []
+        for i, c in enumerate(batch.columns):
+            if c.is_string:
+                arrays[f"c{i}_offsets"] = c.offsets
+                arrays[f"c{i}_chars"] = c.chars
+                arrays[f"c{i}_validity"] = c.validity
+                self._layout.append("s")
+            else:
+                arrays[f"c{i}_data"] = c.data
+                arrays[f"c{i}_validity"] = c.validity
+                self._layout.append("f")
+        self._handle = SpillableHandle(arrays, priority, catalog)
+
+    @property
+    def size_bytes(self) -> int:
+        return self._handle.size
+
+    def get_batch(self) -> ColumnarBatch:
+        arrs = self._handle.materialize()
+        cols = []
+        for i, (kind, f) in enumerate(zip(self._layout, self.schema.fields)):
+            if kind == "s":
+                cols.append(DeviceColumn(
+                    f.dataType, self.num_rows, None,
+                    arrs[f"c{i}_validity"],
+                    offsets=arrs[f"c{i}_offsets"],
+                    chars=arrs[f"c{i}_chars"]))
+            else:
+                cols.append(DeviceColumn(
+                    f.dataType, self.num_rows,
+                    arrs[f"c{i}_data"], arrs[f"c{i}_validity"]))
+        return ColumnarBatch(cols, self.schema, self.num_rows)
+
+    @property
+    def tier(self) -> int:
+        return self._handle.tier
+
+    def close(self) -> None:
+        self._handle.close()

@@ -496,31 +496,46 @@ def groupby_agg(
         return sort_groupby(
             key_cols, key_dtypes, value_cols, agg_ops, num_rows, str_max_lens)
     cap = key_cols[0].validity.shape[0]
-    B = min(cap, num_buckets)
-    if B & (B - 1):  # non-power-of-two capacity: round down
-        B = 1 << (B.bit_length() - 1)
 
-    hk, ha, hn, ok = hash_groupby(
-        list(key_cols), key_dtypes, value_cols, agg_ops, num_rows, B,
-        approx_float_sum=approx_float_sum)
+    def pow2_floor(x: int) -> int:
+        return 1 << (x.bit_length() - 1) if x & (x - 1) else x
 
-    def use_hash(_):
+    B2 = pow2_floor(min(cap, num_buckets))
+    # the one-hot matmul reduction costs O(cap * B): run a small-B tier
+    # first (TPC-DS aggregates rarely exceed ~1K groups) and escalate to
+    # the wide tier — then the bitonic sort — only on collisions. lax.cond
+    # executes just the taken branch, so the common case never pays B2.
+    B1 = min(1024, B2)
+
+    def pack(keys, aggs, n):
         return (
-            tuple((c.data, c.validity) for c in hk),
-            tuple((c.data, c.validity) for c in ha),
-            hn,
+            tuple((c.data, c.validity) for c in keys),
+            tuple((c.data, c.validity) for c in aggs),
+            n,
         )
 
     def use_sort(_):
-        sk, sa, sn = sort_groupby(
-            key_cols, key_dtypes, value_cols, agg_ops, num_rows, str_max_lens)
-        return (
-            tuple((c.data, c.validity) for c in sk),
-            tuple((c.data, c.validity) for c in sa),
-            sn,
-        )
+        return pack(*sort_groupby(
+            key_cols, key_dtypes, value_cols, agg_ops, num_rows,
+            str_max_lens))
 
-    keys_t, aggs_t, n = lax.cond(ok, use_hash, use_sort, operand=None)
+    def tier(B, below):
+        def run(_):
+            hk, ha, hn, ok = hash_groupby(
+                list(key_cols), key_dtypes, value_cols, agg_ops, num_rows,
+                B, approx_float_sum=approx_float_sum)
+
+            def use_hash(_):
+                return pack(hk, ha, hn)
+
+            return lax.cond(ok, use_hash, below, operand=None)
+
+        return run
+
+    chain = use_sort
+    if B2 > B1:
+        chain = tier(B2, chain)
+    keys_t, aggs_t, n = tier(B1, chain)(None)
     out_keys = [ColV(d, v) for d, v in keys_t]
     out_aggs = [ColV(d, v) for d, v in aggs_t]
     return out_keys, out_aggs, n

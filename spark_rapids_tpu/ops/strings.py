@@ -36,6 +36,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..expr.values import StrV as Str  # (offsets, chars, validity)
+from .filter_gather import (  # noqa: F401  (re-exports)
+    piecewise_by_row,
+    rows_of_positions,
+)
 
 BIG = np.int32(2**31 - 1)
 
@@ -49,10 +53,11 @@ def byte_lens(offsets: jax.Array) -> jax.Array:
 
 def row_ids(offsets: jax.Array, nbytes: int) -> jax.Array:
     """Row id per byte position of the chars buffer (padding bytes clamp to
-    the last row; callers mask with ``in_data``)."""
-    cap = offsets.shape[0] - 1
-    pos = jnp.arange(nbytes, dtype=jnp.int32)
-    return jnp.clip(jnp.searchsorted(offsets, pos, side="right") - 1, 0, cap - 1)
+    the last row; callers mask with ``in_data``). One scatter + cumsum
+    (see filter_gather.rows_of_positions for why not searchsorted)."""
+    from .filter_gather import rows_of_positions
+
+    return rows_of_positions(offsets, nbytes)
 
 
 def char_starts(chars: jax.Array, total: jax.Array) -> jax.Array:
@@ -87,25 +92,52 @@ def char_positions(chars: jax.Array, total: jax.Array) -> jax.Array:
     )
 
 
+def all_ascii(chars: jax.Array, total) -> jax.Array:
+    """True when no byte in [0, total) has the high bit set. Gates the
+    lax.cond ASCII fast paths: char==byte turns the UTF-8 cumsum/scatter
+    machinery into pure arithmetic, and XLA executes only the taken
+    branch."""
+    n = chars.shape[0]
+    hi = (chars >= 0x80) & (jnp.arange(n, dtype=jnp.int32) < total)
+    return ~jnp.any(hi)
+
+
 def char_counts(s: Str) -> jax.Array:
     """Per-row character counts (Spark length())."""
     total = s.offsets[-1]
-    cp = char_prefix(s.chars, total)
-    return cp[s.offsets[1:]] - cp[s.offsets[:-1]]
+    lens = byte_lens(s.offsets)
+
+    def fast(_):
+        return lens
+
+    def full(_):
+        cp = char_prefix(s.chars, total)
+        return cp[s.offsets[1:]] - cp[s.offsets[:-1]]
+
+    return jax.lax.cond(all_ascii(s.chars, total), fast, full, operand=None)
 
 
 def char_to_byte(s: Str, char_idx: jax.Array) -> jax.Array:
     """Per-row: byte position of character ``char_idx`` (0-based within the
     row), clamped to the row end for out-of-range ordinals."""
     total = s.offsets[-1]
-    cp = char_prefix(s.chars, total)
-    pos = char_positions(s.chars, total)
-    nchars = cp[s.offsets[1:]] - cp[s.offsets[:-1]]
-    first = cp[s.offsets[:-1]]
-    k = jnp.clip(char_idx, 0, nchars)
-    n = s.chars.shape[0]
-    raw = pos[jnp.clip(first + k, 0, n - 1)]
-    return jnp.where(k >= nchars, s.offsets[1:], raw).astype(jnp.int32)
+    lens = byte_lens(s.offsets)
+
+    def fast(_):
+        k = jnp.clip(char_idx, 0, lens)
+        return (s.offsets[:-1] + k).astype(jnp.int32)
+
+    def full(_):
+        cp = char_prefix(s.chars, total)
+        pos = char_positions(s.chars, total)
+        nchars = cp[s.offsets[1:]] - cp[s.offsets[:-1]]
+        first = cp[s.offsets[:-1]]
+        k = jnp.clip(char_idx, 0, nchars)
+        n = s.chars.shape[0]
+        raw = pos[jnp.clip(first + k, 0, n - 1)]
+        return jnp.where(k >= nchars, s.offsets[1:], raw).astype(jnp.int32)
+
+    return jax.lax.cond(all_ascii(s.chars, total), fast, full, operand=None)
 
 
 # ---------------------------------------------------------------------------
@@ -197,11 +229,10 @@ def has_border(pat: bytes) -> bool:
 # ragged output builders
 # ---------------------------------------------------------------------------
 def _out_rows(new_offsets: jax.Array, out_cap: int) -> Tuple[jax.Array, jax.Array]:
-    cap = new_offsets.shape[0] - 1
+    from .filter_gather import rows_of_positions
+
     pos = jnp.arange(out_cap, dtype=jnp.int32)
-    rid = jnp.clip(
-        jnp.searchsorted(new_offsets, pos, side="right") - 1, 0, cap - 1
-    )
+    rid = rows_of_positions(new_offsets, out_cap)
     return rid, pos - new_offsets[rid]
 
 
@@ -215,13 +246,18 @@ def take_slices(s: Str, start_bytes: jax.Array, new_lens: jax.Array,
                 out_cap: int) -> Tuple[jax.Array, jax.Array]:
     """Build (new_offsets, out_chars) where each output row is the
     contiguous byte slice [start_bytes, start_bytes + new_lens) of the
-    source buffer. Serves substring / trim / substring_index / split-part."""
+    source buffer. Serves substring / trim / substring_index / split-part.
+
+    src[pos] = start_bytes[row] + (pos - new_offsets[row]) — the bracketed
+    delta is piecewise-constant per row, so it expands with one
+    scatter+cumsum instead of a row-id gather."""
     new_offsets = offsets_of_lens(new_lens)
-    rid, within = _out_rows(new_offsets, out_cap)
-    src = jnp.clip(start_bytes[rid] + within, 0, s.chars.shape[0] - 1)
+    delta = start_bytes.astype(jnp.int32) - new_offsets[:-1]
+    pos = jnp.arange(out_cap, dtype=jnp.int32)
+    src = pos + piecewise_by_row(delta, new_offsets, out_cap)
+    src = jnp.clip(src, 0, s.chars.shape[0] - 1)
     out = jnp.where(
-        jnp.arange(out_cap, dtype=jnp.int32) < new_offsets[-1],
-        s.chars[src], jnp.uint8(0),
+        pos < new_offsets[-1], s.chars[src], jnp.uint8(0),
     )
     return new_offsets, out
 
@@ -265,25 +301,43 @@ def _case_luts(upper: bool) -> np.ndarray:
     return lut
 
 
+def _ascii_case(chars: jax.Array, upper: bool) -> jax.Array:
+    """Pure-arithmetic ASCII case map (no table gathers)."""
+    lo, hi = (ord("a"), ord("z")) if upper else (ord("A"), ord("Z"))
+    delta = jnp.uint8(32)
+    in_rng = (chars >= lo) & (chars <= hi)
+    return jnp.where(in_rng, chars - delta if upper else chars + delta, chars)
+
+
 def map_case(chars: jax.Array, total: jax.Array, upper: bool) -> jax.Array:
     """Byte-length-preserving simple case mapping. ASCII and 2-byte
-    sequences below U+0250 are mapped; everything else passes through."""
-    lut = jnp.asarray(_case_luts(upper))
+    sequences below U+0250 are mapped; everything else passes through.
+    All-ASCII buffers (checked at runtime, lax.cond) take a gather-free
+    arithmetic path."""
     n = chars.shape[0]
-    is_ascii = chars < 0x80
-    is2 = (chars & 0xE0) == 0xC0
-    nxt = jnp.concatenate([chars[1:], jnp.zeros(1, jnp.uint8)])
-    prv = jnp.concatenate([jnp.zeros(1, jnp.uint8), chars[:-1]])
-    cp2 = ((chars & 0x1F).astype(jnp.int32) << 6) | (nxt & 0x3F).astype(jnp.int32)
-    mapped2 = lut[jnp.clip(cp2, 0, 0x24F)]
-    in_range2 = is2 & (cp2 < 0x250)
-    # continuation byte of a mapped 2-byte char: recompute from prev
-    prev_cp2 = ((prv & 0x1F).astype(jnp.int32) << 6) | (chars & 0x3F).astype(jnp.int32)
-    prev_is2 = (prv & 0xE0) == 0xC0
-    prev_mapped = lut[jnp.clip(prev_cp2, 0, 0x24F)]
-    prev_in = prev_is2 & (prev_cp2 < 0x250) & ((chars & 0xC0) == 0x80)
-    out = chars
-    out = jnp.where(is_ascii, lut[jnp.clip(chars.astype(jnp.int32), 0, 0x7F)].astype(jnp.uint8), out)
-    out = jnp.where(in_range2, (0xC0 | (mapped2 >> 6)).astype(jnp.uint8), out)
-    out = jnp.where(prev_in, (0x80 | (prev_mapped & 0x3F)).astype(jnp.uint8), out)
-    return jnp.where(jnp.arange(n, dtype=jnp.int32) < total, out, chars)
+
+    def fast(_):
+        mapped = _ascii_case(chars, upper)
+        return jnp.where(jnp.arange(n, dtype=jnp.int32) < total, mapped, chars)
+
+    def full(_):
+        lut = jnp.asarray(_case_luts(upper))
+        is_ascii = chars < 0x80
+        is2 = (chars & 0xE0) == 0xC0
+        nxt = jnp.concatenate([chars[1:], jnp.zeros(1, jnp.uint8)])
+        prv = jnp.concatenate([jnp.zeros(1, jnp.uint8), chars[:-1]])
+        cp2 = ((chars & 0x1F).astype(jnp.int32) << 6) | (nxt & 0x3F).astype(jnp.int32)
+        mapped2 = lut[jnp.clip(cp2, 0, 0x24F)]
+        in_range2 = is2 & (cp2 < 0x250)
+        # continuation byte of a mapped 2-byte char: recompute from prev
+        prev_cp2 = ((prv & 0x1F).astype(jnp.int32) << 6) | (chars & 0x3F).astype(jnp.int32)
+        prev_is2 = (prv & 0xE0) == 0xC0
+        prev_mapped = lut[jnp.clip(prev_cp2, 0, 0x24F)]
+        prev_in = prev_is2 & (prev_cp2 < 0x250) & ((chars & 0xC0) == 0x80)
+        out = _ascii_case(chars, upper)
+        out = jnp.where(~is_ascii, chars, out)
+        out = jnp.where(in_range2, (0xC0 | (mapped2 >> 6)).astype(jnp.uint8), out)
+        out = jnp.where(prev_in, (0x80 | (prev_mapped & 0x3F)).astype(jnp.uint8), out)
+        return jnp.where(jnp.arange(n, dtype=jnp.int32) < total, out, chars)
+
+    return jax.lax.cond(all_ascii(chars, total), fast, full, operand=None)

@@ -57,9 +57,9 @@ def all_to_all_exchange(
     # 2) scatter the per-target runs into (n_shards * B,) send blocks
     idx = jnp.arange(cap, dtype=jnp.int32)
     live_sorted = idx < offsets[n_shards]
-    tgt = jnp.clip(
-        jnp.searchsorted(offsets[1:], idx, side="right"), 0, n_shards - 1
-    ).astype(jnp.int32)
+    from ..ops.filter_gather import rows_of_positions
+
+    tgt = rows_of_positions(offsets, cap)
     slot = idx - jnp.take(offsets, tgt)
     dest = jnp.where(
         live_sorted & (slot < B), tgt * B + slot, jnp.int32(n_shards * B)

@@ -54,17 +54,24 @@ class ShuffleTransport:
 
 
 class DeviceShuffleTransport(ShuffleTransport):
-    """Pieces stay device-resident (the UCX device-cache path analog:
-    RapidsCachingWriter stores sliced batches in the device store)."""
+    """Pieces stay device-resident but REGISTERED with the buffer catalog
+    (the UCX device-cache path analog: RapidsCachingWriter stores sliced
+    batches in the device store + ShuffleBufferCatalog registers them for
+    spill, RapidsShuffleInternalManager.scala:90-150). Under memory
+    pressure a piece spills to host/disk and re-materializes at fetch."""
 
     def __init__(self):
-        self._catalog: Dict[Tuple[int, int], List[Tuple[int, ShufflePiece]]] = {}
+        self._catalog: Dict[Tuple[int, int], List[Tuple[int, object]]] = {}
         self._lock = threading.Lock()
 
     def write(self, shuffle_id, map_id, reduce_id, piece, schema):
+        from ..memory import INPUT_FROM_SHUFFLE_PRIORITY, SpillableVals
+
+        sv = SpillableVals(piece.vals, INPUT_FROM_SHUFFLE_PRIORITY)
+        entry = (sv, piece.n, piece.byte_lens)
         with self._lock:
             self._catalog.setdefault((shuffle_id, reduce_id), []).append(
-                (map_id, piece))
+                (map_id, entry))
 
     def fetch(self, shuffle_id, reduce_id):
         with self._lock:
@@ -72,12 +79,17 @@ class DeviceShuffleTransport(ShuffleTransport):
                 self._catalog.get((shuffle_id, reduce_id), ()),
                 key=lambda e: e[0],
             )
-        return [p for _, p in entries]
+        return [
+            ShufflePiece(sv.get_vals(), n, bl)
+            for _, (sv, n, bl) in entries
+        ]
 
     def release(self, shuffle_id):
         with self._lock:
-            for k in [k for k in self._catalog if k[0] == shuffle_id]:
-                del self._catalog[k]
+            victims = [k for k in self._catalog if k[0] == shuffle_id]
+            entries = [e for k in victims for e in self._catalog.pop(k)]
+        for _, (sv, _n, _bl) in entries:
+            sv.close()
 
 
 class SerializedShuffleTransport(ShuffleTransport):
