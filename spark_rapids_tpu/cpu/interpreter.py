@@ -854,6 +854,13 @@ def eval_row(expr: E.Expression, row: Sequence[Any]) -> Any:
             return None
         return d.toordinal() - _EPOCH_ORD
 
+    if isinstance(expr, E.PythonUDF):
+        # row-by-row python execution — the fallback path for UDFs the
+        # bytecode compiler can't lower (reference: ScalaUDF staying on the
+        # JVM / the python-worker path)
+        vals = [ev(c) for c in expr.children_]
+        return expr.func(*vals)
+
     raise NotImplementedError(f"cpu interpreter: {type(expr).__name__}")
 
 

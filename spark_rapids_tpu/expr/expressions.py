@@ -1039,6 +1039,37 @@ class TruncDate(Expression):
 
 
 # ---------------------------------------------------------------------------
+# UDF (reference: GpuScalaUDF / the udf-compiler's ScalaUDF rewriting)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class PythonUDF(Expression):
+    """A user Python function over scalar args. The planner's resolution
+    pass (sql/session.py) replaces it with bytecode-compiled engine
+    expressions when spark.rapids.tpu.sql.udfCompiler.enabled; otherwise it
+    evaluates row-by-row in the CPU interpreter (fallback)."""
+
+    func: Any
+    children_: Tuple[Expression, ...]
+    return_type: Optional[DataType] = None
+
+    @property
+    def dtype(self):
+        if self.return_type is not None:
+            return self.return_type
+        # infer from a best-effort: assume numeric double unless annotated
+        import typing
+
+        hints = typing.get_type_hints(self.func) if callable(self.func) else {}
+        r = hints.get("return")
+        m = {int: T.LONG, float: T.DOUBLE, bool: T.BOOLEAN, str: T.STRING}
+        return m.get(r, T.DOUBLE)
+
+    @property
+    def pretty_name(self):
+        return f"pythonUDF({getattr(self.func, '__name__', '?')})"
+
+
+# ---------------------------------------------------------------------------
 # Binding / resolution
 # ---------------------------------------------------------------------------
 def bind_references(expr: Expression, schema: T.StructType) -> Expression:

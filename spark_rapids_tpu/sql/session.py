@@ -101,11 +101,35 @@ def _extract_pushed_filters(cond: E.Expression) -> tuple:
     return tuple(out)
 
 
+def _resolve_udfs(e: E.Expression, conf: RapidsConf) -> E.Expression:
+    """Resolution pass: PythonUDF -> bytecode-compiled expression tree when
+    spark.rapids.tpu.sql.udfCompiler.enabled (reference: the udf-compiler's
+    injectResolutionRule rewriting ScalaUDF bodies, Plugin.scala:31-64).
+    Uncompilable UDFs stay as PythonUDF nodes and run row-by-row on CPU."""
+    from ..conf import UDF_COMPILER_ENABLED
+
+    if not conf.get(UDF_COMPILER_ENABLED):
+        return e
+
+    def rw(node):
+        if isinstance(node, E.PythonUDF):
+            from ..udf import try_compile
+
+            compiled = try_compile(node)
+            if compiled is not None:
+                return compiled
+        return node
+
+    return e.transform(rw)
+
+
 def _lower(node: LNode, conf: RapidsConf) -> C.CpuExec:
     k = node.kind
+    rx = lambda ex: _resolve_udfs(ex, conf)  # noqa: E731
     if k == "filter" and node.children[0].kind == "file_scan":
         # push col-vs-literal conjuncts into the scan for row-group pruning
         (cond,) = node.args
+        cond = rx(cond)
         fmt, path, opts = node.children[0].args
         pushed = _extract_pushed_filters(cond) if fmt == "parquet" else ()
         sc = _make_scanner(fmt, path, opts, conf, pushed)
@@ -128,16 +152,18 @@ def _lower(node: LNode, conf: RapidsConf) -> C.CpuExec:
         return C.CpuRangeExec(conf, start, end, step, slices, name)
     if k == "project":
         (exprs,) = node.args
-        return C.CpuProjectExec(conf, list(exprs), kids[0])
+        return C.CpuProjectExec(conf, [rx(e) for e in exprs], kids[0])
     if k == "filter":
         (cond,) = node.args
-        return C.CpuFilterExec(conf, cond, kids[0])
+        return C.CpuFilterExec(conf, rx(cond), kids[0])
     if k == "aggregate":
         keys, aggs = node.args
-        return C.CpuHashAggregateExec(conf, list(keys), list(aggs), kids[0])
+        return C.CpuHashAggregateExec(
+            conf, [rx(e) for e in keys], [rx(a) for a in aggs], kids[0])
     if k == "sort":
         exprs, orders = node.args
-        return C.CpuSortExec(conf, list(exprs), list(orders), kids[0])
+        return C.CpuSortExec(
+            conf, [rx(e) for e in exprs], list(orders), kids[0])
     if k == "limit":
         (n,) = node.args
         return C.CpuLocalLimitExec(conf, n, kids[0])

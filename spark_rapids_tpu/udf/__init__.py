@@ -1,0 +1,37 @@
+"""UDF layer (SURVEY.md L8 / §2.9).
+
+Reference analog: the udf-compiler plugin rewriting ScalaUDF bodies into
+Catalyst trees at resolution time (udf-compiler/.../Plugin.scala:31-64),
+with uncompilable UDFs running row-by-row (the reference leaves them on the
+JVM; here the CPU interpreter calls the Python function — the pandas-UDF
+worker analog). `tpu_udf(fn)` is the native-UDF interface analog
+(RapidsUDF.java:22): the user supplies a function the engine understands.
+"""
+from typing import Callable, Optional
+
+from .. import types as T
+from ..expr import expressions as E
+from .compiler import compile_udf
+
+
+def udf(fn: Callable, return_type: Optional[T.DataType] = None):
+    """Wrap a Python function as a SQL UDF: ``udf(f)(col("a"), lit(2))``.
+
+    With spark.rapids.tpu.sql.udfCompiler.enabled the planner compiles the
+    bytecode into the engine's expression tree (fusing with the whole
+    projection); otherwise the PythonUDF node evaluates row-by-row on CPU.
+    """
+
+    def apply(*args: E.Expression) -> E.Expression:
+        return E.PythonUDF(fn, tuple(args), return_type)
+
+    apply.fn = fn
+    return apply
+
+
+def try_compile(node: "E.PythonUDF") -> Optional[E.Expression]:
+    """PythonUDF -> engine expression tree, or None when not compilable."""
+    return compile_udf(node.func, node.children_)
+
+
+__all__ = ["udf", "try_compile", "compile_udf"]
