@@ -116,6 +116,11 @@ UDF_COMPILER_ENABLED = conf(
     "spark.rapids.tpu.sql.udfCompiler.enabled", False,
     "Compile Python scalar UDF bytecode into engine expression trees "
     "(analog of the reference's JVM-bytecode udf-compiler).")
+AUTO_BROADCAST_JOIN_THRESHOLD = conf(
+    "spark.rapids.tpu.sql.autoBroadcastJoinThreshold", 10 * 1024 * 1024,
+    "Build sides estimated below this size broadcast instead of paying two "
+    "hash exchanges (Spark's spark.sql.autoBroadcastJoinThreshold role). "
+    "-1 disables.", conf_type=int)
 REPLACE_SORT_MERGE_JOIN = conf(
     "spark.rapids.tpu.sql.replaceSortMergeJoin.enabled", True,
     "Replace sort-merge joins with TPU hash joins (reference: RapidsConf.scala:476).")

@@ -37,6 +37,16 @@ class CpuExec:
     def execute_rows_partition(self, index: int) -> Iterator[tuple]:
         raise NotImplementedError(type(self).__name__)
 
+    def estimated_size_bytes(self):
+        """Best-effort plan-size estimate for broadcast-join selection
+        (reference: Spark statistics feeding autoBroadcastJoinThreshold).
+        None = unknown; row-preserving subclasses override with the child
+        pass-through below."""
+        return None
+
+    def _child_size_estimate(self):
+        return self.children[0].estimated_size_bytes()
+
     def execute_rows(self) -> Iterator[tuple]:
         for p in range(self.num_partitions):
             yield from self.execute_rows_partition(p)
@@ -89,6 +99,11 @@ class CpuScanExec(CpuExec):
     def execute_rows_partition(self, index: int) -> Iterator[tuple]:
         yield from self._partitions[index]
 
+    def estimated_size_bytes(self):
+        nrows = sum(len(p) for p in self._partitions)
+        ncols = max(1, len(self._schema.fields))
+        return nrows * ncols * 16  # rough fixed-width guess
+
 
 class CpuFileScanExec(CpuExec):
     """Row-based file scan — fallback path AND differential oracle for the
@@ -111,6 +126,14 @@ class CpuFileScanExec(CpuExec):
 
     def describe(self):
         return f"CpuFileScanExec({self.fmt})"
+
+    def estimated_size_bytes(self):
+        import os
+
+        try:
+            return sum(os.path.getsize(f) for f, _ in self.scanner.files)
+        except OSError:
+            return None
 
     def execute_rows_partition(self, index: int) -> Iterator[tuple]:
         from ..io.arrow_convert import _np_from_arrow_array
@@ -197,6 +220,9 @@ class CpuProjectExec(CpuExec):
     def describe(self):
         return f"CpuProjectExec [{', '.join(map(str, self.exprs))}]"
 
+    def estimated_size_bytes(self):
+        return self._child_size_estimate()
+
     def execute_rows_partition(self, index: int) -> Iterator[tuple]:
         for row in self.children[0].execute_rows_partition(index):
             yield tuple(eval_row(b, row) for b in self._bound)
@@ -214,6 +240,9 @@ class CpuFilterExec(CpuExec):
 
     def describe(self):
         return f"CpuFilterExec [{self.condition}]"
+
+    def estimated_size_bytes(self):
+        return self._child_size_estimate()
 
     def execute_rows_partition(self, index: int) -> Iterator[tuple]:
         for row in self.children[0].execute_rows_partition(index):
@@ -251,6 +280,9 @@ class CpuLocalLimitExec(CpuExec):
     def output_schema(self):
         return self.children[0].output_schema
 
+    def estimated_size_bytes(self):
+        return self._child_size_estimate()
+
     def execute_rows_partition(self, index: int) -> Iterator[tuple]:
         n = 0
         for row in self.children[0].execute_rows_partition(index):
@@ -258,6 +290,35 @@ class CpuLocalLimitExec(CpuExec):
                 return
             n += 1
             yield row
+
+
+class CpuCollectLimitExec(CpuExec):
+    """Global limit: gather partitions in order until ``limit`` rows
+    (reference: CollectLimitExec / GpuCollectLimitMeta limit.scala:126)."""
+
+    def __init__(self, conf: RapidsConf, limit: int, child: CpuExec):
+        super().__init__(conf, [child])
+        self.limit = limit
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    @property
+    def num_partitions(self):
+        return 1
+
+    def estimated_size_bytes(self):
+        return self._child_size_estimate()
+
+    def execute_rows_partition(self, index: int) -> Iterator[tuple]:
+        n = 0
+        for p in range(self.children[0].num_partitions):
+            for row in self.children[0].execute_rows_partition(p):
+                if n >= self.limit:
+                    return
+                n += 1
+                yield row
 
 
 class CpuExpandExec(CpuExec):
@@ -282,6 +343,31 @@ class CpuExpandExec(CpuExec):
         for row in self.children[0].execute_rows_partition(index):
             for bound in self._bound:
                 yield tuple(eval_row(b, row) for b in bound)
+
+
+class CpuGenerateExec(CpuExpandExec):
+    """explode(array(e1..eN)) over per-row expression lists — one output
+    row per generator element (reference: GpuGenerateExec; with fixed-size
+    generators the kernel is exactly the Expand pair-expansion, which is
+    how the TPU side lowers it too)."""
+
+    def __init__(self, conf: RapidsConf, generators, col_name: str,
+                 with_pos: bool, child: CpuExec):
+        self.generators = list(generators)
+        self.col_name = col_name
+        self.with_pos = with_pos
+        child_cols = [E.col(f.name) for f in child.output_schema.fields]
+        projections = [
+            child_cols
+            + ([E.Literal(i, T.INT)] if with_pos else [])
+            + [g]
+            for i, g in enumerate(self.generators)
+        ]
+        names = [f.name for f in child.output_schema.fields]
+        if with_pos:
+            names.append("pos")
+        names.append(col_name)
+        super().__init__(conf, projections, names, child)
 
 
 # ---------------------------------------------------------------------------
@@ -711,6 +797,10 @@ class CpuWindowExec(CpuExec):
                 yield row + tuple(extra)
 
     def _frame_rows(self, part, okeys, i, whole, range_frame):
+        frame = self.spec.resolved_frame()
+        if not whole and not frame.is_running and frame.is_bounded_rows:
+            lo, hi = frame.row_bounds()
+            return range(max(i + lo, 0), min(i + hi, len(part) - 1) + 1)
         if whole:
             return range(len(part))
         if range_frame:

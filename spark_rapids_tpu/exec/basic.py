@@ -253,6 +253,8 @@ class TpuLocalLimitExec(TpuExec):
             if batch.num_rows <= remaining:
                 remaining -= batch.num_rows
                 yield self.record_batch(batch)
+                if remaining == 0:
+                    return  # don't pull (compute) another child batch
             else:
                 vals, count = filter_gather.slice_cols(
                     vals_of_batch(batch), 0, bucket_rows(remaining, self.conf.shape_bucket_min),
@@ -262,6 +264,47 @@ class TpuLocalLimitExec(TpuExec):
                 remaining = 0
                 yield self.record_batch(out)
                 return
+
+
+class TpuCollectLimitExec(TpuExec):
+    """Global limit: one output partition draining children in order until
+    ``limit`` rows (reference: GpuCollectLimitMeta limit.scala:126)."""
+
+    def __init__(self, conf: RapidsConf, limit: int, child: TpuExec):
+        super().__init__(conf, [child])
+        self.limit = limit
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    @property
+    def num_partitions(self):
+        return 1
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        remaining = self.limit
+        child = self.children[0]
+        for p in range(child.num_partitions):
+            for batch in child.execute_partition(p):
+                if remaining <= 0:
+                    return
+                n = batch.num_rows
+                if n <= remaining:
+                    remaining -= n
+                    yield self.record_batch(batch)
+                    if remaining == 0:
+                        return  # don't pull (compute) another child batch
+                else:
+                    vals, count = filter_gather.slice_cols(
+                        vals_of_batch(batch), 0,
+                        bucket_rows(remaining, self.conf.shape_bucket_min),
+                        jnp.int32(remaining),
+                    )
+                    out = batch_from_vals(vals, self.output_schema, remaining)
+                    remaining = 0
+                    yield self.record_batch(out)
+                    return
 
 
 class TpuExpandExec(TpuExec):

@@ -465,7 +465,7 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
         return self.children[0].num_partitions
 
     def describe(self):
-        return "TpuBroadcastNestedLoopJoinExec"
+        return self.node_name
 
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
         if self._built is None:
@@ -515,3 +515,12 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             n = int(count)
             if n:
                 yield self.record_batch(batch_from_vals(vals, self._schema, n))
+
+
+class TpuCartesianProductExec(TpuBroadcastNestedLoopJoinExec):
+    """Unconditioned cross join (reference: GpuCartesianProductExec.scala:304
+    — the same pair-expansion kernel as the nested-loop join, no residual
+    condition)."""
+
+    def __init__(self, conf: RapidsConf, left: TpuExec, right: TpuExec):
+        super().__init__(conf, left, right, condition=None)

@@ -56,6 +56,7 @@ class TpuFileSourceScanExec(TpuExec):
         super().__init__(conf)
         self.scanner = scanner
         self.fmt = fmt
+        self._prefetch = None  # MULTITHREADED reader futures
         self.metrics[SCAN_TIME] = self.metric(SCAN_TIME)
         self.metrics[DECODE_TIME] = self.metric(DECODE_TIME)
 
@@ -70,13 +71,39 @@ class TpuFileSourceScanExec(TpuExec):
     def describe(self):
         return f"TpuFileSourceScanExec {self.fmt} {getattr(self.scanner, 'path', '')}"
 
+    def _read_split(self, index: int):
+        """Split read, optionally through the MULTITHREADED prefetcher:
+        cloud-path scans buffer EVERY split in a thread pool on first
+        touch so later partitions find their bytes already fetched
+        (reference: MultiFileCloudParquetPartitionReader
+        GpuParquetScan.scala:1299-1333)."""
+        rt = getattr(self.scanner, "reader_type", lambda: "PERFILE")()
+        if rt != "MULTITHREADED":
+            return self.scanner.read_split_i(index)
+        if self._prefetch is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            from ..conf import PARQUET_MULTITHREAD_READ_NUM_THREADS
+
+            pool = ThreadPoolExecutor(
+                max_workers=self.conf.get(PARQUET_MULTITHREAD_READ_NUM_THREADS),
+                thread_name_prefix="srtpu-scan")
+            self._prefetch = [
+                pool.submit(self.scanner.read_split_i, i)
+                for i in range(self.scanner.num_splits())
+            ]
+            pool.shutdown(wait=False)
+        fut = self._prefetch[index]
+        self._prefetch[index] = None  # free the decoded table once consumed
+        return fut.result()
+
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
         from ..io.arrow_convert import arrow_to_batch
 
         if index >= self.scanner.num_splits():
             return
         with timed(self.metrics[SCAN_TIME]):
-            table, pvals = self.scanner.read_split_i(index)
+            table, pvals = self._read_split(index)
         with timed(self.metrics[DECODE_TIME]):
             schema = self.output_schema
             # the schema only carries the partition keys common to every

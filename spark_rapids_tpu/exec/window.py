@@ -119,6 +119,8 @@ class TpuWindowExec(TpuExec):
         frame = self.spec.resolved_frame()
         range_frame = frame.frame_type == W.RANGE
         whole = frame.is_whole_partition or not self._order_keys
+        bounded = frame.is_bounded_rows and not whole and not frame.is_running
+        blo, bhi = frame.row_bounds() if bounded else (0, 0)
 
         def run(cols, num_rows):
             live = filter_gather.live_of(num_rows, cap)
@@ -167,10 +169,18 @@ class TpuWindowExec(TpuExec):
                         v, off, ps, pe, live_s, dflt))
                 elif isinstance(f, A.Average):
                     v = lower(E.Cast(f.child, T.DOUBLE), sorted_cols, cap)
-                    s = window_ops.running_agg(
-                        "sum", v, seg, ps, qe, live_s, range_frame, whole, pe)
-                    c = window_ops.running_agg(
-                        "count", v, seg, ps, qe, live_s, range_frame, whole, pe)
+                    if bounded:
+                        s = window_ops.bounded_row_agg(
+                            "sum", v, ps, pe, live_s, blo, bhi)
+                        c = window_ops.bounded_row_agg(
+                            "count", v, ps, pe, live_s, blo, bhi)
+                    else:
+                        s = window_ops.running_agg(
+                            "sum", v, seg, ps, qe, live_s, range_frame,
+                            whole, pe)
+                        c = window_ops.running_agg(
+                            "count", v, seg, ps, qe, live_s, range_frame,
+                            whole, pe)
                     data = s.data / jnp.where(c.data == 0, 1, c.data)
                     valid = s.validity & (c.data > 0)
                     out.append(ColV(jnp.where(valid, data, 0.0), valid))
@@ -186,8 +196,13 @@ class TpuWindowExec(TpuExec):
                         cast_to = f.dtype if isinstance(f, A.Sum) else None
                         e = E.Cast(f.child, cast_to) if cast_to else f.child
                         v = lower(e, sorted_cols, cap)
-                    out.append(window_ops.running_agg(
-                        op, v, seg, ps, qe, live_s, range_frame, whole, pe))
+                    if bounded:
+                        out.append(window_ops.bounded_row_agg(
+                            op, v, ps, pe, live_s, blo, bhi))
+                    else:
+                        out.append(window_ops.running_agg(
+                            op, v, seg, ps, qe, live_s, range_frame,
+                            whole, pe))
                 else:
                     raise ValueError(f"unsupported window function {f}")
             return out

@@ -1059,7 +1059,11 @@ class PythonUDF(Expression):
         # infer from a best-effort: assume numeric double unless annotated
         import typing
 
-        hints = typing.get_type_hints(self.func) if callable(self.func) else {}
+        try:
+            hints = typing.get_type_hints(self.func) if callable(
+                self.func) else {}
+        except Exception:  # unresolvable forward refs etc.
+            hints = {}
         r = hints.get("return")
         m = {int: T.LONG, float: T.DOUBLE, bool: T.BOOLEAN, str: T.STRING}
         return m.get(r, T.DOUBLE)

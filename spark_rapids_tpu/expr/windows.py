@@ -30,9 +30,15 @@ UNBOUNDED_FOLLOWING = "unbounded_following"
 
 @dataclasses.dataclass(frozen=True)
 class WindowFrame:
+    """Frame bounds. ``lower``/``upper`` are the sentinels above, or — for
+    ROWS frames — int offsets from the current row (negative = preceding,
+    e.g. ROWS BETWEEN 2 PRECEDING AND CURRENT ROW -> lower=-2, upper=0),
+    matching the reference's literal row-frame bounds requirement
+    (GpuWindowExpression.scala:451)."""
+
     frame_type: str = RANGE
-    lower: str = UNBOUNDED_PRECEDING
-    upper: str = CURRENT_ROW
+    lower: object = UNBOUNDED_PRECEDING
+    upper: object = CURRENT_ROW
 
     @property
     def is_running(self) -> bool:
@@ -46,6 +52,21 @@ class WindowFrame:
             self.lower == UNBOUNDED_PRECEDING
             and self.upper == UNBOUNDED_FOLLOWING
         )
+
+    @property
+    def is_bounded_rows(self) -> bool:
+        """Literal ROWS frame (current row = offset 0)."""
+        lo = 0 if self.lower == CURRENT_ROW else self.lower
+        hi = 0 if self.upper == CURRENT_ROW else self.upper
+        return (
+            self.frame_type == ROWS
+            and isinstance(lo, int) and isinstance(hi, int) and lo <= hi
+        )
+
+    def row_bounds(self):
+        lo = 0 if self.lower == CURRENT_ROW else self.lower
+        hi = 0 if self.upper == CURRENT_ROW else self.upper
+        return int(lo), int(hi)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -252,9 +252,15 @@ def batch_to_arrow(batch: ColumnarBatch):
                 h.data[:n].astype(np.int64), type=pa.int64(),
                 mask=mask).cast(pa.timestamp("us", tz="UTC")))
         elif isinstance(dt, T.DecimalType):
+            # build from unscaled ints directly: a numeric int64->decimal128
+            # cast both raises ('Precision is not great enough') and would
+            # scale the value by 10^scale (advisor finding r2)
+            import decimal as _d
+
             arrays.append(pa.array(
-                h.data[:n].astype(np.int64), type=pa.int64(), mask=mask
-            ).cast(pa.decimal128(dt.precision, dt.scale)))
+                [None if m else _d.Decimal(int(v)).scaleb(-dt.scale)
+                 for v, m in zip(h.data[:n], mask)],
+                type=pa.decimal128(dt.precision, dt.scale)))
         else:
             arrays.append(pa.array(h.data[:n], mask=mask))
     return pa.table(dict(zip(names, arrays)))

@@ -1,0 +1,23 @@
+"""Shared single-file commit protocol for the writers.
+
+Reference analog: GpuFileFormatWriter.scala:339 commit semantics — write to
+a temporary name, rename into place on success, always clean up the temp on
+failure. One implementation serves the parquet/ORC/CSV writers."""
+from __future__ import annotations
+
+import contextlib
+import os
+
+
+@contextlib.contextmanager
+def committed_file(path: str):
+    """Yield a temp path; os.replace it onto ``path`` iff the body
+    succeeds, unlink it otherwise."""
+    tmp = path + "._temporary"
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    try:
+        yield tmp
+        os.replace(tmp, path)  # commit
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)

@@ -76,3 +76,34 @@ class CsvScanner:
     def read_split_i(self, i: int):
         """(pyarrow table, partition values): unified scanner protocol."""
         return self._read(self.files[i][0]), ()
+
+
+def write_csv(batches, path: str, schema: T.StructType) -> dict:
+    """Chunked CSV write with the temp-file commit protocol (header once,
+    batches appended; reference role: the CSV leg of ColumnarOutputWriter)."""
+    import pyarrow.csv as pacsv
+
+    from ..columnar.batch import ColumnarBatch
+    from .arrow_convert import batch_to_arrow
+    from .commit import committed_file
+
+    rows = 0
+    nbatches = 0
+    with committed_file(path) as tmp:
+        with open(tmp, "wb") as sink:
+            first = True
+            for b in batches:
+                t = batch_to_arrow(b)
+                pacsv.write_csv(
+                    t, sink,
+                    write_options=pacsv.WriteOptions(include_header=first))
+                first = False
+                rows += t.num_rows
+                nbatches += 1
+            if first:
+                empty = ColumnarBatch.from_pydict(
+                    {f.name: [] for f in schema.fields}, schema)
+                pacsv.write_csv(
+                    batch_to_arrow(empty), sink,
+                    write_options=pacsv.WriteOptions(include_header=True))
+    return {"rows": rows, "batches": max(nbatches, 1), "files": 1}

@@ -25,7 +25,6 @@ from .. import types as T
 from ..conf import (
     CLOUD_SCHEMES,
     MAX_READER_BATCH_SIZE_BYTES,
-    PARQUET_MULTITHREAD_READ_NUM_THREADS,
     PARQUET_READER_TYPE,
     RapidsConf,
 )
@@ -248,14 +247,6 @@ class ParquetScanner:
         s = self.splits()[i]
         return self.read_split(s), s.partition_values
 
-    def read_splits_threaded(self, splits: Sequence[FileSplit]):
-        """MULTITHREADED cloud reader: buffer files in a thread pool
-        (reference: MultiFileCloudParquetPartitionReader :1299-1333)."""
-        from concurrent.futures import ThreadPoolExecutor
-
-        nthreads = self.conf.get(PARQUET_MULTITHREAD_READ_NUM_THREADS)
-        with ThreadPoolExecutor(max_workers=nthreads) as pool:
-            yield from pool.map(self.read_split, splits)
 
 
 def split_pcols(split: FileSplit) -> List[str]:
@@ -294,39 +285,34 @@ def write_parquet(
     import pyarrow.parquet as pq
 
     from .arrow_convert import batch_to_arrow
+    from .commit import committed_file
 
-    tmp = path + "._temporary"
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     writer = None
     rows = 0
     nbatches = 0
     try:
-        for b in batches:
-            t = batch_to_arrow(b)
+        with committed_file(path) as tmp:
+            for b in batches:
+                t = batch_to_arrow(b)
+                if writer is None:
+                    writer = pq.ParquetWriter(
+                        tmp, t.schema, compression=compression)
+                writer.write_table(t)
+                rows += t.num_rows
+                nbatches += 1
             if writer is None:
+                from ..columnar.batch import ColumnarBatch
+
+                empty = ColumnarBatch.from_pydict(
+                    {f.name: [] for f in schema.fields}, schema)
+                t = batch_to_arrow(empty)
                 writer = pq.ParquetWriter(
                     tmp, t.schema, compression=compression)
-            writer.write_table(t)
-            rows += t.num_rows
-            nbatches += 1
-        if writer is None:
-            import pyarrow as pa
-
-            from .arrow_convert import batch_to_arrow as _b2a
-            from ..columnar.batch import ColumnarBatch
-
-            empty = ColumnarBatch.from_pydict(
-                {f.name: [] for f in schema.fields}, schema)
-            t = _b2a(empty)
-            writer = pq.ParquetWriter(tmp, t.schema, compression=compression)
-            writer.write_table(t)
-        writer.close()
-        writer = None
-        os.replace(tmp, path)  # commit
+                writer.write_table(t)
+            writer.close()
+            writer = None
     finally:
         if writer is not None:
             writer.close()
-        if os.path.exists(tmp):
-            os.remove(tmp)
     return {"numRows": rows, "numBatches": nbatches,
             "bytes": os.path.getsize(path)}

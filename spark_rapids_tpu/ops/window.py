@@ -121,6 +121,96 @@ def _seg_scan(values: jax.Array, seg: jax.Array, combine):
     return out
 
 
+def bounded_row_agg(
+    op: str,
+    col: Optional[ColV],
+    part_start: jax.Array,
+    part_end: jax.Array,
+    live: jax.Array,
+    lower: int,
+    upper: int,
+) -> ColV:
+    """sum/count/min/max over a literal ROWS frame [i+lower, i+upper],
+    clamped to the partition (reference: GpuWindowExpression.scala:451+ —
+    row frames with literal bounds lowered to cudf rolling windows).
+
+    sum/count use prefix sums; min/max a sparse table with static levels
+    (the frame width is a literal, so log2(width) unrolls at trace time).
+    """
+    cap = live.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    lo = jnp.maximum(idx + lower, part_start)
+    hi = jnp.minimum(idx + upper, part_end)  # part_end inclusive
+    empty = (hi < lo) | ~live
+    lo_c = jnp.clip(lo, 0, cap - 1)
+    hi_c = jnp.clip(hi, 0, cap - 1)
+
+    if op == "count_star":
+        cnt = jnp.where(empty, 0, hi_c - lo_c + 1)
+        return ColV(cnt.astype(jnp.int64), live)
+
+    valid = live & col.validity
+
+    def window_count():
+        pre = jnp.concatenate(
+            [jnp.zeros(1, jnp.int64), jnp.cumsum(valid.astype(jnp.int64))])
+        return jnp.where(empty, 0, pre[hi_c + 1] - pre[lo_c])
+
+    if op == "count":
+        return ColV(window_count(), live)
+    if op == "sum":
+        x = jnp.where(valid, col.data, jnp.zeros((), col.data.dtype))
+        pre = jnp.concatenate([jnp.zeros(1, x.dtype), jnp.cumsum(x)])
+        s = jnp.where(empty, jnp.zeros((), x.dtype), pre[hi_c + 1] - pre[lo_c])
+        has = (window_count() > 0) & ~empty
+        return ColV(jnp.where(has, s, jnp.zeros((), s.dtype)), has)
+    if op in ("min", "max"):
+        isfloat = jnp.issubdtype(col.data.dtype, jnp.floating)
+        if op == "max":
+            fill = (jnp.array(-jnp.inf, col.data.dtype) if isfloat
+                    else jnp.array(jnp.iinfo(col.data.dtype).min,
+                                   col.data.dtype))
+            combine = jnp.maximum
+            x = jnp.where(valid, col.data, fill)
+        else:
+            fill = (jnp.array(jnp.inf, col.data.dtype) if isfloat
+                    else jnp.array(jnp.iinfo(col.data.dtype).max,
+                                   col.data.dtype))
+            combine = jnp.minimum
+            x = col.data
+            if isfloat:
+                # Spark min skips NaN unless the frame is all-NaN
+                x = jnp.where(jnp.isnan(x), jnp.inf, x)
+            x = jnp.where(valid, x, fill)
+        # sparse table: level k answers any range of length in [2^k, 2^(k+1))
+        width = upper - lower + 1
+        levels = [x]
+        k = 1
+        while k < width:
+            t = levels[-1]
+            shifted = jnp.concatenate([t[k:], jnp.full(k, fill, t.dtype)])
+            levels.append(combine(t, shifted))
+            k *= 2
+        T = jnp.stack(levels)  # (L, cap): T[k, i] = agg over [i, i+2^k)
+        ln = (hi_c - lo_c + 1).astype(jnp.float64)
+        kq = jnp.floor(jnp.log2(jnp.maximum(ln, 1))).astype(jnp.int32)
+        kq = jnp.clip(kq, 0, len(levels) - 1)
+        p2 = (1 << kq.astype(jnp.int64)).astype(jnp.int32)
+        a = T[kq, lo_c]
+        b = T[kq, jnp.clip(hi_c - p2 + 1, 0, cap - 1)]
+        r = combine(a, b)
+        cnt = window_count()
+        has = (cnt > 0) & ~empty
+        if op == "min" and isfloat:
+            nn = valid & ~jnp.isnan(col.data)
+            npre = jnp.concatenate(
+                [jnp.zeros(1, jnp.int64), jnp.cumsum(nn.astype(jnp.int64))])
+            n_nonnan = jnp.where(empty, 0, npre[hi_c + 1] - npre[lo_c])
+            r = jnp.where((n_nonnan == 0) & has, jnp.nan, r)
+        return ColV(jnp.where(has, r, jnp.zeros((), r.dtype)), has)
+    raise ValueError(f"unsupported bounded window aggregation {op!r}")
+
+
 def running_agg(
     op: str,
     col: Optional[ColV],

@@ -414,6 +414,10 @@ def _convert_limit(cpu: C.CpuLocalLimitExec, conf, children):
     return XB.TpuLocalLimitExec(conf, cpu.limit, children[0])
 
 
+def _convert_collect_limit(cpu: "C.CpuCollectLimitExec", conf, children):
+    return XB.TpuCollectLimitExec(conf, cpu.limit, children[0])
+
+
 def _tag_expand(meta: "PlanMeta") -> None:
     cpu: C.CpuExpandExec = meta.wrapped  # type: ignore[assignment]
     schema = cpu.children[0].output_schema
@@ -596,18 +600,47 @@ def _tag_join(meta: "PlanMeta") -> None:
 def _convert_join(cpu: C.CpuJoinExec, conf, children):
     from ..exec.join import (
         TpuBroadcastNestedLoopJoinExec,
+        TpuCartesianProductExec,
         TpuShuffledHashJoinExec,
     )
 
     if not cpu.left_keys:
         # build side flows through a broadcast exchange (reference:
-        # GpuBroadcastExchangeExec feeding GpuBroadcastNestedLoopJoinExec)
+        # GpuBroadcastExchangeExec feeding GpuBroadcastNestedLoopJoinExec;
+        # no condition = GpuCartesianProductExec.scala:304)
         from ..exec.exchange import TpuBroadcastExchangeExec
 
+        bcast = TpuBroadcastExchangeExec(conf, children[1])
+        if cpu.condition is None:
+            return TpuCartesianProductExec(conf, children[0], bcast)
         return TpuBroadcastNestedLoopJoinExec(
-            conf, children[0],
-            TpuBroadcastExchangeExec(conf, children[1]), cpu.condition)
+            conf, children[0], bcast, cpu.condition)
     left, right = children
+    # size-thresholded broadcast hash join (reference: the shim
+    # GpuBroadcastHashJoinExec selected when Spark stats fall under
+    # autoBroadcastJoinThreshold): the small side broadcasts and the big
+    # side's partitions probe in place — no exchanges at all
+    from ..conf import AUTO_BROADCAST_JOIN_THRESHOLD
+
+    thresh = conf.get(AUTO_BROADCAST_JOIN_THRESHOLD)
+    if (
+        thresh >= 0 and cpu.condition is None
+        and (left.num_partitions > 1 or right.num_partitions > 1)
+    ):
+        from ..exec.exchange import TpuBroadcastExchangeExec
+
+        lsz = cpu.children[0].estimated_size_bytes()
+        rsz = cpu.children[1].estimated_size_bytes()
+        # the exec builds from the RIGHT side (left for right joins)
+        if cpu.join_type in ("inner", "left", "semi", "anti") \
+                and rsz is not None and rsz <= thresh:
+            return TpuShuffledHashJoinExec(
+                conf, left, TpuBroadcastExchangeExec(conf, right),
+                cpu.left_keys, cpu.right_keys, cpu.join_type, None)
+        if cpu.join_type == "right" and lsz is not None and lsz <= thresh:
+            return TpuShuffledHashJoinExec(
+                conf, TpuBroadcastExchangeExec(conf, left), right,
+                cpu.left_keys, cpu.right_keys, cpu.join_type, None)
     if left.num_partitions > 1 or right.num_partitions > 1:
         # co-partition both sides by the join keys through hash exchanges
         # (reference: GpuShuffledHashJoinExec requires HashPartitioning
@@ -681,10 +714,11 @@ def _tag_window(meta: "PlanMeta") -> None:
         except (ValueError, KeyError) as ex:
             meta.will_not_work(str(ex))
     frame = spec.resolved_frame()
-    if not (frame.is_running or frame.is_whole_partition):
+    if not (frame.is_running or frame.is_whole_partition
+            or frame.is_bounded_rows):
         meta.will_not_work(
-            "only UNBOUNDED PRECEDING..CURRENT ROW / whole-partition window "
-            "frames run on TPU")
+            "only UNBOUNDED PRECEDING..CURRENT ROW, whole-partition, or "
+            "literal ROWS window frames run on TPU")
     for we in cpu.window_exprs:
         f = we.func
         if isinstance(f, (W.RowNumber, W.Rank, W.DenseRank)):
@@ -736,7 +770,11 @@ _exec_rule(C.CpuProjectExec, "ProjectExec", "column projection", _tag_project, _
 _exec_rule(C.CpuFilterExec, "FilterExec", "row filter", _tag_filter, _convert_filter)
 _exec_rule(C.CpuUnionExec, "UnionExec", "union all", _tag_union, _convert_union)
 _exec_rule(C.CpuLocalLimitExec, "LocalLimitExec", "row limit", _tag_limit, _convert_limit)
+_exec_rule(C.CpuCollectLimitExec, "CollectLimitExec", "global row limit",
+           _tag_limit, _convert_collect_limit)
 _exec_rule(C.CpuExpandExec, "ExpandExec", "expand projections", _tag_expand, _convert_expand)
+_exec_rule(C.CpuGenerateExec, "GenerateExec", "explode generator rows",
+           _tag_expand, _convert_expand)
 _exec_rule(C.CpuHashAggregateExec, "HashAggregateExec", "hash aggregation",
            _tag_aggregate, _convert_aggregate)
 _exec_rule(C.CpuSortExec, "SortExec", "sort", _tag_sort, _convert_sort)

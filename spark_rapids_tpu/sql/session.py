@@ -36,7 +36,17 @@ def _make_scanner(fmt: str, path: str, opts: tuple, conf: RapidsConf,
                   pushed: tuple = ()):
     """Build (and cache) a file scanner; the cache avoids re-parsing
     footers on every schema access (conf identity is part of the key)."""
-    key = (fmt, path, opts, pushed, id(conf))
+    # the key holds the conf VALUES planning depends on, not id(conf): an
+    # id can be reused after GC and silently serve a scanner planned under
+    # different settings (advisor finding r2)
+    from ..conf import (
+        CLOUD_SCHEMES,
+        MAX_READER_BATCH_SIZE_BYTES,
+        PARQUET_READER_TYPE,
+    )
+
+    key = (fmt, path, opts, pushed, conf.get(PARQUET_READER_TYPE),
+           conf.get(MAX_READER_BATCH_SIZE_BYTES), conf.get(CLOUD_SCHEMES))
     sc = _SCANNER_CACHE.get(key)
     if sc is None:
         od = dict(opts)
@@ -55,7 +65,8 @@ def _make_scanner(fmt: str, path: str, opts: tuple, conf: RapidsConf,
         elif fmt == "orc":
             from ..io.orc import OrcScanner
 
-            sc = OrcScanner(path, conf, columns=od.get("columns"))
+            sc = OrcScanner(path, conf, columns=od.get("columns"),
+                            filters=list(pushed))
         else:
             raise ValueError(f"unknown file format {fmt}")
         if len(_SCANNER_CACHE) > 256:
@@ -131,7 +142,8 @@ def _lower(node: LNode, conf: RapidsConf) -> C.CpuExec:
         (cond,) = node.args
         cond = rx(cond)
         fmt, path, opts = node.children[0].args
-        pushed = _extract_pushed_filters(cond) if fmt == "parquet" else ()
+        pushed = (
+            _extract_pushed_filters(cond) if fmt in ("parquet", "orc") else ())
         sc = _make_scanner(fmt, path, opts, conf, pushed)
         return C.CpuFilterExec(conf, cond, C.CpuFileScanExec(conf, sc, fmt))
     kids = [_lower(c, conf) for c in node.children]
@@ -167,6 +179,13 @@ def _lower(node: LNode, conf: RapidsConf) -> C.CpuExec:
     if k == "limit":
         (n,) = node.args
         return C.CpuLocalLimitExec(conf, n, kids[0])
+    if k == "collect_limit":
+        (n,) = node.args
+        return C.CpuCollectLimitExec(conf, n, kids[0])
+    if k == "generate":
+        gens, name, with_pos = node.args
+        return C.CpuGenerateExec(
+            conf, [rx(g) for g in gens], name, with_pos, kids[0])
     if k == "union":
         return C.CpuUnionExec(conf, kids)
     if k == "expand":
@@ -283,14 +302,12 @@ class DataFrameWriter:
     def __init__(self, df: "DataFrame"):
         self._df = df
 
-    def parquet(self, path: str, compression: str = "snappy") -> Dict[str, int]:
-        from ..io.parquet import write_parquet
-
+    def _batches(self):
         df = self._df
         final = df.session._execute(df.node)
         schema = final.output_schema
 
-        def batches():
+        def gen():
             if isinstance(final, ColumnarToRowExec):
                 # columnar fast path: hand device batches to the writer
                 yield from final.tpu_child.execute_columnar()
@@ -309,7 +326,25 @@ class DataFrameWriter:
                 if buf:
                     yield batch_from_rows(buf, schema)
 
-        return write_parquet(batches(), path, schema, compression)
+        return gen(), schema
+
+    def parquet(self, path: str, compression: str = "snappy") -> Dict[str, int]:
+        from ..io.parquet import write_parquet
+
+        batches, schema = self._batches()
+        return write_parquet(batches, path, schema, compression)
+
+    def orc(self, path: str, compression: str = "zstd") -> Dict[str, int]:
+        from ..io.orc import write_orc
+
+        batches, schema = self._batches()
+        return write_orc(batches, path, schema, compression)
+
+    def csv(self, path: str) -> Dict[str, int]:
+        from ..io.csv import write_csv
+
+        batches, schema = self._batches()
+        return write_csv(batches, path, schema)
 
 
 class DataFrame:
@@ -368,7 +403,31 @@ class DataFrame:
     sort = order_by
 
     def limit(self, n: int) -> "DataFrame":
+        """Global limit (Spark CollectLimit semantics: at most n rows total,
+        taken from partitions in order)."""
+        return DataFrame(
+            self.session, LNode("collect_limit", (n,), (self.node,)))
+
+    def local_limit(self, n: int) -> "DataFrame":
+        """Per-partition limit (Spark LocalLimit)."""
         return DataFrame(self.session, LNode("limit", (n,), (self.node,)))
+
+    def explode(self, values: Sequence[E.Expression], name: str = "col",
+                pos: bool = False) -> "DataFrame":
+        """explode(array(e1..eN)): one output row per element, keeping the
+        input columns (posexplode with ``pos=True``)."""
+        return DataFrame(
+            self.session,
+            LNode("generate", (tuple(values), name, pos), (self.node,)))
+
+    def cross_join(self, other: "DataFrame",
+                   condition: Optional[E.Expression] = None) -> "DataFrame":
+        """Cartesian product, optionally with a residual condition."""
+        return DataFrame(
+            self.session,
+            LNode("join", ((), (), "inner", condition),
+                  (self.node, other.node)),
+        )
 
     def union(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(

@@ -114,9 +114,11 @@ def _as_bool(e: E.Expression) -> E.Expression:
     dt = _dtype_of(e)
     if dt == T.BOOLEAN:
         return e
-    if dt is None:
+    if dt is None or not dt.is_numeric:
+        # string/other truthiness is NOT `!= 0`; falling back beats a
+        # silent miscompile
         raise UnsupportedUDF(
-            "truthiness of an unresolved column (use explicit comparisons)")
+            "truthiness of a non-numeric value (use explicit comparisons)")
     # Python truthiness of numbers: x != 0
     return E.Not(E.EqualTo(e, E.Literal(0, T.INT)))
 

@@ -202,3 +202,66 @@ def test_scan_feeds_partitioned_aggregate_through_exchange(tmpd):
     want = cpu.read.parquet(tmpd).group_by("k").agg(
         A.agg(A.Sum(E.col("l")), "sl")).collect()
     compare_rows(want, out)
+
+
+# ---------------------------------------------------------------------------
+# round 3: ORC/CSV writers, ORC pushdown, MULTITHREADED prefetch, decimals
+# ---------------------------------------------------------------------------
+def test_orc_write_query_read_round_trip(tmpd):
+    paorc.write_table(_mixed_table(seed=21), f"{tmpd}/in.orc")
+    s = TpuSession()
+    stats = (
+        s.read.orc(f"{tmpd}/in.orc")
+        .where(E.GreaterThan(col("k"), lit(10)))
+        .write.orc(f"{tmpd}/out.orc")
+    )
+    assert stats["rows"] > 0
+    assert_tpu_and_cpu_equal(lambda se: se.read.orc(f"{tmpd}/out.orc"))
+
+
+def test_csv_writer_round_trip(tmpd):
+    t = _mixed_table(300, seed=22)
+    pq.write_table(t, f"{tmpd}/in.parquet")
+    s = TpuSession()
+    stats = s.read.parquet(f"{tmpd}/in.parquet").write.csv(f"{tmpd}/out.csv")
+    assert stats["rows"] == 300
+    import pyarrow.csv as pacsv
+
+    back = pacsv.read_csv(f"{tmpd}/out.csv")
+    assert back.num_rows == 300
+
+
+def test_orc_filter_pushdown_differential(tmpd):
+    paorc.write_table(_mixed_table(2000, seed=23), f"{tmpd}/a.orc")
+    assert_tpu_and_cpu_equal(
+        lambda s: s.read.orc(tmpd).where(
+            E.And(E.GreaterThanOrEqual(col("k"), lit(20)),
+                  E.IsNotNull(col("s")))))
+
+
+def test_multithreaded_reader_prefetches(tmpd):
+    t = _mixed_table(1200, seed=24)
+    for i in range(4):
+        pq.write_table(t.slice(i * 300, 300), f"{tmpd}/m{i}.parquet")
+    assert_tpu_and_cpu_equal(
+        lambda s: s.read.parquet(tmpd).group_by("k").agg(
+            A.agg(A.Sum(col("l")), "sl")),
+        conf={"spark.rapids.tpu.sql.format.parquet.reader.type":
+              "MULTITHREADED"},
+    )
+
+
+def test_decimal_write_round_trip(tmpd):
+    import decimal as D
+
+    t = pa.table({
+        "d": pa.array([D.Decimal("12.34"), None, D.Decimal("-0.05"),
+                       D.Decimal("99999.99")], pa.decimal128(10, 2)),
+        "v": pa.array([1, 2, 3, 4], pa.int64()),
+    })
+    pq.write_table(t, f"{tmpd}/dec.parquet")
+    s = TpuSession()
+    s.read.parquet(f"{tmpd}/dec.parquet").write.parquet(f"{tmpd}/dec_out.parquet")
+    back = pq.read_table(f"{tmpd}/dec_out.parquet")
+    assert back.column("d").to_pylist() == [
+        D.Decimal("12.34"), None, D.Decimal("-0.05"), D.Decimal("99999.99")]

@@ -15,8 +15,11 @@ from spark_rapids_tpu.sql import TpuSession
 
 from harness import assert_tpu_and_cpu_equal, compare_rows
 
-ICI = {"spark.rapids.tpu.shuffle.mode": "ici"}
-HOST = {"spark.rapids.tpu.shuffle.mode": "host"}
+# broadcast-threshold off: these tests exercise the exchange paths
+ICI = {"spark.rapids.tpu.shuffle.mode": "ici",
+       "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1}
+HOST = {"spark.rapids.tpu.shuffle.mode": "host",
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1}
 
 SCHEMA = T.StructType([
     T.StructField("k", T.INT),

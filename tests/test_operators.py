@@ -1,0 +1,211 @@
+"""Round-3 operator gap tests: CollectLimit, CartesianProduct, Generate,
+bounded ROWS window frames, size-thresholded broadcast hash join
+(reference: limit.scala:126, GpuCartesianProductExec.scala:304,
+GpuGenerateExec, GpuWindowExpression.scala:451, shim GpuBroadcastHashJoinExec).
+"""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import aggregates as A
+from spark_rapids_tpu.expr import expressions as E
+from spark_rapids_tpu.expr import windows as W
+from spark_rapids_tpu.expr.expressions import col, lit
+from spark_rapids_tpu.sql import TpuSession
+
+from harness import assert_tpu_and_cpu_equal, compare_rows
+
+SCHEMA = T.StructType([
+    T.StructField("k", T.INT), T.StructField("a", T.LONG),
+    T.StructField("b", T.DOUBLE),
+])
+
+
+def _data(n=300):
+    return {
+        "k": [i % 7 if i % 13 else None for i in range(n)],
+        "a": [i * 3 - n if i % 11 else None for i in range(n)],
+        "b": [i / 7.0 if i % 5 else None for i in range(n)],
+    }
+
+
+def make_df(s, n=300, parts=3):
+    return s.create_dataframe(_data(n), SCHEMA, num_partitions=parts)
+
+
+# ---------------------------------------------------------------------------
+# CollectLimit
+# ---------------------------------------------------------------------------
+def test_collect_limit_is_global():
+    sess = TpuSession()
+    rows = make_df(sess, 300, 4).limit(50).collect()
+    assert len(rows) == 50
+    assert "TpuCollectLimitExec" in sess.last_executed_plan.tree_string()
+
+
+def test_collect_limit_differential():
+    assert_tpu_and_cpu_equal(
+        lambda s: make_df(s, 120, 3).limit(40), ignore_order=False)
+    assert_tpu_and_cpu_equal(lambda s: make_df(s, 30, 2).limit(100))
+
+
+def test_local_limit_still_available():
+    sess = TpuSession()
+    rows = make_df(sess, 300, 3).local_limit(10).collect()
+    assert len(rows) == 30  # 10 per partition
+
+
+# ---------------------------------------------------------------------------
+# CartesianProduct / cross join
+# ---------------------------------------------------------------------------
+def test_cartesian_product_differential():
+    def build(s):
+        left = s.create_dataframe(
+            {"x": [1, 2, 3, None]}, T.StructType([T.StructField("x", T.INT)]),
+            num_partitions=2)
+        right = s.create_dataframe(
+            {"y": [10, 20, 30]}, T.StructType([T.StructField("y", T.INT)]))
+        return left.cross_join(right)
+
+    rows = assert_tpu_and_cpu_equal(build)
+    assert len(rows) == 12
+
+
+def test_cartesian_plan_name():
+    sess = TpuSession()
+    l = sess.create_dataframe({"x": [1, 2]},
+                              T.StructType([T.StructField("x", T.INT)]))
+    r = sess.create_dataframe({"y": [3]},
+                              T.StructType([T.StructField("y", T.INT)]))
+    l.cross_join(r).collect()
+    assert "TpuCartesianProductExec" in sess.last_executed_plan.tree_string()
+
+
+def test_cross_join_with_condition():
+    def build(s):
+        l = s.create_dataframe({"x": list(range(20))},
+                               T.StructType([T.StructField("x", T.INT)]))
+        r = s.create_dataframe({"y": list(range(10))},
+                               T.StructType([T.StructField("y", T.INT)]))
+        return l.cross_join(r, condition=E.GreaterThan(col("x"), col("y")))
+
+    assert_tpu_and_cpu_equal(build)
+
+
+# ---------------------------------------------------------------------------
+# Generate / explode
+# ---------------------------------------------------------------------------
+def test_explode_values_differential():
+    def build(s):
+        return make_df(s, 100, 2).explode(
+            [col("a"), E.Multiply(col("a"), lit(2)), lit(7)], name="v")
+
+    assert_tpu_and_cpu_equal(build)
+
+
+def test_posexplode_differential():
+    def build(s):
+        return make_df(s, 60, 2).explode(
+            [col("a"), col("k")], name="v", pos=True)
+
+    rows = assert_tpu_and_cpu_equal(build)
+    assert {r[3] for r in rows} == {0, 1}  # pos column
+
+
+def test_generate_output_schema():
+    sess = TpuSession()
+    df = make_df(sess, 20, 1).explode([col("a"), lit(1)], name="v", pos=True)
+    assert [f.name for f in df.schema.fields] == ["k", "a", "b", "pos", "v"]
+    assert len(df.collect()) == 40
+
+
+# ---------------------------------------------------------------------------
+# bounded ROWS window frames
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("lo,hi", [(-2, 0), (-1, 1), (0, 2), (-3, -1), (1, 3)])
+def test_bounded_rows_frames(lo, hi):
+    frame = W.WindowFrame(W.ROWS, lo, hi)
+    spec = W.WindowSpec(
+        partition_by=(col("k"),), order_by=(col("a"),),
+        orders=((True, True),), frame=frame)
+
+    def build(s):
+        return make_df(s, 200, 1).with_windows(
+            W.WindowExpression(A.Sum(col("a")), spec, "rs"),
+            W.WindowExpression(A.Min(col("a")), spec, "mn"),
+            W.WindowExpression(A.Max(col("a")), spec, "mx"),
+            W.WindowExpression(A.Count(col("a")), spec, "cn"),
+        )
+
+    assert_tpu_and_cpu_equal(build)
+
+
+def test_bounded_rows_average():
+    frame = W.WindowFrame(W.ROWS, -3, 3)
+    spec = W.WindowSpec(partition_by=(col("k"),), order_by=(col("a"),),
+                        orders=((True, True),), frame=frame)
+
+    def build(s):
+        return make_df(s, 150, 1).with_windows(
+            W.WindowExpression(A.Average(col("b")), spec, "av"))
+
+    assert_tpu_and_cpu_equal(
+        build, approx_float=True,
+        conf={"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+
+
+def test_bounded_rows_current_row_sentinels():
+    # ROWS BETWEEN 2 PRECEDING AND CURRENT ROW via the sentinel
+    frame = W.WindowFrame(W.ROWS, -2, W.CURRENT_ROW)
+    spec = W.WindowSpec(partition_by=(col("k"),), order_by=(col("a"),),
+                        orders=((True, True),), frame=frame)
+
+    def build(s):
+        return make_df(s, 120, 1).with_windows(
+            W.WindowExpression(A.Sum(col("a")), spec, "rs"))
+
+    assert_tpu_and_cpu_equal(build)
+
+
+# ---------------------------------------------------------------------------
+# size-thresholded broadcast hash join
+# ---------------------------------------------------------------------------
+def test_small_side_broadcasts():
+    sess = TpuSession()
+    big = make_df(sess, 400, 4)
+    dim = sess.create_dataframe(
+        {"k2": list(range(7)), "w": [i * 10 for i in range(7)]},
+        T.StructType([T.StructField("k2", T.INT), T.StructField("w", T.LONG)]),
+        num_partitions=2)
+    big.join(dim, on=[("k", "k2")]).collect()
+    plan = sess.last_executed_plan.tree_string()
+    assert "TpuBroadcastExchangeExec" in plan
+    assert "TpuShuffleExchangeExec" not in plan
+    assert "TpuMeshAggregateExec" not in plan
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti", "right"])
+def test_broadcast_join_differential(how):
+    def build(s):
+        big = make_df(s, 300, 3)
+        dim = s.create_dataframe(
+            {"k2": [0, 1, 2, 3, None], "w": [0, 10, 20, 30, 40]},
+            T.StructType([T.StructField("k2", T.INT),
+                          T.StructField("w", T.LONG)]),
+            num_partitions=2)
+        if how == "right":
+            return dim.join(big, on=[("k2", "k")], how="right")
+        return big.join(dim, on=[("k", "k2")], how=how)
+
+    assert_tpu_and_cpu_equal(build)
+
+
+def test_threshold_disable_keeps_exchanges():
+    sess = TpuSession({"spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1,
+                       "spark.rapids.tpu.shuffle.mode": "host"})
+    big = make_df(sess, 200, 3)
+    dim = sess.create_dataframe(
+        {"k2": [1, 2], "w": [1, 2]},
+        T.StructType([T.StructField("k2", T.INT), T.StructField("w", T.LONG)]),
+        num_partitions=2)
+    big.join(dim, on=[("k", "k2")]).collect()
+    assert "TpuShuffleExchangeExec" in sess.last_executed_plan.tree_string()
