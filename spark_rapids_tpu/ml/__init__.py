@@ -1,0 +1,21 @@
+"""Python & ML integration (SURVEY.md L9 / §2.10).
+
+Reference analog: ColumnarRdd.scala:41-47 + InternalColumnarRddConverter
+(device-table export for XGBoost with no host round trip, gated by
+spark.rapids.sql.exportColumnarRdd) and the pandas-UDF exec family
+(GpuArrowEvalPythonExec / GpuMapInPandasExec — Arrow-stream hand-off to
+python workers). On TPU the "device table" is the jax-array ColumnarBatch
+itself: `columnar_rdd` hands those over without any host copy, and
+`to_dlpack_batches` exposes the columns through DLPack so consumers
+(XGBoost's DMatrix, torch, etc.) can ingest them zero-copy.
+"""
+from .columnar_rdd import columnar_rdd, to_dlpack_batches, to_numpy_batches
+from .pandas_udf import map_in_arrow, map_in_pandas
+
+__all__ = [
+    "columnar_rdd",
+    "to_dlpack_batches",
+    "to_numpy_batches",
+    "map_in_arrow",
+    "map_in_pandas",
+]

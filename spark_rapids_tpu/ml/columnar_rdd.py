@@ -1,0 +1,82 @@
+"""Device-batch export for ML frameworks.
+
+Reference analog: ColumnarRdd(df): RDD[Table] (ColumnarRdd.scala:41-47) —
+the public zero-copy hand-off that lets XGBoost build a DMatrix from the
+plugin's device tables without a host round trip, gated by
+spark.rapids.sql.exportColumnarRdd (RapidsConf.scala:406). The TPU
+equivalent exports the engine's device-resident ColumnarBatch stream: the
+columns are jax arrays already, so consumers ingest them through DLPack
+(XGBoost >= 2 accepts __dlpack__-capable arrays) or as numpy views.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from ..conf import RapidsConf, conf
+from ..columnar import ColumnarBatch
+
+EXPORT_COLUMNAR_RDD = conf(
+    "spark.rapids.tpu.sql.exportColumnarRdd", False,
+    "Enable exporting the device ColumnarBatch stream to ML consumers "
+    "(reference: spark.rapids.sql.exportColumnarRdd).")
+
+
+def _tpu_plan(df):
+    """The device-side plan of a DataFrame, bypassing the row boundary."""
+    from ..exec.transitions import ColumnarToRowExec
+
+    final = df.session._execute(df.node)
+    if isinstance(final, ColumnarToRowExec):
+        return final.tpu_child
+    return None
+
+
+def columnar_rdd(df) -> Iterator[ColumnarBatch]:
+    """Device batches of a DataFrame with NO host round trip.
+
+    Raises unless spark.rapids.tpu.sql.exportColumnarRdd is set (the same
+    opt-in contract as the reference) or the plan has CPU fallbacks (no
+    device batches exist to export, like InternalColumnarRddConverter's
+    mapPartitions failure mode)."""
+    conf_ = df.session.conf
+    if not conf_.get(EXPORT_COLUMNAR_RDD):
+        raise ValueError(
+            "set spark.rapids.tpu.sql.exportColumnarRdd=true to export "
+            "device batches")
+    plan = _tpu_plan(df)
+    if plan is None:
+        raise ValueError(
+            "plan has CPU fallbacks; no device batches to export "
+            "(check df.explain())")
+    return plan.execute_columnar()
+
+
+def to_dlpack_batches(df) -> Iterator[List[object]]:
+    """Per batch: the fixed-width column data arrays as DLPack-capable
+    objects (jax arrays implement __dlpack__), for XGBoost/torch ingestion."""
+    for batch in columnar_rdd(df):
+        cols = []
+        for c in batch.columns:
+            if c.is_string:
+                raise ValueError("string columns cannot export via DLPack")
+            cols.append(c.data[: batch.num_rows])
+        yield cols
+
+
+def to_numpy_batches(df) -> Iterator[List[object]]:
+    """Per batch: (n, ncols) float-ready numpy views with NaN for nulls —
+    the DMatrix-building convenience (docs/ml-integration.md analog)."""
+    import numpy as np
+
+    for batch in columnar_rdd(df):
+        n = batch.num_rows
+        out = []
+        for c in batch.columns:
+            if c.is_string:
+                raise ValueError("string columns cannot export to DMatrix")
+            import jax
+
+            d = np.asarray(jax.device_get(c.data[:n])).astype(np.float64)
+            v = np.asarray(jax.device_get(c.validity[:n]))
+            out.append(np.where(v, d, np.nan))
+        yield out

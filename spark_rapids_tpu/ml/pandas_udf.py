@@ -1,0 +1,61 @@
+"""pandas/arrow mapPartitions operators.
+
+Reference analog: the python exec family (GpuMapInPandasExec,
+GpuArrowEvalPythonExec: GpuArrowEvalPythonExec.scala:58-465) — device
+batches stream to the python function as Arrow data and the results come
+back as Arrow. There is no separate worker process here (the engine IS
+python); what is preserved is the data plane: device batch -> one Arrow
+conversion -> user function -> one Arrow conversion -> device batch, with
+the engine's columnar operators running before and after on TPU.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterator
+
+from .. import types as T
+from ..columnar import ColumnarBatch
+
+
+def _arrow_batches(df) -> Iterator[object]:
+    """Arrow tables of a DataFrame's device output (one per batch)."""
+    from ..exec.transitions import ColumnarToRowExec
+    from ..io.arrow_convert import batch_to_arrow
+
+    final = df.session._execute(df.node)
+    if isinstance(final, ColumnarToRowExec):
+        for b in final.tpu_child.execute_columnar():
+            yield batch_to_arrow(b)
+    else:
+        from ..columnar.batch import batch_from_rows
+
+        schema = final.output_schema
+        rows = [
+            r for p in range(final.num_partitions)
+            for r in final.execute_rows_partition(p)
+        ]
+        yield batch_to_arrow(batch_from_rows(rows, schema))
+
+
+def map_in_arrow(df, fn: Callable, schema: T.StructType):
+    """fn(pyarrow.Table) -> pyarrow.Table over each batch; the results come
+    back as a DataFrame with ``schema`` (GpuMapInPandasExec's Arrow leg)."""
+    from ..io.arrow_convert import arrow_to_batch
+
+    out_data = {f.name: [] for f in schema.fields}
+    for t in _arrow_batches(df):
+        r = fn(t)
+        for f in schema.fields:
+            out_data[f.name].extend(r.column(f.name).to_pylist())
+    return df.session.create_dataframe(out_data, schema)
+
+
+def map_in_pandas(df, fn: Callable, schema: T.StructType):
+    """fn(pandas.DataFrame) -> pandas.DataFrame over each batch (the
+    df.mapInPandas analog, GpuMapInPandasExec)."""
+    import pyarrow as pa
+
+    def arrow_fn(t):
+        return pa.Table.from_pandas(
+            fn(t.to_pandas()), preserve_index=False)
+
+    return map_in_arrow(df, arrow_fn, schema)

@@ -1,0 +1,116 @@
+"""Python/ML integration tests (SURVEY §2.10): zero-copy device-batch
+export (ColumnarRdd analog, BASELINE config #5) and mapInPandas/mapInArrow.
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import expressions as E
+from spark_rapids_tpu.expr.expressions import col, lit
+from spark_rapids_tpu.ml import (
+    columnar_rdd,
+    map_in_arrow,
+    map_in_pandas,
+    to_dlpack_batches,
+    to_numpy_batches,
+)
+from spark_rapids_tpu.sql import TpuSession
+
+ON = {"spark.rapids.tpu.sql.exportColumnarRdd": True}
+
+SCHEMA = T.StructType([
+    T.StructField("x", T.DOUBLE), T.StructField("y", T.LONG)])
+
+
+def _df(sess, n=500, parts=2):
+    return sess.create_dataframe(
+        {"x": [i / 3.0 if i % 7 else None for i in range(n)],
+         "y": [i for i in range(n)]},
+        SCHEMA, num_partitions=parts)
+
+
+def test_columnar_rdd_requires_opt_in():
+    sess = TpuSession()
+    with pytest.raises(ValueError, match="exportColumnarRdd"):
+        next(iter(columnar_rdd(_df(sess))))
+
+
+def test_columnar_rdd_exports_device_batches():
+    import jax
+
+    sess = TpuSession(ON)
+    total = 0
+    for batch in columnar_rdd(_df(sess).where(E.GreaterThan(col("y"), lit(9)))):
+        assert isinstance(batch.columns[0].data, jax.Array)  # still on device
+        total += batch.num_rows
+    assert total == 490
+
+
+def test_dlpack_and_numpy_export():
+    sess = TpuSession(ON)
+    df = _df(sess, 100, 1)
+    [cols] = list(to_dlpack_batches(df))
+    assert hasattr(cols[0], "__dlpack__")
+    [mats] = list(to_numpy_batches(df))
+    x = mats[0]
+    assert np.isnan(x[0])  # null -> NaN (DMatrix convention)
+    assert x[1] == pytest.approx(1 / 3.0)
+
+
+def test_columnar_rdd_rejects_fallback_plans():
+    sess = TpuSession(ON)
+    # string min aggregate falls back to CPU -> no device batches
+    schema = T.StructType([T.StructField("s", T.STRING)])
+    df = sess.create_dataframe({"s": ["a", "b"]}, schema)
+    from spark_rapids_tpu.expr import aggregates as A
+
+    bad = df.agg(A.agg(A.Min(col("s")), "m"))
+    with pytest.raises(ValueError, match="CPU fallback"):
+        next(iter(columnar_rdd(bad)))
+
+
+def test_map_in_pandas():
+    sess = TpuSession()
+    out_schema = T.StructType([T.StructField("z", T.DOUBLE)])
+
+    def f(pdf):
+        import pandas as pd
+
+        return pd.DataFrame({"z": pdf["x"].fillna(0.0) * 2 + pdf["y"]})
+
+    out = map_in_pandas(_df(sess, 50, 2), f, out_schema)
+    rows = out.collect()
+    assert len(rows) == 50
+    assert rows[1][0] == pytest.approx(2 / 3.0 + 1)
+
+
+def test_map_in_arrow_then_tpu_ops():
+    sess = TpuSession()
+    out_schema = T.StructType([T.StructField("y2", T.LONG)])
+
+    def f(t):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+
+        return pa.table({"y2": pc.multiply(t.column("y"), 3)})
+
+    out = map_in_arrow(_df(sess, 40, 1), f, out_schema)
+    # the result is a first-class DataFrame: TPU ops continue on it
+    rows = out.where(E.GreaterThanOrEqual(col("y2"), lit(60))).collect()
+    assert len(rows) == 20
+
+
+def test_xgboost_style_dmatrix_build():
+    """BASELINE config #5 shape: device batches -> DMatrix-ready matrix."""
+    sess = TpuSession(ON)
+    df = _df(sess, 200, 2).where(E.IsNotNull(col("x")))
+    mats = [np.column_stack(m) for m in to_numpy_batches(df)]
+    X = np.vstack(mats)
+    assert X.shape[1] == 2 and not np.isnan(X).any()
+    try:
+        import xgboost as xgb
+
+        d = xgb.DMatrix(X[:, :1], label=X[:, 1])
+        assert d.num_row() == X.shape[0]
+    except ImportError:
+        pass  # xgboost not in the image: the export path is still proven

@@ -256,3 +256,29 @@ class TestMixedPlan:
         # multi-device sessions lower global sort to the mesh stage
         plan = sess.last_executed_plan.tree_string()
         assert "TpuSortExec" in plan or "TpuMeshSortExec" in plan
+
+
+class TestDocGen:
+    def test_generated_docs_cover_registries(self):
+        """configs.md / supported_ops.md generate from the live registries
+        (reference: RapidsConf.help + TypeChecks.help doc artifacts)."""
+        from spark_rapids_tpu.conf import _REGISTRY
+        from spark_rapids_tpu.plugin.docgen import configs_md, supported_ops_md
+        from spark_rapids_tpu.plugin.overrides import (
+            EXEC_RULES,
+            EXPRESSION_RULES,
+        )
+
+        cfg = configs_md()
+        assert "spark.rapids.tpu.sql.enabled" in cfg
+        public = [k for k, e in _REGISTRY.items() if not e.internal]
+        assert all(k in cfg for k in public)
+
+        ops = supported_ops_md()
+        for r in EXPRESSION_RULES.values():
+            assert f"| {r.name} |" in ops
+        for r in EXEC_RULES.values():
+            assert f"| {r.name} |" in ops
+        # a few known matrix facts
+        assert "| Upper | uppercase conversion |" in ops
+        assert "CollectLimitExec" in ops
