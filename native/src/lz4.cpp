@@ -1,0 +1,166 @@
+// LZ4 block-format codec (compress + decompress), C++17, no dependencies.
+//
+// Reference analog: the nvcomp LZ4 batched codec behind the reference's
+// TableCompressionCodec SPI (NvcompLZ4CompressionCodec.scala:25-159,
+// SURVEY.md §2.12 item 4). On TPU hosts there is no device codec; this is
+// the native host-side implementation the shuffle serializer loads through
+// ctypes (spark_rapids_tpu/native.py). Standard LZ4 block format:
+//   token: high nibble = literal run length, low nibble = match length - 4
+//   (15 => 255-terminated extension bytes), literals, then a 2-byte LE
+//   match offset. The final sequence is literals-only; the last match must
+//   start >= 12 bytes from the end and leave >= 5 literal bytes.
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int MINMATCH = 4;
+constexpr int HASH_LOG = 16;
+constexpr int HASH_SIZE = 1 << HASH_LOG;
+
+inline uint32_t read32(const uint8_t* p) {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return v;
+}
+
+inline uint32_t hash4(uint32_t v) {
+    return (v * 2654435761u) >> (32 - HASH_LOG);
+}
+
+inline uint8_t* write_length(uint8_t* op, int len) {
+    while (len >= 255) {
+        *op++ = 255;
+        len -= 255;
+    }
+    *op++ = static_cast<uint8_t>(len);
+    return op;
+}
+
+}  // namespace
+
+extern "C" {
+
+// worst-case compressed size for n input bytes (LZ4_compressBound)
+int srtpu_lz4_bound(int n) {
+    return n + n / 255 + 16;
+}
+
+// returns compressed size, or 0 on failure / insufficient dst capacity
+int srtpu_lz4_compress(const uint8_t* src, int n, uint8_t* dst, int dcap) {
+    if (n < 0 || dcap < srtpu_lz4_bound(n)) return 0;
+    if (n == 0) return 0;
+    const uint8_t* ip = src;
+    const uint8_t* const iend = src + n;
+    // matches may not start within the last 12 bytes (format rule)
+    const uint8_t* const mflimit = (n >= 13) ? iend - 12 : src;
+    const uint8_t* anchor = src;
+    uint8_t* op = dst;
+
+    int32_t table[HASH_SIZE];
+    std::memset(table, -1, sizeof(table));
+
+    while (ip < mflimit) {
+        uint32_t h = hash4(read32(ip));
+        int32_t cand = table[h];
+        table[h] = static_cast<int32_t>(ip - src);
+        const uint8_t* match = src + cand;
+        if (cand < 0 || ip - match > 65535 || read32(match) != read32(ip)) {
+            ++ip;
+            continue;
+        }
+        // extend the match forward (stay clear of the 5-byte tail rule)
+        const uint8_t* const matchlimit = iend - 5;
+        const uint8_t* mp = match + MINMATCH;
+        const uint8_t* cp = ip + MINMATCH;
+        while (cp < matchlimit && *cp == *mp) {
+            ++cp;
+            ++mp;
+        }
+        int mlen = static_cast<int>(cp - ip);
+        int litlen = static_cast<int>(ip - anchor);
+
+        // token
+        uint8_t* token = op++;
+        int lit_nib = litlen >= 15 ? 15 : litlen;
+        int mat_nib = (mlen - MINMATCH) >= 15 ? 15 : (mlen - MINMATCH);
+        *token = static_cast<uint8_t>((lit_nib << 4) | mat_nib);
+        if (litlen >= 15) op = write_length(op, litlen - 15);
+        std::memcpy(op, anchor, litlen);
+        op += litlen;
+        uint16_t off = static_cast<uint16_t>(ip - match);
+        *op++ = static_cast<uint8_t>(off & 0xFF);
+        *op++ = static_cast<uint8_t>(off >> 8);
+        if (mlen - MINMATCH >= 15) op = write_length(op, mlen - MINMATCH - 15);
+
+        ip = cp;
+        anchor = ip;
+        // NOTE: no table insert here — the loop top inserts for this ip;
+        // inserting now would make the next lookup find ip itself
+        // (offset 0, malformed stream)
+    }
+
+    // final literals-only sequence
+    int litlen = static_cast<int>(iend - anchor);
+    uint8_t* token = op++;
+    *token = static_cast<uint8_t>((litlen >= 15 ? 15 : litlen) << 4);
+    if (litlen >= 15) op = write_length(op, litlen - 15);
+    std::memcpy(op, anchor, litlen);
+    op += litlen;
+    return static_cast<int>(op - dst);
+}
+
+// returns decompressed size, or -1 on malformed input / capacity overflow
+int srtpu_lz4_decompress(const uint8_t* src, int n, uint8_t* dst, int dcap) {
+    const uint8_t* ip = src;
+    const uint8_t* const iend = src + n;
+    uint8_t* op = dst;
+    uint8_t* const oend = dst + dcap;
+
+    while (ip < iend) {
+        uint8_t token = *ip++;
+        int litlen = token >> 4;
+        if (litlen == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                litlen += b;
+            } while (b == 255);
+        }
+        if (ip + litlen > iend || op + litlen > oend) return -1;
+        std::memcpy(op, ip, litlen);
+        ip += litlen;
+        op += litlen;
+        if (ip >= iend) break;  // final sequence has no match part
+
+        if (ip + 2 > iend) return -1;
+        int off = ip[0] | (ip[1] << 8);
+        ip += 2;
+        if (off == 0 || op - dst < off) return -1;
+        int mlen = (token & 0x0F);
+        if (mlen == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                mlen += b;
+            } while (b == 255);
+        }
+        mlen += MINMATCH;
+        if (op + mlen > oend) return -1;
+        const uint8_t* match = op - off;
+        // when the match overlaps the output (off < mlen) the bytes being
+        // read are being produced by this same copy: byte-forward copy IS
+        // the semantics (repeating pattern); memcpy only when disjoint
+        if (off >= mlen) {
+            std::memcpy(op, match, mlen);
+        } else {
+            for (int i = 0; i < mlen; ++i) op[i] = match[i];
+        }
+        op += mlen;
+    }
+    return static_cast<int>(op - dst);
+}
+
+}  // extern "C"

@@ -195,9 +195,10 @@ SHUFFLE_TRANSPORT_CLASS = conf(
     valid_values=("device", "host"))
 SHUFFLE_COMPRESSION_CODEC = conf(
     "spark.rapids.tpu.shuffle.compression.codec", "none",
-    "Codec for host-path shuffle payloads: none/zstd (the host stand-in "
-    "for the reference's nvcomp LZ4).",
-    valid_values=("none", "zstd"))
+    "Codec for host-path shuffle payloads: none/zstd/lz4. lz4 is the "
+    "native C++ block codec (native/src/lz4.cpp, the nvcomp-LZ4 analog) "
+    "and requires the g++-built library.",
+    valid_values=("none", "zstd", "lz4"))
 SHUFFLE_PARTITIONS = conf(
     "spark.rapids.tpu.sql.shuffle.partitions", 0,
     "Number of reduce partitions for exchanges; 0 keeps the child's "

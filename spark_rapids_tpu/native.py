@@ -1,0 +1,82 @@
+"""ctypes loader for the native runtime library (native/libsrtpu.so).
+
+Reference analog: the JNI boundary to the cudf/nvcomp native code
+(§2.12) — kept out of the compute path (that's XLA's) and limited to the
+host runtime pieces the reference also kept native: currently the LZ4
+shuffle codec. Builds on demand with g++ and degrades to None when the
+toolchain or library is unavailable, so pure-python deployments still work.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        try:
+            root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            import sys
+
+            sys.path.insert(0, os.path.join(root, "native"))
+            try:
+                from build import build  # type: ignore[import-not-found]
+            finally:
+                sys.path.pop(0)
+            path = build()
+            lib = ctypes.CDLL(path)
+            lib.srtpu_lz4_bound.restype = ctypes.c_int
+            lib.srtpu_lz4_bound.argtypes = [ctypes.c_int]
+            lib.srtpu_lz4_compress.restype = ctypes.c_int
+            lib.srtpu_lz4_compress.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_char), ctypes.c_int]
+            lib.srtpu_lz4_decompress.restype = ctypes.c_int
+            lib.srtpu_lz4_decompress.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_char), ctypes.c_int]
+            _LIB = lib
+        except Exception:
+            _LIB = None
+        return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def lz4_compress(data: bytes) -> bytes:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable (g++ build failed?)")
+    if not data:
+        return b""
+    cap = lib.srtpu_lz4_bound(len(data))
+    buf = ctypes.create_string_buffer(cap)
+    n = lib.srtpu_lz4_compress(data, len(data), buf, cap)
+    if n <= 0:
+        raise RuntimeError("lz4 compression failed")
+    return buf.raw[:n]
+
+
+def lz4_decompress(data: bytes, out_size: int) -> bytes:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable (g++ build failed?)")
+    if out_size == 0:
+        return b""
+    buf = ctypes.create_string_buffer(out_size)
+    n = lib.srtpu_lz4_decompress(data, len(data), buf, out_size)
+    if n != out_size:
+        raise ValueError(f"lz4 payload corrupt ({n} != {out_size})")
+    return buf.raw

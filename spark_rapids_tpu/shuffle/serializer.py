@@ -75,8 +75,8 @@ def serialize_host_columns(
     codec: str = "none",
 ) -> bytes:
     """Serialize host columns (strings as object arrays) to wire bytes."""
-    head = struct.pack(
-        "<IBHI", MAGIC, 1 if codec == "zstd" else 0, len(cols), n)
+    flags = {"zstd": 1, "lz4": 2}.get(codec, 0)
+    head = struct.pack("<IBHI", MAGIC, flags, len(cols), n)
     for c, nm in zip(cols, names):
         head += _dtype_header(c.dtype, nm)
 
@@ -109,6 +109,13 @@ def serialize_host_columns(
         import zstandard
 
         payload = zstandard.ZstdCompressor(level=1).compress(payload)
+    elif codec == "lz4":
+        # native codec (the nvcomp-LZ4 analog, native/src/lz4.cpp); the
+        # raw size rides in front so decompression sizes exactly
+        from .. import native
+
+        payload = struct.pack("<Q", len(payload)) + native.lz4_compress(
+            payload)
     return head + payload
 
 
@@ -137,6 +144,11 @@ def deserialize_batch(data: bytes) -> ColumnarBatch:
         import zstandard
 
         payload = zstandard.ZstdDecompressor().decompress(payload)
+    elif flags & 2:
+        from .. import native
+
+        (raw_size,) = struct.unpack_from("<Q", payload, 0)
+        payload = native.lz4_decompress(payload[8:], raw_size)
 
     p = 0
     nvbytes = (n + 7) // 8
