@@ -75,10 +75,16 @@ class _MeshStage(TpuExec):
         return self.n_shards
 
     # -- staging -----------------------------------------------------------
-    def _stage_child(self, child: TpuExec) -> Tuple[List[jax.Array], np.ndarray, int]:
+    def _stage_child(self, child: TpuExec):
         """Materialize every child partition and lay rows onto the mesh:
-        returns (global (n*cap,) data/validity arrays per column, per-shard
-        counts, per-shard cap). Child partition p maps to shard p % n."""
+        returns (flat global arrays, per-shard counts, per-shard cap,
+        layout, str_max_lens). Child partition p maps to shard p % n.
+
+        layout[i] is ("f",) for a fixed column or ("s", char_cap) for a
+        string column (offsets/chars/validity planes); str_max_lens holds
+        the max byte length per string column (a STATIC bound the sort /
+        hash kernels need, computed host-side here — staging already
+        touches every byte)."""
         schema = child.output_schema
         per_shard: List[List[ColumnarBatch]] = [[] for _ in range(self.n_shards)]
         for p in range(child.num_partitions):
@@ -90,39 +96,114 @@ class _MeshStage(TpuExec):
         ]
         cap = bucket_rows(max(max(rows_per_shard), 1),
                           self.conf.shape_bucket_min)
-        ncols = len(schema.fields)
-        datas = [
-            np.zeros((self.n_shards, cap), f.dataType.to_numpy())
-            for f in schema.fields
-        ]
-        valids = [np.zeros((self.n_shards, cap), bool) for _ in range(ncols)]
+        fields = schema.fields
+        ncols = len(fields)
+        is_str = [T_is_string(f.dataType) for f in fields]
+        # gather host views once
+        host: List[List[tuple]] = [[] for _ in range(self.n_shards)]
         for s, bs in enumerate(per_shard):
-            pos = 0
             for b in bs:
                 n = int(b.num_rows)
-                for j, c in enumerate(b.columns):
-                    datas[j][s, pos:pos + n] = _np_of(c.data)[:n]
-                    valids[j][s, pos:pos + n] = _np_of(c.validity)[:n]
-                pos += n
-            counts[s] = pos
-        sh = row_sharding(self.mesh)
-        out: List[jax.Array] = []
+                row = []
+                for c in b.columns:
+                    if c.is_string:
+                        row.append((
+                            _np_of(c.offsets), _np_of(c.chars),
+                            _np_of(c.validity), n))
+                    else:
+                        row.append((_np_of(c.data), _np_of(c.validity), n))
+                host[s].append(row)
+            counts[s] = sum(int(b.num_rows) for b in bs)
+        # per string column: per-shard byte totals -> common char cap + sml
+        layout: List[tuple] = []
+        smls: List[int] = []
         for j in range(ncols):
-            out.append(jax.device_put(datas[j].reshape(-1), sh))
-            out.append(jax.device_put(valids[j].reshape(-1), sh))
-        return out, counts, cap
+            if not is_str[j]:
+                layout.append(("f",))
+                continue
+            max_bytes = 1
+            max_len = 1
+            for s in range(self.n_shards):
+                tot = 0
+                for row in host[s]:
+                    offs, _, _, n = row[j]
+                    tot += int(offs[n])
+                    if n:
+                        max_len = max(
+                            max_len, int((offs[1:n + 1] - offs[:n]).max()))
+                max_bytes = max(max_bytes, tot)
+            ccap = bucket_rows(max_bytes, 128)
+            layout.append(("s", ccap))
+            smls.append(max(4, bucket_rows(max_len, 4)))
+        # build global planes
+        planes: List[np.ndarray] = []
+        for j in range(ncols):
+            if layout[j][0] == "f":
+                d = np.zeros((self.n_shards, cap), fields[j].dataType.to_numpy())
+                v = np.zeros((self.n_shards, cap), bool)
+                for s in range(self.n_shards):
+                    pos = 0
+                    for row in host[s]:
+                        data, valid, n = row[j]
+                        d[s, pos:pos + n] = data[:n]
+                        v[s, pos:pos + n] = valid[:n]
+                        pos += n
+                planes.extend([d, v])
+            else:
+                ccap = layout[j][1]
+                o = np.zeros((self.n_shards, cap + 1), np.int32)
+                ch = np.zeros((self.n_shards, ccap), np.uint8)
+                v = np.zeros((self.n_shards, cap), bool)
+                for s in range(self.n_shards):
+                    pos = 0
+                    bpos = 0
+                    for row in host[s]:
+                        offs, chars, valid, n = row[j]
+                        nb = int(offs[n])
+                        o[s, pos + 1: pos + n + 1] = bpos + offs[1: n + 1]
+                        ch[s, bpos: bpos + nb] = chars[:nb]
+                        v[s, pos:pos + n] = valid[:n]
+                        pos += n
+                        bpos += nb
+                    o[s, pos + 1:] = bpos
+                planes.extend([o, ch, v])
+        sh = row_sharding(self.mesh)
+        out = [jax.device_put(a.reshape(-1), sh) for a in planes]
+        return out, counts, cap, tuple(layout), tuple(smls)
 
     def _emit(self, schema: StructType, global_cols: Sequence[jax.Array],
-              counts: np.ndarray, cap: int) -> List[Optional[ColumnarBatch]]:
-        """Split (n*cap,) outputs back into per-shard batches."""
+              counts: np.ndarray, cap: int,
+              layout=None) -> List[Optional[ColumnarBatch]]:
+        """Split flat global outputs back into per-shard batches. Shapes
+        per shard derive from each plane's global size / n_shards."""
+        if layout is None:
+            layout = tuple(
+                ("s",) if T_is_string(f.dataType) else ("f",)
+                for f in schema.fields)
         outs: List[Optional[ColumnarBatch]] = []
         for s in range(self.n_shards):
             n = int(counts[s])
             cols = []
-            for j, f in enumerate(schema.fields):
-                d = global_cols[2 * j][s * cap:(s + 1) * cap]
-                v = global_cols[2 * j + 1][s * cap:(s + 1) * cap]
-                cols.append(DeviceColumn(f.dataType, n, d, v))
+            gi = 0
+            for f, lay in zip(schema.fields, layout):
+                if lay[0] == "f":
+                    d, v = global_cols[gi], global_cols[gi + 1]
+                    gi += 2
+                    per = d.shape[0] // self.n_shards
+                    cols.append(DeviceColumn(
+                        f.dataType, n, d[s * per:(s + 1) * per],
+                        v[s * per:(s + 1) * per]))
+                else:
+                    o, ch, v = (global_cols[gi], global_cols[gi + 1],
+                                global_cols[gi + 2])
+                    gi += 3
+                    po = o.shape[0] // self.n_shards
+                    pc = ch.shape[0] // self.n_shards
+                    pv = v.shape[0] // self.n_shards
+                    cols.append(DeviceColumn(
+                        f.dataType, n, None, v[s * pv:(s + 1) * pv],
+                        offsets=o[s * po:(s + 1) * po],
+                        chars=ch[s * pc:(s + 1) * pc]))
             outs.append(ColumnarBatch(cols, schema, n))
         return outs
 

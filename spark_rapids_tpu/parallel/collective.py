@@ -12,9 +12,12 @@ host staging, no serialization: the wire format IS the column layout.
 Everything here is trace-safe inside shard_map: row counts stay device
 scalars throughout.
 
-Fixed-width columns only for now: string columns cross the single-host
-exchange (exec/exchange.py) until a two-phase (lengths, then bytes)
-collective lands.
+String columns cross as a second BYTE plane: rows are partition-sorted,
+so each target's bytes are one contiguous slice of the sorted chars buffer
+— lengths ride with the rows as an int32 column, the byte slices scatter
+into per-target byte blocks that all_to_all alongside the row blocks, and
+the receive side rebuilds offsets with a cumsum (the two-phase metadata/
+data split of the reference's UCX shuffle, §3.4).
 """
 from __future__ import annotations
 
@@ -24,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..expr.eval import ColV
+from ..expr.eval import ColV, StrV, Val
 from ..ops.filter_gather import live_of
 from ..shuffle.partition import partition_cols
 
@@ -70,9 +73,39 @@ def all_to_all_exchange(
         return z.at[dest].set(data, mode="drop")
 
     send: List[jax.Array] = []
+    layout: List[str] = []
+    byte_planes: List[Tuple[jax.Array, jax.Array, int]] = []
     for c in sorted_cols:
-        send.append(scatter_block(c.data))
-        send.append(scatter_block(c.validity))
+        if isinstance(c, StrV):
+            lens = jnp.where(
+                live_sorted, c.offsets[1:] - c.offsets[:-1], 0
+            ).astype(jnp.int32)
+            send.append(scatter_block(lens))
+            send.append(scatter_block(c.validity))
+            layout.append("s")
+            # rows are sorted by target, so target t's bytes are the
+            # contiguous slice [offsets_bytes[t], offsets_bytes[t+1])
+            nchar = int(c.chars.shape[0])
+            BB = nchar  # byte granule: the local char capacity
+            byte_off = jnp.take(
+                c.offsets, jnp.clip(offsets, 0, cap), mode="clip"
+            ).astype(jnp.int32)
+            bcounts = byte_off[1:] - byte_off[:-1]
+            ok = ok & ~jnp.any(bcounts > BB)
+            bpos = jnp.arange(nchar, dtype=jnp.int32)
+            btgt = rows_of_positions(byte_off, nchar)
+            bslot = bpos - jnp.take(byte_off, btgt)
+            in_data = bpos < byte_off[n_shards]
+            bdest = jnp.where(
+                in_data & (bslot < BB), btgt * BB + bslot,
+                jnp.int32(n_shards * BB))
+            bblocks = jnp.zeros(n_shards * BB, jnp.uint8).at[bdest].set(
+                c.chars, mode="drop")
+            byte_planes.append((bblocks, bcounts, BB))
+        else:
+            send.append(scatter_block(c.data))
+            send.append(scatter_block(c.validity))
+            layout.append("f")
 
     # 3) swap block b with shard b (counts ride along)
     recv = [
@@ -84,19 +117,53 @@ def all_to_all_exchange(
         jnp.minimum(counts, B).reshape(n_shards, 1), axis_name, 0, 0,
         tiled=False,
     ).reshape(n_shards)
+    recv_bytes = []
+    for bblocks, bcounts, BB in byte_planes:
+        rb = lax.all_to_all(
+            bblocks.reshape(n_shards, BB), axis_name, 0, 0, tiled=False
+        ).reshape(n_shards * BB)
+        rbc = lax.all_to_all(
+            jnp.minimum(bcounts, BB).reshape(n_shards, 1), axis_name, 0, 0,
+            tiled=False,
+        ).reshape(n_shards)
+        recv_bytes.append((rb, rbc, BB))
     ok = lax.psum(ok.astype(jnp.int32), axis_name) == n_shards
 
-    # 4) compact received blocks to the front
+    # 4) compact received row blocks to the front
     j = jnp.arange(n_shards * B, dtype=jnp.int32)
     block = j // B
     live_recv = (j % B) < jnp.take(recv_counts, block)
-    from ..ops.filter_gather import filter_cols
+    from ..ops.filter_gather import compaction_indices, filter_cols
 
-    out_cols = [
+    pair_cols = [
         ColV(recv[2 * i], recv[2 * i + 1]) for i in range(len(sorted_cols))
     ]
-    compacted, total = filter_cols(out_cols, live_recv, None)
-    return compacted, total, ok
+    compacted, total = filter_cols(pair_cols, live_recv, None)
+
+    # 5) rebuild string columns: offsets from the exchanged lengths; chars
+    # compacted from the byte blocks (block order == compacted row order)
+    out_cols: List[Val] = []
+    si = 0
+    for kind, cc in zip(layout, compacted):
+        if kind == "f":
+            out_cols.append(cc)
+            continue
+        rb, rbc, BB = recv_bytes[si]
+        si += 1
+        lens = jnp.where(
+            jnp.arange(cc.data.shape[0], dtype=jnp.int32) < total,
+            cc.data.astype(jnp.int32), 0)
+        new_offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(lens).astype(jnp.int32)])
+        bj = jnp.arange(n_shards * BB, dtype=jnp.int32)
+        blive = (bj % BB) < jnp.take(rbc, bj // BB)
+        bidx, btotal = compaction_indices(blive)
+        chars = jnp.take(rb, bidx, mode="clip")
+        chars = jnp.where(
+            jnp.arange(chars.shape[0], dtype=jnp.int32) < btotal,
+            chars, jnp.uint8(0))
+        out_cols.append(StrV(new_offsets, chars, cc.validity))
+    return out_cols, total, ok
 
 
 def gather_all(

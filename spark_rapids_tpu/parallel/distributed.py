@@ -42,6 +42,7 @@ def dist_groupby(
     num_rows: Union[int, jax.Array],
     axis_name: str,
     n_shards: int,
+    str_max_lens: Sequence[int] = (),
 ) -> Tuple[List[ColV], List[ColV], jax.Array]:
     """PARTIAL local aggregate -> key-hash all_to_all -> FINAL merge.
 
@@ -53,10 +54,13 @@ def dist_groupby(
     """
     # PARTIAL: local groupby shrinks rows before they cross the wire
     pkeys, paggs, pn = groupby_ops.groupby_agg(
-        key_cols, key_dtypes, value_cols, list(update_ops), num_rows)
+        key_cols, key_dtypes, value_cols, list(update_ops), num_rows,
+        str_max_lens)
 
-    # exchange by key hash (same murmur3+pmod as the single-host exchange)
-    h = hashing.murmur3(list(pkeys), list(key_dtypes))
+    # exchange by key hash (same murmur3+pmod as the single-host exchange);
+    # string keys cross via the byte plane of the collective
+    h = hashing.murmur3(list(pkeys), list(key_dtypes),
+                        str_max_lens=str_max_lens)
     pids = hashing.partition_ids(h, n_shards)
     all_cols = list(pkeys) + list(paggs)
     recvd, rn, _ok = all_to_all_exchange(
@@ -66,7 +70,7 @@ def dist_groupby(
 
     # FINAL: merge partial buffers locally (keys now shard-disjoint)
     return groupby_ops.groupby_agg(
-        rkeys, key_dtypes, list(raggs), list(merge_ops), rn)
+        rkeys, key_dtypes, list(raggs), list(merge_ops), rn, str_max_lens)
 
 
 def _sample_bounds(
@@ -116,6 +120,7 @@ def dist_sort(
     num_rows: Union[int, jax.Array],
     axis_name: str,
     n_shards: int,
+    str_max_lens: Sequence[int] = (),
 ) -> Tuple[List[ColV], jax.Array]:
     """Sample-range exchange + local sort: shard i's rows all precede
     shard i+1's in the requested order (the global sort contract)."""
@@ -126,7 +131,7 @@ def dist_sort(
     # local sort FIRST: evenly-spaced positions then sample true quantiles,
     # and the post-exchange sort of mostly-sorted runs is cheap
     perm, sorted_radix = sort_with_radix_keys(
-        key_cols, key_dtypes, orders, live)
+        key_cols, key_dtypes, orders, live, str_max_lens)
     live_sorted = jnp.take(live, perm, mode="clip")
     sorted_cols = gather(cols, perm, live_sorted)
 
@@ -139,7 +144,8 @@ def dist_sort(
         sorted_cols, pid, live_sorted, axis_name, n_shards)
 
     rkeys = [recvd[i] for i in key_indices]
-    perm2, _ = sort_with_radix_keys(rkeys, key_dtypes, orders, rn)
+    perm2, _ = sort_with_radix_keys(rkeys, key_dtypes, orders, rn,
+                                    str_max_lens)
     rcap = recvd[0].validity.shape[0]
     live2 = jnp.arange(rcap, dtype=jnp.int32) < rn
     live2_sorted = jnp.take(live2, perm2, mode="clip")
