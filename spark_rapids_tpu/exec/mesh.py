@@ -98,7 +98,7 @@ class _MeshStage(TpuExec):
                           self.conf.shape_bucket_min)
         fields = schema.fields
         ncols = len(fields)
-        is_str = [T_is_string(f.dataType) for f in fields]
+        is_str = [T.is_string(f.dataType) for f in fields]
         # gather host views once
         host: List[List[tuple]] = [[] for _ in range(self.n_shards)]
         for s, bs in enumerate(per_shard):
@@ -178,7 +178,7 @@ class _MeshStage(TpuExec):
         per shard derive from each plane's global size / n_shards."""
         if layout is None:
             layout = tuple(
-                ("s",) if T_is_string(f.dataType) else ("f",)
+                ("s",) if T.is_string(f.dataType) else ("f",)
                 for f in schema.fields)
         outs: List[Optional[ColumnarBatch]] = []
         for s in range(self.n_shards):
@@ -274,7 +274,7 @@ class TpuMeshAggregateExec(_MeshStage):
 
     def _materialize(self) -> None:
         child = self.children[0]
-        global_cols, counts, cap = self._stage_child(child)
+        global_cols, counts, cap, _layout, _smls = self._stage_child(child)
         nk = len(self._key_fields)
         key_dtypes = list(self._key_dtypes())
         bound_keys = tuple(self._bound_keys)
@@ -368,7 +368,7 @@ class TpuMeshSortExec(_MeshStage):
 
     def _materialize(self) -> None:
         child = self.children[0]
-        global_cols, counts, cap = self._stage_child(child)
+        global_cols, counts, cap, _layout, _smls = self._stage_child(child)
         key_dtypes = [
             self._schema.fields[i].dataType for i in self.key_indices
         ]
@@ -433,8 +433,8 @@ class TpuMeshHashJoinExec(_MeshStage):
 
     def _materialize(self) -> None:
         left, right = self.children
-        l_cols, l_counts, lcap = self._stage_child(left)
-        r_cols, r_counts, rcap = self._stage_child(right)
+        l_cols, l_counts, lcap, _llay, _lsml = self._stage_child(left)
+        r_cols, r_counts, rcap, _rlay, _rsml = self._stage_child(right)
         n_shards, mesh = self.n_shards, self.mesh
         l_ix, r_ix, kd = list(self.left_ix), list(self.right_ix), list(
             self._key_dtypes)

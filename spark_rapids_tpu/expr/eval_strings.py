@@ -428,6 +428,15 @@ def _pad(expr, c: StrV, cap: int, left: bool) -> StrV:
     if L <= 0:
         off = jnp.zeros(cap + 1, jnp.int32)
         return StrV(off, jnp.zeros(1, jnp.uint8), c.validity)
+    # the kernel allocates cap*4*L output bytes; an adversarial literal
+    # pad length would OOM the device. The guard must depend on L ONLY:
+    # L is a plan-time literal, so the tpu_supports probe (which traces
+    # with a tiny cap) sees the same value and the plan genuinely falls
+    # back to CPU — a cap-dependent guard would pass the probe and then
+    # raise uncaught inside the jit at execution time
+    if L > 4096:
+        raise UnsupportedExpressionError(
+            f"pad length {L} exceeds the device kernel bound 4096")
     pb = pad.encode("utf-8")
     pad_offs = [0]
     for ch in pad:

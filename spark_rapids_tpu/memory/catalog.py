@@ -175,23 +175,27 @@ class BufferCatalog:
                 if self.conf.get(MEMORY_DEBUG):
                     log.info("spilled %d B to host (device=%d B)",
                              freed, self._device_bytes)
-        # host tier over its cap: push oldest to disk
+        # host tier over its cap: push oldest to disk. The victim list is
+        # snapshotted under the lock, but the loop re-reads the LIVE byte
+        # count under the lock each iteration so concurrent spillers stop
+        # as soon as the tier is under cap instead of each pushing the full
+        # overage to disk.
         host_cap = self.conf.get(HOST_SPILL_STORAGE_SIZE)
-        if self._host_bytes > host_cap:
+        with self._lock:
+            hosts = sorted(
+                (h for h in self._buffers.values()
+                 if h.tier == TIER_HOST),
+                key=lambda h: h.priority,
+            ) if self._host_bytes > host_cap else []
+        for h in hosts:
             with self._lock:
-                hosts = sorted(
-                    (h for h in self._buffers.values()
-                     if h.tier == TIER_HOST),
-                    key=lambda h: h.priority,
-                )
-            for h in hosts:
                 if self._host_bytes <= host_cap:
                     break
-                freed = h.spill_to_disk(self._disk_dir())
-                if freed:
-                    with self._lock:
-                        self._host_bytes -= freed
-                        self.metrics.host_to_disk += 1
+            freed = h.spill_to_disk(self._disk_dir())
+            if freed:
+                with self._lock:
+                    self._host_bytes -= freed
+                    self.metrics.host_to_disk += 1
 
     def _disk_dir(self) -> str:
         if self._spill_dir is None:
@@ -279,11 +283,17 @@ class SpillableHandle:
 
     # -- lifecycle (Arm idiom: with_resource(SpillableHandle(...))) --------
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
-        self._catalog.unregister(self._id)
-        self._device = None
-        self._host = None
-        if self._disk_path and os.path.exists(self._disk_path):
-            os.unlink(self._disk_path)
+        # taken under the tier lock so a close can't interleave with an
+        # in-flight spill: unregister() reads self.tier to pick which byte
+        # counter to decrement, and the spill loop decrements the same
+        # counter when spill_to_* returns nonzero — serializing the two
+        # keeps the accounting single-entry either way
+        with self._tlock:
+            if self._closed:
+                return
+            self._closed = True
+            self._catalog.unregister(self._id)
+            self._device = None
+            self._host = None
+            if self._disk_path and os.path.exists(self._disk_path):
+                os.unlink(self._disk_path)

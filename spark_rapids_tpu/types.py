@@ -355,3 +355,7 @@ def promote(a: DataType, b: DataType) -> DataType:
 
 def is_fixed_width(dt: DataType) -> bool:
     return not isinstance(dt, (StringType, BinaryType, ArrayType, StructType, NullType))
+
+
+def is_string(dt: DataType) -> bool:
+    return isinstance(dt, StringType)
