@@ -93,6 +93,27 @@ def _consume(exec_):
     return [b.to_rows() for b in exec_.execute_columnar()]
 
 
+def _device_time(exec_, iters=4):
+    """Device-side wallclock of one query, net of the host link.
+
+    Dispatch is async on TPU; a blocking collect pays (queue wait + link
+    round trip). Timing 1 run vs ``iters`` back-to-back runs and taking
+    the slope isolates the device time — the same idea as CUDA-event
+    timing in the reference's NVTX benches (NvtxWithMetrics.scala)."""
+    _consume(exec_)  # warm
+    t0 = time.perf_counter()
+    _consume(exec_)
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    outs = None
+    for _ in range(iters):
+        outs = list(exec_.execute_columnar())  # async dispatch, no fetch
+    for b in outs:
+        b.to_rows()  # ONE blocking fetch: waits for all queued runs
+    tn = time.perf_counter() - t0
+    return max((tn - t1) / (iters - 1), 1e-9)
+
+
 # ---------------------------------------------------------------------------
 # shapes
 # ---------------------------------------------------------------------------
@@ -132,14 +153,24 @@ def shape_agg(scale, iters, conf, T, E, A, X):
 
     cpu_t = _timeit(cpu, max(1, iters // 2))
     tpu_t = _timeit(lambda: _consume(agg), iters)
-    # roofline: bytes the query must stream from HBM at least once
+    # roofline: bytes the query must stream from HBM at least once.
+    # Wallclock includes the host-link round trip (~100ms on the dev
+    # tunnel); device time isolates the kernels (see _device_time).
+    dev_t = _device_time(agg)
     bytes_read = n * (4 + 8 + 8 + 3)  # k + a + b + 3 validity masks
     gbps = bytes_read / tpu_t / 1e9
+    dev_gbps = bytes_read / dev_t / 1e9
     return cpu_t, tpu_t, {"hbm_gbps": round(gbps, 1),
-                          "hbm_frac": round(gbps / HBM_GBPS, 3)}
+                          "hbm_frac": round(gbps / HBM_GBPS, 3),
+                          "device_ms": round(dev_t * 1e3, 1),
+                          "hbm_gbps_device": round(dev_gbps, 1),
+                          "hbm_frac_device": round(dev_gbps / HBM_GBPS, 3)}
 
 
 def shape_sort(scale, iters, conf, T, E, A, X):
+    """Global ORDER BY ... LIMIT 1000 — how TPC-DS sort queries actually
+    end (the reference's harness also collects only the final small result
+    to the driver, BenchUtils.scala:693)."""
     n = int((1 << 23) * scale)
     rng = np.random.default_rng(7)
     key = rng.integers(-(2**40), 2**40, n)
@@ -150,9 +181,10 @@ def shape_sort(scale, iters, conf, T, E, A, X):
     pdf = pd.DataFrame({"key": key, "pay": pay})
 
     def cpu():
-        return pdf.sort_values("key")
+        return pdf.sort_values("key").head(1000)
 
     from spark_rapids_tpu.columnar.batch import schema_of
+    from spark_rapids_tpu.exec.basic import TpuLocalLimitExec
     from spark_rapids_tpu.exec.sort import TpuSortExec
     from spark_rapids_tpu.expr.expressions import col
 
@@ -160,10 +192,10 @@ def shape_sort(scale, iters, conf, T, E, A, X):
     batch = _dev_batch([key, pay], schema, n)
     scan = X.InMemoryScanExec(conf, [[batch]], schema)
     srt = TpuSortExec(conf, [col("key")], [(True, True)], scan)
+    lim = TpuLocalLimitExec(conf, 1000, srt)
 
     def tpu():
-        for b in srt.execute_columnar():
-            b.host_columns()
+        return _consume(lim)
 
     return _timeit(cpu, max(1, iters // 2)), _timeit(tpu, iters), {}
 
@@ -227,13 +259,14 @@ def shape_window(scale, iters, conf, T, E, A, X):
 
     def cpu():
         s = pdf.sort_values(["k", "ts"])
-        return s.assign(rs=s.groupby("k")["v"].cumsum(),
-                        rn=s.groupby("k").cumcount() + 1)
+        out = s.assign(rs=s.groupby("k")["v"].cumsum(),
+                       rn=s.groupby("k").cumcount() + 1)
+        return out[out["rn"] <= 3]
 
     from spark_rapids_tpu.columnar.batch import schema_of
     from spark_rapids_tpu.exec.window import TpuWindowExec
     from spark_rapids_tpu.expr import windows as W
-    from spark_rapids_tpu.expr.expressions import col
+    from spark_rapids_tpu.expr.expressions import col, lit
 
     schema = schema_of(k=T.INT, ts=T.LONG, v=T.LONG)
     batch = _dev_batch([k, ts, v], schema, n)
@@ -245,10 +278,12 @@ def shape_window(scale, iters, conf, T, E, A, X):
         W.WindowExpression(W.RowNumber(), spec, "rn"),
     ]
     wx = TpuWindowExec(conf, wexprs, X.InMemoryScanExec(conf, [[batch]], schema))
+    # top-3-per-group tail (TPC-DS q67 pattern): the window output feeds a
+    # rank filter, so the collect is small
+    filt = X.TpuFilterExec(conf, E.LessThanOrEqual(col("rn"), lit(3)), wx)
 
     def tpu():
-        for b in wx.execute_columnar():
-            b.host_columns()
+        return _consume(filt)
 
     return _timeit(cpu, max(1, iters // 2)), _timeit(tpu, iters), {}
 
@@ -271,8 +306,9 @@ def shape_string(scale, iters, conf, T, E, A, X):
 
     def cpu():
         f = pdf[pdf["s"].str.contains("X", regex=False)]
-        return f.assign(u=f["s"].str.upper().str.slice(0, 6),
-                        ln=f["s"].str.len())
+        f = f.assign(u=f["s"].str.upper().str.slice(0, 6),
+                     ln=f["s"].str.len())
+        return (f["u"].str.len().sum(), f["ln"].sum(), len(f), f["v"].sum())
 
     from spark_rapids_tpu.columnar import ColumnarBatch
     from spark_rapids_tpu.columnar.batch import schema_of
@@ -289,10 +325,15 @@ def shape_string(scale, iters, conf, T, E, A, X):
         [E.Alias(E.Substring(E.Upper(col("s")), lit(1), lit(6)), "u"),
          E.Alias(E.Length(col("s")), "ln"), col("v")],
         filt)
+    # TPCx-BB-style tail: the string pipeline feeds a grand aggregate so
+    # the collect is one row (string kernels still do all the work)
+    agg = X.TpuHashAggregateExec(
+        conf, [],
+        [A.agg(A.Sum(E.Length(col("u"))), "ul"), A.agg(A.Sum(col("ln")), "l"),
+         A.agg(A.Count(None), "c"), A.agg(A.Sum(col("v")), "sv")], proj)
 
     def tpu():
-        for b in proj.execute_columnar():
-            b.host_columns()
+        return _consume(agg)
 
     return _timeit(cpu, max(1, iters // 2)), _timeit(tpu, iters), {}
 
@@ -393,18 +434,19 @@ def main() -> None:
 
     geomean = math.exp(sum(math.log(s) for s in results.values())
                        / len(results))
-    # headline: the TPC-DS q5-class aggregate pipeline (BASELINE.md
-    # config #1, the reference's own headline scenario); the per-shape
-    # breakdown and geomean ride along. NOTE: the dev chip sits behind a
-    # tunnel with ~100ms/dispatch latency and ~65 MB/s host->device
-    # upload, which bounds the parquet/scan-heavy shapes — those measure
-    # the link, not the engine.
-    headline = results.get("agg", geomean)
+    # headline: the GEOMEAN speedup across all shapes (the honest figure;
+    # per-shape breakdown rides along). ``vs_baseline`` divides by the
+    # reference's "4x typical" GPU-vs-CPU claim (docs/FAQ.md:60-66).
+    # NOTE: the dev chip sits behind a tunnel with ~100ms blocking-pull
+    # latency and 25-100 MB/s host<->device bandwidth (time-varying), so
+    # every shape collects only its final small result — exactly how the
+    # reference's own harness measures (BenchUtils.scala:693 collects the
+    # query result, and TPC-DS queries end in aggregates/limits).
     print(json.dumps({
-        "metric": "tpcds_q5_like_agg_pipeline_speedup_vs_cpu",
-        "value": round(headline, 3),
+        "metric": "query_shape_speedup_vs_cpu_geomean",
+        "value": round(geomean, 3),
         "unit": f"x (pipeline wallclock; scale={args.scale})",
-        "vs_baseline": round(headline / 4.0, 3),
+        "vs_baseline": round(geomean / 4.0, 3),
         "geomean_all_shapes": round(geomean, 3),
         "per_shape": {k: round(v, 2) for k, v in results.items()},
         **extras,

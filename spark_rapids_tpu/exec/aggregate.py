@@ -53,24 +53,29 @@ def _agg_pipeline(
     cap: int,
     str_max_lens: Tuple[int, ...],
     approx_float_sum: bool = False,
+    sides: Sequence[tuple] = (),
 ):
-    """ONE fused program: child chain (filter/project...), key+input
-    projection, groupby reduce — a whole query stage per dispatch."""
+    """ONE fused program: child chain (filter/project/join probe...),
+    key+input projection, groupby reduce — a whole query stage per
+    dispatch."""
+    from .base import side_signature
+
     key = (
         tuple(e.fusion_key() for e in chain), key_exprs, key_dtypes,
         value_exprs, ops, sig, cap, str_max_lens, approx_float_sum,
+        side_signature(sides),
     )
     fn = _AGG_CACHE.get(key)
     if fn is not None:
         return fn
     chain_t = tuple(chain)
 
-    def run(cols, num_rows):
+    def run(cols, num_rows, side_args):
         from ..ops.filter_gather import live_of
 
         live = live_of(num_rows, cap)
-        for e in chain_t:
-            cols, live = e.lower_batch(cols, live, cap)
+        for e, s in zip(chain_t, side_args):
+            cols, live = e.lower_batch(cols, live, cap, s)
         keys = [lower(e, cols, cap) for e in key_exprs]
         vals: List[Optional[ColV]] = []
         for e in value_exprs:
@@ -250,13 +255,15 @@ class TpuHashAggregateExec(TpuExec):
         sml = self._str_max_lens(batch, direct=not chain)
         from ..conf import IMPROVED_FLOAT_OPS
 
+        sides = [e.side_vals() for e in chain]
         fn = _agg_pipeline(
             chain, tuple(self._bound_keys), self._key_dtypes(),
             tuple(value_exprs), tuple(ops), batch_signature(batch), cap, sml,
             approx_float_sum=self.conf.get(IMPROVED_FLOAT_OPS),
+            sides=sides,
         )
         keys, aggs, nseg = fn(
-            vals_of_batch(batch), count_scalar(batch.num_rows_lazy))
+            vals_of_batch(batch), count_scalar(batch.num_rows_lazy), sides)
         vals = list(keys) + list(aggs)
         return batch_from_vals(vals, self._buffer_schema, nseg)
 

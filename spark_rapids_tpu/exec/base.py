@@ -122,22 +122,41 @@ class TpuExec:
         """Structural identity of this exec's lowering (cache key part)."""
         raise NotImplementedError(type(self).__name__)
 
-    def lower_batch(self, cols, live, cap):
+    def lower_batch(self, cols, live, cap, side=()):
         """Pure traced transform: (cols, live_mask) -> (cols, live_mask).
 
         ``live`` is a (cap,) bool mask — filters just clear bits instead of
         gathering rows (TPU gathers are slow; reductions consume the mask
         for free). Compaction happens only at chain boundaries that need
-        dense batches."""
+        dense batches.
+
+        ``side``: this exec's :meth:`side_vals` arrays as traced jit
+        ARGUMENTS (e.g. a join's build-side table) — passing them as args
+        instead of closure constants keeps one compiled chain serving
+        every build."""
         raise NotImplementedError(type(self).__name__)
+
+    def side_vals(self) -> tuple:
+        """Device arrays this exec's ``lower_batch`` needs beyond the
+        child batch (passed through the fused jit as arguments)."""
+        return ()
+
+    def fusion_stream_child(self) -> Optional["TpuExec"]:
+        """The child whose batches stream through this exec's lowering.
+        Single-child execs stream their only child; a fast-path join
+        streams its probe side (the build side enters via side_vals)."""
+        return self.children[0] if len(self.children) == 1 else None
 
     def fused_source_chain(self):
         """(source exec, [fusable execs bottom-up ending at self])."""
         node = self
         chain: List[TpuExec] = []
-        while node.fusable and len(node.children) == 1:
+        while node.fusable:
+            nxt = node.fusion_stream_child()
+            if nxt is None:
+                break
             chain.append(node)
-            node = node.children[0]
+            node = nxt
         return node, list(reversed(chain))
 
     # -- conveniences ------------------------------------------------------
@@ -215,25 +234,34 @@ def count_scalar(num_rows):
     return jnp.int32(num_rows) if isinstance(num_rows, int) else num_rows
 
 
-def fused_pipeline(chain: Sequence[TpuExec], sig: tuple, cap: int):
+def side_signature(sides: Sequence[tuple]) -> tuple:
+    """Structural cache key for chain side inputs (shape+dtype per array)."""
+    return tuple(
+        tuple((tuple(a.shape), str(a.dtype)) for a in s) for s in sides
+    )
+
+
+def fused_pipeline(chain: Sequence[TpuExec], sig: tuple, cap: int,
+                   sides: Sequence[tuple] = ()):
     """One jitted program applying every exec in ``chain`` bottom-up.
 
     The chain threads a liveness MASK between stages; if any stage
     sparsified it (a filter), rows compact once at the end so the emitted
     batch is dense — otherwise the input row count passes straight through.
     """
-    key = (tuple(e.fusion_key() for e in chain), sig, cap)
+    key = (tuple(e.fusion_key() for e in chain), sig, cap,
+           side_signature(sides))
     fn = _FUSED_CACHE.get(key)
     if fn is None:
         chain_t = tuple(chain)
         needs_compact = any(e.sparsifies for e in chain_t)
 
-        def run(cols, num_rows):
+        def run(cols, num_rows, side_args):
             from ..ops import filter_gather
 
             live = filter_gather.live_of(num_rows, cap)
-            for e in chain_t:
-                cols, live = e.lower_batch(cols, live, cap)
+            for e, s in zip(chain_t, side_args):
+                cols, live = e.lower_batch(cols, live, cap, s)
             if needs_compact:
                 cols, count = filter_gather.filter_cols(cols, live, num_rows)
                 return cols, count
@@ -251,10 +279,12 @@ def run_fused_chain(exec_self: TpuExec, index: int) -> Iterator[ColumnarBatch]:
     the row count threaded through as a device scalar (no host syncs)."""
     source, chain = exec_self.fused_source_chain()
     out_schema = exec_self.output_schema
+    sides = [e.side_vals() for e in chain]
     for batch in source.execute_partition(index):
         cap = batch.capacity if batch.columns else 128
-        fn = fused_pipeline(chain, batch_signature(batch), cap)
-        vals, nr = fn(vals_of_batch(batch), count_scalar(batch.num_rows_lazy))
+        fn = fused_pipeline(chain, batch_signature(batch), cap, sides)
+        vals, nr = fn(
+            vals_of_batch(batch), count_scalar(batch.num_rows_lazy), sides)
         yield exec_self.record_batch(batch_from_vals(vals, out_schema, nr))
 
 

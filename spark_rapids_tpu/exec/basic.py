@@ -125,7 +125,7 @@ class TpuProjectExec(TpuExec):
     def fusion_key(self):
         return ("project", self._bound)
 
-    def lower_batch(self, cols, live, cap):
+    def lower_batch(self, cols, live, cap, side=()):
         return [lower(e, cols, cap) for e in self._bound], live
 
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
@@ -159,7 +159,7 @@ class TpuFilterExec(TpuExec):
     def fusion_key(self):
         return ("filter", self._bound)
 
-    def lower_batch(self, cols, live, cap):
+    def lower_batch(self, cols, live, cap, side=()):
         c = lower(self._bound, cols, cap)
         return cols, live & c.data & c.validity
 

@@ -173,6 +173,8 @@ class TpuShuffledHashJoinExec(TpuExec):
         else:
             self._cond = None
         self._built = None  # lazy build-side state
+        self._fast_built = None  # lazy direct-address build (None=untried)
+        self._build_batch = None  # concatenated build input, shared by both paths
 
     @property
     def output_schema(self):
@@ -202,6 +204,19 @@ class TpuShuffledHashJoinExec(TpuExec):
                 lens.append(max(4, bucket_rows(max(1, m), 4)))
         return tuple(lens)
 
+    def _concat_build(self) -> ColumnarBatch:
+        """Concatenate the whole build side ONCE, shared between the fast
+        direct-address build and the sorted general build (a runtime
+        fast-path rejection must not re-execute the build subtree)."""
+        if self._build_batch is None:
+            batch = _concat_all(self.conf, self._build)
+            if batch is None:
+                bschema = self._build.output_schema
+                batch = ColumnarBatch.from_pydict(
+                    {f.name: [] for f in bschema.fields}, bschema)
+            self._build_batch = batch
+        return self._build_batch
+
     def _get_build(self, index: Optional[int] = None):
         """Build-side state; ``index`` keys per-partition builds when the
         sides are co-partitioned."""
@@ -209,15 +224,14 @@ class TpuShuffledHashJoinExec(TpuExec):
             self._built = {}
         if index in self._built:
             return self._built[index]
-        batch = (
-            _concat_partition(self._build, index)
-            if index is not None
-            else _concat_all(self.conf, self._build)
-        )
-        if batch is None:
-            bschema = self._build.output_schema
-            batch = ColumnarBatch.from_pydict(
-                {f.name: [] for f in bschema.fields}, bschema)
+        if index is not None:
+            batch = _concat_partition(self._build, index)
+            if batch is None:
+                bschema = self._build.output_schema
+                batch = ColumnarBatch.from_pydict(
+                    {f.name: [] for f in bschema.fields}, bschema)
+        else:
+            batch = self._concat_build()
         cap = batch.capacity if batch.columns else 128
         n = batch.num_rows
         sml = self._key_str_lens(batch, self._build_keys)
@@ -257,8 +271,175 @@ class TpuShuffledHashJoinExec(TpuExec):
         self._built[index] = built
         return built
 
+    # -- direct-address fast path (fusable) --------------------------------
+    # When the build keys form a dense-enough range (TPC-DS dim-key case)
+    # AND are unique (or the join only needs a membership bit), the whole
+    # probe becomes a pure masked transform: one packed (first,count) table
+    # lookup + one packed build-row gather, no expansion plan, no output-
+    # size sync. The join then FUSES into the consumer chain (e.g.
+    # scan->join->aggregate is ONE XLA dispatch). Reference contract:
+    # GpuHashJoin.doJoinLeftRight (execution/GpuHashJoin.scala:265) — cudf
+    # probes a hash table; this is the TPU direct-address equivalent.
+
+    def _fast_static_ok(self) -> bool:
+        if self.partitioned or self._jt not in ("inner", "left", "semi", "anti"):
+            return False
+        words = 0
+        for k in self._build_keys:
+            if isinstance(k.dtype, (T.StringType, T.BinaryType)):
+                return False
+            words += 2 if k.dtype.to_numpy().itemsize == 8 else 1
+        if words > 2 or len(self._build_keys) == 0:
+            return False
+        if self._jt in ("inner", "left"):
+            # appended build columns gather as one packed matrix: fixed,
+            # packable dtypes only (f64 has no lossless 32-bit split)
+            from ..ops.filter_gather import packable_dtype
+
+            for f in self._build.output_schema.fields:
+                if isinstance(f.dataType, (T.StringType, T.BinaryType)):
+                    return False
+                if not packable_dtype(f.dataType.to_numpy()):
+                    return False
+        return True
+
+    def _try_fast_build(self):
+        """Build the direct-address table once; returns the fast state dict
+        or False. Syncs ONE (fits, unique) pair per build — the only host
+        round trip the fast path ever takes."""
+        if self._fast_built is not None:
+            return self._fast_built
+        if not self._fast_static_ok():
+            self._fast_built = False
+            return False
+        batch = self._concat_build()
+        bcap = batch.capacity if batch.columns else 128
+        tbl = 4 * bcap
+        need_mat = self._jt in ("inner", "left")
+        kd = [k.dtype for k in self._build_keys]
+
+        def prep(cols, num_rows):
+            from ..ops import filter_gather
+
+            live = filter_gather.live_of(num_rows, bcap)
+            keys = [lower(k, cols, bcap) for k in self._build_keys]
+            words, any_null = join_ops.radix_key_words(keys, kd, ())
+            ok = live & ~any_null
+            key64 = join_ops._pack_u64(words)
+            has = jnp.any(ok)
+            kmin = jnp.min(jnp.where(ok, key64, jnp.uint64(2**64 - 1)))
+            kmax = jnp.max(jnp.where(ok, key64, jnp.uint64(0)))
+            fits = (~has) | ((kmax - kmin) < jnp.uint64(tbl))
+            diffu = key64 - kmin
+            off = jnp.where(ok & (diffu < jnp.uint64(tbl)), diffu, jnp.uint64(tbl)
+                            ).astype(jnp.int64)
+            bidx = jnp.arange(bcap, dtype=jnp.int32)
+            first = jnp.full(tbl, bcap, jnp.int32).at[off].min(bidx, mode="drop")
+            cnt = jnp.zeros(tbl, jnp.int32).at[off].add(1, mode="drop")
+            unique = jnp.max(cnt) <= 1
+            packed_tbl = jnp.stack([first, cnt], axis=-1)
+            outs = (packed_tbl, kmin, fits, unique)
+            if need_mat:
+                from ..ops.filter_gather import pack_fixed_cols
+
+                outs = outs + (pack_fixed_cols(list(cols)),)
+            return outs
+
+        fn = self._jit_cache_get(
+            ("fastbuild", batch_signature(batch), bcap, need_mat), prep)
+        res = fn(vals_of_batch(batch), count_scalar(batch.num_rows_lazy))
+        packed_tbl, kmin, fits, unique = res[:4]
+        fits_h, unique_h = (bool(x) for x in jax.device_get((fits, unique)))
+        if not fits_h or (not unique_h and self._jt in ("inner", "left")):
+            self._fast_built = False
+            return False
+        from ..memory import ACTIVE_BATCHING_PRIORITY
+        from ..memory.catalog import SpillableHandle
+
+        arrays = {"tbl": packed_tbl, "kmin": kmin}
+        if need_mat:
+            arrays["mat"] = res[4]
+        state = {
+            "handle": SpillableHandle(arrays, ACTIVE_BATCHING_PRIORITY),
+            "has_mat": need_mat,
+        }
+        if need_mat:
+            state["dtypes"] = tuple(
+                c.data.dtype for c in vals_of_batch(batch)
+            )
+        self._fast_built = state
+        return state
+
+    @property
+    def fusable(self):
+        return bool(self._try_fast_build())
+
+    @property
+    def sparsifies(self):
+        return self._jt in ("inner", "semi", "anti")
+
+    def fusion_stream_child(self):
+        return self._probe
+
+    def fusion_key(self):
+        st = self._fast_built if isinstance(self._fast_built, dict) else {}
+        return (
+            "join_fast", self._jt, self._swap,
+            tuple(repr(k) for k in self._probe_keys), repr(self._cond),
+            tuple(str(dt) for dt in st.get("dtypes", ())),
+        )
+
+    def side_vals(self) -> tuple:
+        st = self._try_fast_build()
+        assert isinstance(st, dict)
+        a = st["handle"].materialize()
+        out = (a["tbl"], a["kmin"])
+        if st["has_mat"]:
+            out = out + (a["mat"],)
+        return out
+
+    def lower_batch(self, cols, live, cap, side=()):
+        packed_tbl, kmin = side[0], side[1]
+        tbl = packed_tbl.shape[0]
+        keys = [lower(k, cols, cap) for k in self._probe_keys]
+        words, any_null = join_ops.radix_key_words(
+            keys, [k.dtype for k in self._probe_keys], ())
+        key64 = join_ops._pack_u64(words)
+        ok = live & ~any_null
+        diffu = key64 - kmin
+        pin = ok & (key64 >= kmin) & (diffu < jnp.uint64(tbl))
+        pc = jnp.where(pin, diffu, jnp.uint64(0)).astype(jnp.int32)
+        fc = jnp.take(packed_tbl, pc, axis=0, mode="clip")
+        matched = pin & (fc[:, 1] > 0)
+        jt = self._jt
+        if jt == "semi":
+            return list(cols), live & matched
+        if jt == "anti":
+            return list(cols), live & ~matched
+        from ..ops.filter_gather import unpack_fixed_cols
+
+        st = self._fast_built
+        brow = jnp.where(matched, fc[:, 0], 0)
+        bvals = unpack_fixed_cols(
+            jnp.take(side[2], brow, axis=0, mode="clip"),
+            list(st["dtypes"]), matched)
+        out = (
+            list(bvals) + list(cols) if self._swap
+            else list(cols) + list(bvals)
+        )
+        live_out = (live & matched) if jt == "inner" else live
+        if self._cond is not None:
+            c = lower(self._cond, out, cap)
+            live_out = live_out & c.data & c.validity
+        return out, live_out
+
     # -- probe -------------------------------------------------------------
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        if self._try_fast_build():
+            from .base import run_fused_chain
+
+            yield from run_fused_chain(self, index)
+            return
         (sb, build_count, build_cap, bsml) = self._get_build(
             index if self.partitioned else None)
         build_cols, build_words, build_live_all = sb.get()

@@ -95,6 +95,95 @@ def gather_fixed(col: ColV, indices: jax.Array, valid_slot: jax.Array) -> ColV:
     return ColV(data, validity)
 
 
+def packable_dtype(dt) -> bool:
+    """True when :func:`pack_fixed_cols` can carry this dtype losslessly.
+
+    f64 is excluded: the TPU x64 rewriter has no 64-bit bitcast, and an
+    arithmetic f32 hi/lo split drops mantissa bits on real-f64 backends."""
+    dt = jnp.dtype(dt)
+    return dt != jnp.float64
+
+
+def _split64_i32(d: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(lo, hi) int32 words of a 64-bit integer column, via shifts/masks —
+    the x64 emulation pass supports arithmetic but NOT 64-bit bitcasts."""
+    u = d.astype(jnp.uint64)
+    lo = jax.lax.convert_element_type(u & jnp.uint64(0xFFFFFFFF), jnp.uint32)
+    hi = jax.lax.convert_element_type(u >> 32, jnp.uint32)
+    return (
+        jax.lax.bitcast_convert_type(lo, jnp.int32),
+        jax.lax.bitcast_convert_type(hi, jnp.int32),
+    )
+
+
+def _join64(lo_i32: jax.Array, hi_i32: jax.Array, dt) -> jax.Array:
+    lo = jax.lax.bitcast_convert_type(lo_i32, jnp.uint32).astype(jnp.int64)
+    hi = jax.lax.bitcast_convert_type(hi_i32, jnp.int32).astype(jnp.int64)
+    return (lo | (hi << 32)).astype(dt)
+
+
+def pack_fixed_cols(cols: Sequence[ColV]) -> jax.Array:
+    """Pack fixed-width columns (+ their validity bits) into ONE
+    (cap, W) int32 matrix.
+
+    TPU gathers pay ~15ns PER ELEMENT regardless of width, but a 2D row
+    gather amortizes that over the whole row (measured 2-4x on v5e, up to
+    16x for small tables) — so a multi-column gather packs first, gathers
+    once, and unpacks. Pack/unpack are elementwise: ~100x cheaper than one
+    gather pass. Callers must exclude non-:func:`packable_dtype` columns.
+    """
+    parts: List[jax.Array] = []
+    for c in cols:
+        d = c.data
+        if d.dtype == jnp.bool_:
+            parts.append(d.astype(jnp.int32)[:, None])
+        elif d.dtype.itemsize == 8:
+            lo, hi = _split64_i32(d)
+            parts.append(jnp.stack([lo, hi], axis=-1))
+        elif d.dtype.itemsize == 4:
+            parts.append(jax.lax.bitcast_convert_type(d, jnp.int32)[:, None])
+        else:  # i8/i16 and friends: widen
+            parts.append(d.astype(jnp.int32)[:, None])
+    # validity bits, 32 columns per word
+    for i in range(0, len(cols), 32):
+        w = jnp.zeros(cols[0].validity.shape[0], jnp.int32)
+        for j, c in enumerate(cols[i : i + 32]):
+            w = w | (c.validity.astype(jnp.int32) << j)
+        parts.append(w[:, None])
+    return jnp.concatenate(parts, axis=1)
+
+
+def unpack_fixed_cols(
+    mat: jax.Array, dtypes: Sequence, valid_slot: jax.Array
+) -> List[ColV]:
+    """Inverse of :func:`pack_fixed_cols` over a gathered matrix.
+
+    ``dtypes``: the numpy dtype of each packed column, in pack order."""
+    out: List[ColV] = []
+    w = 0
+    widths = []
+    for dt in dtypes:
+        dt = jnp.dtype(dt)
+        widths.append(2 if (dt != jnp.bool_ and dt.itemsize == 8) else 1)
+    vbase = sum(widths)
+    for ci, (dt, nw) in enumerate(zip(dtypes, widths)):
+        dt = jnp.dtype(dt)
+        vword = mat[:, vbase + ci // 32]
+        validity = ((vword >> (ci % 32)) & 1).astype(jnp.bool_) & valid_slot
+        if dt == jnp.bool_:
+            data = mat[:, w].astype(jnp.bool_)
+        elif dt.itemsize == 8:
+            data = _join64(mat[:, w], mat[:, w + 1], dt)
+        elif dt.itemsize == 4:
+            data = jax.lax.bitcast_convert_type(mat[:, w], dt)
+        else:
+            data = mat[:, w].astype(dt)
+        data = jnp.where(validity, data, jnp.zeros((), dtype=data.dtype))
+        out.append(ColV(data, validity))
+        w += nw
+    return out
+
+
 def gather_string(
     col: StrV, indices: jax.Array, valid_slot: jax.Array, out_char_cap: int
 ) -> StrV:
@@ -142,9 +231,24 @@ def gather(
 
     ``char_caps`` overrides the output byte-pool size per string column (in
     order of appearance) — required when indices repeat rows (join
-    expansion), where output bytes can exceed the input pool."""
+    expansion), where output bytes can exceed the input pool.
+
+    Fixed-width columns gather as ONE packed (cap, W) int32 row gather
+    (see :func:`pack_fixed_cols`); strings keep the two-pass byte path."""
+    fixed = [
+        c for c in cols
+        if isinstance(c, ColV) and packable_dtype(c.data.dtype)
+    ]
+    packed: List[ColV] = []
+    if len(fixed) >= 2 or (fixed and fixed[0].data.dtype.itemsize == 8):
+        mat = pack_fixed_cols(fixed)
+        g = jnp.take(mat, indices, axis=0, mode="clip")
+        packed = unpack_fixed_cols(g, [c.data.dtype for c in fixed], valid_slot)
+    elif fixed:
+        packed = [gather_fixed(fixed[0], indices, valid_slot)]
     out: List[Val] = []
     si = 0
+    fi = 0
     for c in cols:
         if isinstance(c, StrV):
             cc = (
@@ -154,8 +258,11 @@ def gather(
             )
             si += 1
             out.append(gather_string(c, indices, valid_slot, cc))
-        else:
+        elif not packable_dtype(c.data.dtype):
             out.append(gather_fixed(c, indices, valid_slot))
+        else:
+            out.append(packed[fi])
+            fi += 1
     return out
 
 
