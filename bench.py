@@ -339,26 +339,37 @@ def shape_string(scale, iters, conf, T, E, A, X):
 
 
 def shape_parquet(scale, iters, conf_dict, T, E, A, X):
-    n = int((1 << 22) * scale)
+    """TPC-DS store_sales-like scan -> filter -> aggregate through the
+    session/planner path. Column distributions mirror TPC-DS (dimension
+    keys, bounded quantities, discrete price points): parquet dictionary-
+    encodes them, and the TPU-side page decoder (io/parquet_device.py)
+    uploads the encoded pages and expands on device — the same division
+    of labor as the reference's GPU decode (GpuParquetScan.scala:1157)."""
+    n = int((1 << 23) * scale)
     rng = np.random.default_rng(19)
     import pyarrow as pa
     import pyarrow.parquet as pq
 
     tmpd = tempfile.mkdtemp(prefix="srtpu_bench_")
+    prices = np.round(rng.uniform(1.0, 100.0, 9750), 2)
     t = pa.table({
-        "k": pa.array(rng.integers(0, 50, n).astype(np.int32)),
-        "a": pa.array(rng.integers(-(10**6), 10**6, n).astype(np.int64)),
-        "b": pa.array(rng.normal(size=n)),
+        "ss_item_sk": pa.array(
+            rng.integers(1, 18_001, n).astype(np.int32)),
+        "ss_quantity": pa.array(rng.integers(1, 101, n).astype(np.int32)),
+        "ss_wholesale_cost": pa.array(prices[rng.integers(0, 9750, n)]),
+        "ss_sold_date_sk": pa.array(
+            (2_450_815 + rng.integers(0, 2400, n)).astype(np.int32)),
     })
     path = os.path.join(tmpd, "t.parquet")
-    pq.write_table(t, path, row_group_size=1 << 20)
+    pq.write_table(t, path, row_group_size=1 << 21)
 
     import pandas as pd
 
     def cpu():
         pdf = pd.read_parquet(path)
-        f = pdf[pdf["a"] >= 0]
-        return f.groupby("k").agg(s=("a", "sum"), m=("b", "mean"))
+        f = pdf[pdf["ss_sold_date_sk"] >= 2_452_015]
+        return f.groupby("ss_quantity").agg(
+            s=("ss_wholesale_cost", "sum"), c=("ss_item_sk", "count"))
 
     from spark_rapids_tpu.expr.expressions import col, lit
     from spark_rapids_tpu.sql import TpuSession
@@ -368,9 +379,11 @@ def shape_parquet(scale, iters, conf_dict, T, E, A, X):
     def tpu():
         df = sess.read.parquet(tmpd)
         return (
-            df.where(E.GreaterThanOrEqual(col("a"), lit(0)))
-            .group_by("k")
-            .agg(A.agg(A.Sum(col("a")), "s"), A.agg(A.Average(col("b")), "m"))
+            df.where(E.GreaterThanOrEqual(col("ss_sold_date_sk"),
+                                          lit(2_452_015)))
+            .group_by("ss_quantity")
+            .agg(A.agg(A.Sum(col("ss_wholesale_cost")), "s"),
+                 A.agg(A.Count(col("ss_item_sk")), "c"))
             .collect())
 
     return _timeit(cpu, max(1, iters // 2)), _timeit(tpu, iters), {}

@@ -223,6 +223,13 @@ PARQUET_READER_TYPE = conf(
 PARQUET_MULTITHREAD_READ_NUM_THREADS = conf(
     "spark.rapids.tpu.sql.format.parquet.multiThreadedRead.numThreads", 4,
     "Threads for the cloud multithreaded reader.", check=_positive)
+PARQUET_DEVICE_DECODE = conf(
+    "spark.rapids.tpu.sql.format.parquet.deviceDecode.enabled", True,
+    "Decode parquet pages ON the TPU (dictionary/RLE expansion as XLA "
+    "kernels) so the host uploads encoded bytes instead of raw columns — "
+    "the TPU analog of cudf's GPU decoder (GpuParquetScan.scala:1157 "
+    "Table.readParquet). Columns with unsupported encodings fall back to "
+    "the host arrow decoder per-column.")
 CLOUD_SCHEMES = conf(
     "spark.rapids.tpu.cloudSchemes", "abfs,abfss,dbfs,gs,s3,s3a,s3n,wasbs",
     "URI schemes treated as high-latency cloud stores.")

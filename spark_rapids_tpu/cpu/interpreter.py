@@ -691,6 +691,54 @@ def eval_row(expr: E.Expression, row: Sequence[Any]) -> Any:
             i += 1
         return _re.match("(?:" + "".join(out) + r")\Z", v) is not None
 
+    if isinstance(expr, E.RLike):
+        v, p = ev(expr.left), ev(expr.pattern)
+        if v is None or p is None:
+            return None
+        import re as _re
+
+        # ASCII flag: Java's \w \d \s are ASCII-only (Spark semantics);
+        # Python's default is Unicode
+        return _re.search(p, v, _re.ASCII) is not None
+
+    if isinstance(expr, E.RegExpReplace):
+        v = ev(expr.str)
+        p, r = ev(expr.pattern), ev(expr.replacement)
+        if v is None or p is None or r is None:
+            return None
+        import re as _re
+
+        # Java Matcher.replaceAll replacement semantics: $n = group ref,
+        # \$ and \\ = literal; this path also serves patterns the TPU
+        # guard rejected, so group references must work here
+        def java_repl(m):
+            out = []
+            i = 0
+            while i < len(r):
+                ch = r[i]
+                if ch == "\\" and i + 1 < len(r):
+                    out.append(r[i + 1])
+                    i += 2
+                elif ch == "$" and i + 1 < len(r) and r[i + 1].isdigit():
+                    j = i + 1
+                    while j < len(r) and r[j].isdigit():
+                        j += 1
+                    # Java takes the longest valid group number
+                    for k in range(j, i + 1, -1):
+                        gn = int(r[i + 1 : k])
+                        if gn <= m.re.groups:
+                            out.append(m.group(gn) or "")
+                            i = k
+                            break
+                    else:
+                        raise ValueError(f"no group for {r[i:]}")
+                else:
+                    out.append(ch)
+                    i += 1
+            return "".join(out)
+
+        return _re.sub(p, java_repl, v, flags=_re.ASCII)
+
     if isinstance(expr, E.StringLocate):
         start = ev(expr.start)
         if start is None:

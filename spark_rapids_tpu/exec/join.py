@@ -269,6 +269,8 @@ class TpuShuffledHashJoinExec(TpuExec):
         # is the only reference so a spill actually frees the device copy
         built = (sb, int(count), cap, sml)
         self._built[index] = built
+        if index is None:
+            self._build_batch = None  # sorted spillable state replaces it
         return built
 
     # -- direct-address fast path (fusable) --------------------------------
@@ -368,6 +370,10 @@ class TpuShuffledHashJoinExec(TpuExec):
                 c.data.dtype for c in vals_of_batch(batch)
             )
         self._fast_built = state
+        # the raw concatenated batch is no longer needed: only the
+        # spill-registered table/matrix state survives (holding both would
+        # pin two copies of the build side in HBM)
+        self._build_batch = None
         return state
 
     @property

@@ -97,11 +97,34 @@ class TpuFileSourceScanExec(TpuExec):
         self._prefetch[index] = None  # free the decoded table once consumed
         return fut.result()
 
+    def _attach_partition_cols(self, batch: ColumnarBatch, pvals):
+        schema = self.output_schema
+        pkeys = list(getattr(self.scanner, "partition_cols", ()))
+        if not pkeys:
+            return batch
+        pmap = dict(pvals)
+        n, cap = batch.num_rows, max(batch.capacity, 1)
+        cols = list(batch.columns)
+        for k in pkeys:
+            cols.append(constant_string_column(pmap.get(k), n, cap))
+        return ColumnarBatch(cols, schema, n)
+
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
         from ..io.arrow_convert import arrow_to_batch
 
         if index >= self.scanner.num_splits():
             return
+        # TPU-side page decode (reference: GPU decode via Table.readParquet,
+        # GpuParquetScan.scala:1157): host uploads encoded bytes, XLA
+        # kernels expand dictionary/RLE pages on-device
+        if hasattr(self.scanner, "read_split_device"):
+            with timed(self.metrics[DECODE_TIME]):
+                dev, pvals = self.scanner.read_split_device(index)
+            if dev is not None:
+                for b in dev:
+                    yield self.record_batch(
+                        self._attach_partition_cols(b, pvals))
+                return
         with timed(self.metrics[SCAN_TIME]):
             table, pvals = self._read_split(index)
         with timed(self.metrics[DECODE_TIME]):
@@ -110,15 +133,8 @@ class TpuFileSourceScanExec(TpuExec):
             # file (scanner.partition_cols); a split may report extra keys
             # on ragged layouts — select by schema key, not raw count
             pkeys = list(getattr(self.scanner, "partition_cols", ()))
-            npart = len(pkeys)
-            file_fields = schema.fields[: len(schema.fields) - npart]
+            file_fields = schema.fields[: len(schema.fields) - len(pkeys)]
             batch = arrow_to_batch(
                 table, T.StructType(tuple(file_fields)))
-            if npart:
-                pmap = dict(pvals)
-                n, cap = batch.num_rows, max(batch.capacity, 1)
-                cols = list(batch.columns)
-                for k in pkeys:
-                    cols.append(constant_string_column(pmap.get(k), n, cap))
-                batch = ColumnarBatch(cols, schema, n)
+            batch = self._attach_partition_cols(batch, pvals)
         yield self.record_batch(batch)

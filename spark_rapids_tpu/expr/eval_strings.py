@@ -233,6 +233,55 @@ def _string_predicate(expr, c: StrV, cap: int) -> ColV:
     return ColV(res, c.validity)
 
 
+_DFA_CACHE: dict = {}
+
+
+def _rlike(expr: E.RLike, c: StrV, cap: int) -> ColV:
+    """str RLIKE pattern via the byte-DFA scan (ops/regex.py). Patterns
+    outside the subset (or over the DFA state cap) raise Unsupported so
+    the planner falls back — the reference had no GPU RLike at all."""
+    pat = lit_str(expr.pattern, "RLike pattern")
+    if pat is None:
+        return _all_null_col(cap)
+    from ..ops import regex as RX
+
+    dfa = _DFA_CACHE.get(pat)
+    if dfa is None:
+        try:
+            dfa = RX.compile_search_dfa(pat)
+        except RX.RegexUnsupported as e:
+            raise UnsupportedExpressionError(f"RLike pattern: {e}")
+        if len(_DFA_CACHE) > 256:
+            _DFA_CACHE.clear()
+        _DFA_CACHE[pat] = dfa
+    res = RX.dfa_accept_rows(c.offsets, c.chars, c.validity, dfa)
+    return ColV(res, c.validity)
+
+
+def _regexp_replace(expr: E.RegExpReplace, c: StrV, cap: int) -> StrV:
+    """regexp_replace with the reference's literal guard
+    (canRegexpBeTreatedLikeARegularString, GpuOverrides.scala:414):
+    literal-equivalent patterns lower to the plain replace kernel."""
+    from ..ops import regex as RX
+
+    pat = lit_str(expr.pattern, "regexp_replace pattern")
+    repl = lit_str(expr.replacement, "regexp_replace replacement")
+    if pat is None or repl is None:
+        # Spark: null pattern/replacement -> null out
+        off = jnp.zeros(cap + 1, jnp.int32)
+        return StrV(off, jnp.zeros(1, jnp.uint8), jnp.zeros(cap, jnp.bool_))
+    literal = RX.regex_as_literal(pat)
+    if literal is None or literal == "":
+        raise UnsupportedExpressionError(
+            "regexp_replace pattern is not literal-equivalent")
+    if "$" in repl or "\\" in repl:
+        raise UnsupportedExpressionError(
+            "regexp_replace replacement with group references")
+    synth = E.StringReplace(expr.str, E.Literal(literal, T.STRING),
+                            E.Literal(repl, T.STRING))
+    return _replace(synth, c, cap)
+
+
 def _parse_like(pattern: str, escape: str) -> List[str]:
     """Tokenize a LIKE pattern into literal chunks separated by '%' tokens,
     or a char-wise list when only '_' wildcards appear. Raises Unsupported
@@ -842,6 +891,10 @@ def lower_strings(expr: E.Expression, ev: Callable, cap: int):
         return _string_predicate(expr, ev(expr.left), cap)
     if isinstance(expr, E.Like):
         return _like(expr, ev(expr.left), cap)
+    if isinstance(expr, E.RLike):
+        return _rlike(expr, ev(expr.left), cap)
+    if isinstance(expr, E.RegExpReplace):
+        return _regexp_replace(expr, ev(expr.str), cap)
     if isinstance(expr, E.StringLocate):
         return _locate(expr, ev(expr.str), cap)
     if isinstance(expr, E.StringReplace):

@@ -859,6 +859,38 @@ class SubstringIndex(Expression):
 
 
 @dataclasses.dataclass(frozen=True)
+class RLike(Expression):
+    """str RLIKE pattern (Java Matcher.find semantics). The pattern must
+    be a literal and compile to a small byte DFA (ops/regex.py); anything
+    else is tagged unsupported and falls back. The reference at this
+    version had NO RLike on GPU (regex support was the literal guard,
+    GpuOverrides.scala:414) — the DFA path exceeds that parity."""
+
+    left: Expression
+    pattern: Expression
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+@dataclasses.dataclass(frozen=True)
+class RegExpReplace(Expression):
+    """regexp_replace(str, pattern, replacement): supported exactly when
+    the pattern can be treated like a regular string — the reference's
+    guard (GpuOverrides.canRegexpBeTreatedLikeARegularString,
+    GpuOverrides.scala:414) — and lowers to the literal replace kernel."""
+
+    str: Expression
+    pattern: Expression
+    replacement: Expression
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+@dataclasses.dataclass(frozen=True)
 class StringSplitPart(Expression):
     """split(str, delim)[index] fused into one node — the engine's analog of
     the reference's GpuStringSplit (stringFunctions.scala:832) + array

@@ -247,6 +247,46 @@ class ParquetScanner:
         s = self.splits()[i]
         return self.read_split(s), s.partition_values
 
+    def read_split_device(self, i: int):
+        """Device-decode split i: (list of ColumnarBatch — one per row
+        group — or None when no column takes the device path, partition
+        values). Reference analog: the GPU decode half of
+        GpuParquetScan.scala:1157; see io/parquet_device.py."""
+        import pyarrow.parquet as pq
+
+        from ..conf import PARQUET_DEVICE_DECODE
+        from .parquet_device import read_row_group_device
+
+        if not self.conf.get(PARQUET_DEVICE_DECODE):
+            return None, ()
+        s = self.splits()[i]
+        if not s.row_groups:
+            return None, s.partition_values
+        pf = pq.ParquetFile(s.path)
+        file_cols = [c for c in self.columns if c not in split_pcols(s)]
+        nfields = [
+            f for f in self.schema.fields if f.name in file_cols
+        ]
+        # mmap: plan_chunk touches only the selected chunks' byte ranges,
+        # so the OS pages in just those — no O(splits x file) reads
+        import mmap
+
+        f = open(s.path, "rb")
+        try:
+            file_bytes = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except ValueError:  # empty file
+            file_bytes = b""
+        finally:
+            f.close()
+        batches = []
+        for rg in s.row_groups:
+            b = read_row_group_device(
+                s.path, pf, rg, file_cols, nfields, file_bytes)
+            if b is None:
+                return None, s.partition_values
+            batches.append(b)
+        return batches, s.partition_values
+
 
 
 def split_pcols(split: FileSplit) -> List[str]:

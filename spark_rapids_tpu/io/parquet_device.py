@@ -1,0 +1,753 @@
+"""TPU-offloaded parquet page decode.
+
+Reference analog: the GPU half of the reference's parquet scan — the host
+reads raw column-chunk BYTES and the accelerator decodes pages
+(GpuParquetScan.scala:1775 structure; GPU decode via ``Table.readParquet``
+at :1157, cudf's parquet decoder). The TPU split is chosen by what each
+side is fast at:
+
+  * HOST (cheap, vectorized numpy — no per-value python): thrift page
+    headers, codec decompress (pyarrow), RLE/bit-packed hybrid expansion
+    of dictionary INDICES to the narrowest integer (u8/u16/i32 by bit
+    width) via ``np.unpackbits`` reshape tricks, validity BITS re-packed
+    to words.
+  * WIRE: the narrow codes + packed validity + the dictionary — typically
+    1-2 bytes/value instead of 4-8 raw, so host->device transfer shrinks
+    by the dictionary ratio. That is the same bytes-not-values contract
+    the reference's host half honors.
+  * DEVICE (XLA): validity bit expansion (elementwise shifts), present->
+    row scatter via prefix sums, and the expensive part — DICTIONARY
+    EXPANSION, one packed row gather per column (small-table fast path),
+    plus 64-bit reassembly for PLAIN int64 (arithmetic: the x64 rewriter
+    has no 64-bit bitcast).
+
+Scope: flat schemas (max_repetition_level == 0), PLAIN int32/int64/float,
+RLE_DICTIONARY / PLAIN_DICTIONARY for int32/int64/float/double and
+BYTE_ARRAY (strings), definition levels for nullable columns, v1 and v2
+data pages, snappy/zstd/gzip/uncompressed codecs. Pages of one chunk may
+use different dictionary bit widths. Anything else falls back to the host
+arrow decoder per-column.
+"""
+from __future__ import annotations
+
+import dataclasses
+import struct as _struct
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# thrift compact type ids
+_T_STOP = 0
+_T_TRUE = 1
+_T_FALSE = 2
+_T_BYTE = 3
+_T_I16 = 4
+_T_I32 = 5
+_T_I64 = 6
+_T_DOUBLE = 7
+_T_BINARY = 8
+_T_LIST = 9
+_T_SET = 10
+_T_MAP = 11
+_T_STRUCT = 12
+
+# parquet page types
+DATA_PAGE = 0
+DICTIONARY_PAGE = 2
+DATA_PAGE_V2 = 3
+
+# parquet encodings
+ENC_PLAIN = 0
+ENC_PLAIN_DICTIONARY = 2
+ENC_RLE = 3
+ENC_RLE_DICTIONARY = 8
+
+#: host-side guardrail: pages with more hybrid runs than this fall back
+#: (the python run parser is O(runs); typical pages have few runs)
+MAX_RUNS_PER_PAGE = 1 << 16
+
+
+class _Reader:
+    """Minimal thrift compact-protocol struct reader (header-only needs)."""
+
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def varint(self) -> int:
+        r = 0
+        shift = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            r |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return r
+            shift += 7
+
+    def zigzag(self) -> int:
+        v = self.varint()
+        return (v >> 1) ^ -(v & 1)
+
+    def skip(self, ftype: int) -> None:
+        if ftype in (_T_TRUE, _T_FALSE):
+            return
+        if ftype == _T_BYTE:
+            self.pos += 1
+        elif ftype in (_T_I16, _T_I32, _T_I64):
+            self.varint()
+        elif ftype == _T_DOUBLE:
+            self.pos += 8
+        elif ftype == _T_BINARY:
+            # NOTE: must read the varint BEFORE adding — `pos += varint()`
+            # loads pos before varint() advances it
+            ln = self.varint()
+            self.pos += ln
+        elif ftype in (_T_LIST, _T_SET):
+            b = self.buf[self.pos]
+            self.pos += 1
+            size = b >> 4
+            et = b & 0x0F
+            if size == 15:
+                size = self.varint()
+            for _ in range(size):
+                self.skip(et)
+        elif ftype == _T_MAP:
+            size = self.varint()
+            if size:
+                kv = self.buf[self.pos]
+                self.pos += 1
+                for _ in range(size):
+                    self.skip(kv >> 4)
+                    self.skip(kv & 0x0F)
+        elif ftype == _T_STRUCT:
+            self.read_struct(lambda fid, ft, rd: rd.skip(ft))
+        else:
+            raise ValueError(f"thrift type {ftype}")
+
+    def read_struct(self, on_field) -> None:
+        """on_field(field_id, ftype, reader) must CONSUME the value."""
+        fid = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            if b == _T_STOP:
+                return
+            delta = b >> 4
+            ftype = b & 0x0F
+            fid = fid + delta if delta else self.zigzag()
+            on_field(fid, ftype, self)
+
+
+@dataclasses.dataclass
+class PageHeader:
+    type: int
+    uncompressed_size: int
+    compressed_size: int
+    num_values: int = 0
+    encoding: int = ENC_PLAIN
+    # v2 extras
+    num_nulls: int = 0
+    def_levels_len: int = 0
+    rep_levels_len: int = 0
+    v2_is_compressed: bool = True
+    header_len: int = 0
+
+
+def parse_page_header(buf: bytes, pos: int) -> PageHeader:
+    rd = _Reader(buf, pos)
+    ph = PageHeader(-1, 0, 0)
+
+    def sub_data(fid, ft, r):
+        if fid == 1:
+            ph.num_values = r.zigzag()
+        elif fid == 2:
+            ph.encoding = r.zigzag()
+        else:
+            r.skip(ft)
+
+    def sub_dict(fid, ft, r):
+        if fid == 1:
+            ph.num_values = r.zigzag()
+        elif fid == 2:
+            ph.encoding = r.zigzag()
+        else:
+            r.skip(ft)
+
+    def sub_v2(fid, ft, r):
+        if fid == 1:
+            ph.num_values = r.zigzag()
+        elif fid == 2:
+            ph.num_nulls = r.zigzag()
+        elif fid == 4:
+            ph.encoding = r.zigzag()
+        elif fid == 5:
+            ph.def_levels_len = r.zigzag()
+        elif fid == 6:
+            ph.rep_levels_len = r.zigzag()
+        elif fid == 7:
+            ph.v2_is_compressed = ft == _T_TRUE
+        else:
+            r.skip(ft)
+
+    def top(fid, ft, r):
+        if fid == 1:
+            ph.type = r.zigzag()
+        elif fid == 2:
+            ph.uncompressed_size = r.zigzag()
+        elif fid == 3:
+            ph.compressed_size = r.zigzag()
+        elif fid == 5 and ft == _T_STRUCT:
+            r.read_struct(sub_data)
+        elif fid == 7 and ft == _T_STRUCT:
+            r.read_struct(sub_dict)
+        elif fid == 8 and ft == _T_STRUCT:
+            r.read_struct(sub_v2)
+        else:
+            r.skip(ft)
+
+    rd.read_struct(top)
+    ph.header_len = rd.pos - pos
+    return ph
+
+
+# ---------------------------------------------------------------------------
+# RLE / bit-packed hybrid expansion (host side, vectorized numpy)
+# ---------------------------------------------------------------------------
+class _FallbackError(Exception):
+    """Column can't take the device path; fall back to host decode."""
+
+
+#: safety bound on hybrid runs per stream (each run costs one cheap numpy
+#: slice; this only guards adversarial files)
+MAX_RUNS = 1 << 20
+
+_POWS = {bw: (1 << np.arange(bw, dtype=np.int64)).astype(np.int32)
+         for bw in range(1, 25)}
+
+
+def hybrid_decode_np(data: bytes, pos: int, end: int, bw: int,
+                     n: int) -> Tuple[np.ndarray, int]:
+    """Expand one RLE/bit-packed hybrid stream to n int32 values.
+
+    Per-RUN python loop, per-VALUE numpy (`np.unpackbits` + a reshape dot)
+    — the host cost is a few ns/value, ~100x under arrow's full decode to
+    raw 64-bit columns. Returns (values, byte position after stream)."""
+    if bw == 0:
+        return np.zeros(n, np.int32), pos
+    if bw > 24:
+        raise _FallbackError(f"bit width {bw}")
+    out = np.zeros(n, np.int32)
+    byte_w = (bw + 7) // 8
+    pows = _POWS[bw]
+    got = 0
+    nruns = 0
+    while got < n and pos < end:
+        nruns += 1
+        if nruns > MAX_RUNS:
+            raise _FallbackError("too many hybrid runs")
+        header = 0
+        shift = 0
+        while True:
+            b = data[pos]
+            pos += 1
+            header |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        if header & 1:  # bit-packed run of (header>>1) groups of 8
+            groups = header >> 1
+            count = groups * 8
+            nbytes = groups * bw
+            arr = np.frombuffer(data, np.uint8, nbytes, pos)
+            bits = np.unpackbits(arr, bitorder="little")
+            take = min(count, n - got)
+            m = take  # only decode what the stream logically holds
+            vals = bits[: m * bw].reshape(m, bw) @ pows
+            out[got : got + take] = vals
+            pos += nbytes
+            got += count  # padding values advance the logical count too
+        else:  # RLE run
+            count = header >> 1
+            v = int.from_bytes(data[pos : pos + byte_w], "little")
+            pos += byte_w
+            take = min(count, n - got)
+            out[got : got + take] = v
+            got += count
+    if got < n:
+        raise _FallbackError(f"short hybrid stream: {got}/{n}")
+    return out, pos
+
+
+# ---------------------------------------------------------------------------
+# host planning: file bytes -> upload arrays per column chunk
+# ---------------------------------------------------------------------------
+_PHYS_NP = {
+    "INT32": np.dtype(np.int32),
+    "INT64": np.dtype(np.int64),
+    "FLOAT": np.dtype(np.float32),
+    "DOUBLE": np.dtype(np.float64),
+    "BOOLEAN": np.dtype(np.bool_),
+}
+
+
+@dataclasses.dataclass
+class ChunkPlan:
+    """Host-normalized upload payloads of one column chunk."""
+
+    phys: str  # parquet physical type
+    num_values: int  # rows in the chunk
+    nullable: bool
+    # dictionary (None for PLAIN data pages)
+    dict_values: Optional[np.ndarray] = None  # numeric dicts
+    dict_offsets: Optional[np.ndarray] = None  # string dicts
+    dict_chars: Optional[np.ndarray] = None
+    # per-PRESENT dictionary code, narrowest dtype (u8/u16/i32)
+    codes: Optional[np.ndarray] = None
+    # per-row validity (None = no nulls)
+    validity: Optional[np.ndarray] = None
+    # PLAIN page payloads (concatenated raw value bytes, present only)
+    plain_bytes: Optional[bytes] = None
+    n_present: int = 0
+
+
+def _decompress(codec: str, data: bytes, out_size: int) -> bytes:
+    codec = codec.upper()
+    if codec == "UNCOMPRESSED":
+        return data
+    import pyarrow as pa
+
+    try:
+        c = pa.Codec(codec.lower())
+    except Exception as e:  # codec not built into this pyarrow
+        raise _FallbackError(f"codec {codec}: {e}")
+    return c.decompress(data, out_size).to_pybytes()
+
+
+def plan_chunk(
+    file_bytes: bytes, col_meta, max_def: int, max_rep: int
+) -> ChunkPlan:
+    """Parse one column chunk's pages into a ChunkPlan (host side).
+
+    Raises _FallbackError for unsupported shapes/encodings."""
+    if max_rep != 0:
+        raise _FallbackError("nested (repeated) column")
+    phys = col_meta.physical_type
+    if phys not in _PHYS_NP and phys != "BYTE_ARRAY":
+        raise _FallbackError(f"physical type {phys}")
+    codec = col_meta.compression
+    n = col_meta.num_values
+    st = col_meta.statistics
+    has_nulls = (
+        max_def > 0
+        and (st is None or st.null_count is None or st.null_count > 0)
+    )
+
+    doff = col_meta.dictionary_page_offset
+    off = doff if doff is not None and doff > 0 else col_meta.data_page_offset
+    end = off + col_meta.total_compressed_size
+
+    plan = ChunkPlan(phys=phys, num_values=n, nullable=max_def > 0)
+    pos = off
+    values_seen = 0
+    code_pages: List[np.ndarray] = []
+    valid_pages: List[np.ndarray] = []
+    plain_parts: List[bytes] = []
+    saw_dict_page = False
+    saw_plain_page = False
+
+    def handle_values(raw: bytes, p: int, pend: int, enc: int,
+                      presents: int) -> None:
+        nonlocal saw_dict_page, saw_plain_page
+        if enc in (ENC_RLE_DICTIONARY, ENC_PLAIN_DICTIONARY):
+            bw = raw[p] if p < len(raw) else 0
+            vals, _ = hybrid_decode_np(raw, p + 1, pend, bw, presents)
+            code_pages.append(vals)
+            saw_dict_page = True
+        elif enc == ENC_PLAIN:
+            if phys in ("BYTE_ARRAY", "BOOLEAN", "DOUBLE"):
+                # BYTE_ARRAY plain needs per-value host parsing; f64 needs
+                # a 64-bit device bitcast the x64 rewriter lacks
+                raise _FallbackError(f"PLAIN {phys}")
+            dt = _PHYS_NP[phys]
+            need = presents * dt.itemsize
+            plain_parts.append(raw[p : p + need])
+            saw_plain_page = True
+        else:
+            raise _FallbackError(f"encoding {enc}")
+        if saw_dict_page and saw_plain_page:
+            # mixed dict+plain pages (dict overflow mid-chunk): the device
+            # program would need both paths; punt to the host decoder
+            raise _FallbackError("mixed dict/plain pages")
+
+    while pos < end and values_seen < n:
+        ph = parse_page_header(file_bytes, pos)
+        pos += ph.header_len
+        payload = file_bytes[pos : pos + ph.compressed_size]
+        pos += ph.compressed_size
+        if ph.type == DICTIONARY_PAGE:
+            if ph.encoding not in (ENC_PLAIN, ENC_PLAIN_DICTIONARY):
+                raise _FallbackError(f"dict encoding {ph.encoding}")
+            raw = _decompress(codec, payload, ph.uncompressed_size)
+            _load_dictionary(plan, raw, ph.num_values)
+            continue
+        if ph.type == DATA_PAGE:
+            raw = _decompress(codec, payload, ph.uncompressed_size)
+            p = 0
+            presents = ph.num_values
+            if max_def > 0:
+                (ln,) = _struct.unpack_from("<I", raw, p)
+                p += 4
+                if has_nulls:
+                    levels, _ = hybrid_decode_np(
+                        raw, p, p + ln, 1, ph.num_values)
+                    vp = levels == 1
+                    valid_pages.append(vp)
+                    presents = int(vp.sum())
+                p += ln
+            handle_values(raw, p, len(raw), ph.encoding, presents)
+            values_seen += ph.num_values
+            continue
+        if ph.type == DATA_PAGE_V2:
+            if ph.rep_levels_len:
+                raise _FallbackError("repeated column (v2)")
+            presents = ph.num_values - (
+                ph.num_nulls if max_def > 0 else 0)
+            if max_def > 0 and has_nulls:
+                if ph.def_levels_len:
+                    levels, _ = hybrid_decode_np(
+                        payload, 0, ph.def_levels_len, 1, ph.num_values)
+                    valid_pages.append(levels == 1)
+                else:
+                    valid_pages.append(
+                        np.ones(ph.num_values, np.bool_))
+            vals = payload[ph.def_levels_len :]
+            if ph.v2_is_compressed and codec.upper() != "UNCOMPRESSED":
+                vals = _decompress(
+                    codec, vals, ph.uncompressed_size - ph.def_levels_len)
+            handle_values(vals, 0, len(vals), ph.encoding, presents)
+            values_seen += ph.num_values
+            continue
+        # index pages etc: skip
+    if values_seen < n:
+        raise _FallbackError(f"short chunk: {values_seen}/{n} values")
+    if valid_pages:
+        plan.validity = np.concatenate(valid_pages)
+    if code_pages:
+        codes = (np.concatenate(code_pages) if len(code_pages) > 1
+                 else code_pages[0])
+        plan.n_present = codes.shape[0]
+        mx = int(codes.max()) if codes.shape[0] else 0
+        plan.codes = codes.astype(
+            np.uint8 if mx < 256 else
+            np.uint16 if mx < 65536 else np.int32)
+    elif plain_parts:
+        plan.plain_bytes = b"".join(plain_parts)
+        dt = _PHYS_NP[phys]
+        plan.n_present = len(plan.plain_bytes) // dt.itemsize
+    else:
+        plan.n_present = 0
+        plan.codes = np.zeros(0, np.uint8)
+    return plan
+
+
+def _load_dictionary(plan: ChunkPlan, raw: bytes, count: int) -> None:
+    if plan.phys == "BYTE_ARRAY":
+        offs = np.zeros(count + 1, np.int64)
+        chars = []
+        p = 0
+        for i in range(count):
+            (ln,) = _struct.unpack_from("<I", raw, p)
+            p += 4
+            chars.append(raw[p : p + ln])
+            p += ln
+            offs[i + 1] = offs[i] + ln
+        plan.dict_offsets = offs
+        pool = b"".join(chars)
+        plan.dict_chars = (
+            np.frombuffer(pool, np.uint8).copy() if pool
+            else np.zeros(1, np.uint8))
+    elif plan.phys == "BOOLEAN":
+        raise _FallbackError("boolean dictionary")
+    else:
+        dt = _PHYS_NP[plan.phys]
+        plan.dict_values = np.frombuffer(
+            raw[: count * dt.itemsize], dt).copy()
+
+
+# ---------------------------------------------------------------------------
+# device decode (XLA kernels)
+# ---------------------------------------------------------------------------
+def unpack_bit_words(words, out_cap: int):
+    """bits[j] = bit j of the LSB-first u32 word stream — pure reshape/
+    elementwise, ZERO gathers (TPU gathers cost ~15ns/elem)."""
+    import jax.numpy as jnp
+
+    need_w = -(-out_cap // 32)
+    w = words
+    if w.shape[0] < need_w:
+        w = jnp.concatenate(
+            [w, jnp.zeros(need_w - w.shape[0], jnp.uint32)])
+    else:
+        w = w[:need_w]
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = ((w[:, None] >> shifts[None, :]) & jnp.uint32(1)) != 0
+    return bits.reshape(need_w * 32)[:out_cap]
+
+
+def _pack_validity_words(validity: np.ndarray) -> np.ndarray:
+    b = np.packbits(validity, bitorder="little")
+    pad = (-b.shape[0]) % 4
+    if pad:
+        b = np.concatenate([b, np.zeros(pad, np.uint8)])
+    return b.view(np.uint32)
+
+
+_DECODE_CACHE: Dict[tuple, Any] = {}
+
+
+def _np_plain_words(plan: ChunkPlan) -> np.ndarray:
+    raw = plan.plain_bytes or b""
+    pad = (-len(raw)) % 8  # even word count so int64 lo/hi halves align
+    if pad:
+        raw = raw + b"\x00" * pad
+    return (
+        np.frombuffer(raw, np.uint32).copy()
+        if raw else np.zeros(2, np.uint32)
+    )
+
+
+def chunk_to_device_column(plan: ChunkPlan, dtype_tpu, cap: int):
+    """Upload a ChunkPlan's payloads and expand to a DeviceColumn in ONE
+    jitted program (per structural cache key)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..utils.bucketing import bucket_rows
+
+    n = plan.num_values
+    has_def = plan.validity is not None
+    is_dict = plan.codes is not None
+    is_str = plan.phys == "BYTE_ARRAY"
+    if is_str and not is_dict:
+        raise _FallbackError("PLAIN BYTE_ARRAY")
+    if n == 0:
+        from ..columnar.column import DeviceColumn
+
+        if is_str:
+            return DeviceColumn(
+                dtype_tpu, 0, None, jnp.zeros(cap, jnp.bool_),
+                jnp.zeros(cap + 1, jnp.int32), jnp.zeros(1, jnp.uint8))
+        dt = _PHYS_NP[plan.phys]
+        return DeviceColumn(
+            dtype_tpu, 0, jnp.zeros(cap, dt), jnp.zeros(cap, jnp.bool_))
+
+    args: List[Any] = []
+    key: List[Any] = ["pqdec", plan.phys, str(dtype_tpu), cap, n, has_def,
+                      is_dict]
+
+    if has_def:
+        vwords = _pack_validity_words(plan.validity)
+        args.append(jnp.asarray(vwords))
+        key.append(int(vwords.shape[0]))
+    if is_dict:
+        # all-null chunks can carry an EMPTY dictionary: pad one zero slot
+        # so the device gather has a valid (masked-out) target
+        if plan.dict_values is not None and plan.dict_values.shape[0] == 0:
+            plan.dict_values = np.zeros(1, plan.dict_values.dtype)
+        if plan.dict_offsets is not None and plan.dict_offsets.shape[0] < 2:
+            plan.dict_offsets = np.zeros(2, np.int64)
+        codes = plan.codes
+        pcap = bucket_rows(max(1, codes.shape[0]))
+        if codes.shape[0] < pcap:
+            codes = np.concatenate(
+                [codes, np.zeros(pcap - codes.shape[0], codes.dtype)])
+        args.append(jnp.asarray(codes))
+        key += [str(codes.dtype), pcap]
+        if is_str:
+            D = plan.dict_offsets.shape[0] - 1
+            lens = np.diff(plan.dict_offsets)
+            total_bytes = int(
+                np.bincount(
+                    np.clip(plan.codes.astype(np.int64), 0, D - 1),
+                    minlength=D,
+                ) @ lens
+            ) if plan.codes.shape[0] else 0
+            ccap = bucket_rows(max(1, total_bytes), 128)
+            args += [jnp.asarray(plan.dict_offsets.astype(np.int32)),
+                     jnp.asarray(plan.dict_chars)]
+            key += [D, int(plan.dict_chars.shape[0]), ccap]
+        else:
+            args.append(jnp.asarray(plan.dict_values))
+            key += [int(plan.dict_values.shape[0])]
+    else:
+        words = _np_plain_words(plan)
+        args.append(jnp.asarray(words))
+        key.append(int(words.shape[0]))
+
+    key_t = tuple(key)
+    fn = _DECODE_CACHE.get(key_t)
+    if fn is None:
+        phys = plan.phys
+
+        def run(arglist):
+            ai = 0
+            if has_def:
+                validity = unpack_bit_words(arglist[ai], cap)
+                ai += 1
+                validity = validity & (
+                    jnp.arange(cap, dtype=jnp.int32) < n)
+                vidx = jnp.clip(
+                    jnp.cumsum(validity.astype(jnp.int32)) - 1, 0, cap - 1)
+            else:
+                validity = jnp.arange(cap, dtype=jnp.int32) < n
+                vidx = None
+            if is_dict:
+                codes_ = arglist[ai].astype(jnp.int32)
+                ai += 1
+                if vidx is not None:
+                    codes_ = jnp.take(codes_, vidx, mode="clip")
+                elif codes_.shape[0] != cap:
+                    codes_ = (
+                        jnp.concatenate([
+                            codes_,
+                            jnp.zeros(cap - codes_.shape[0], jnp.int32)])
+                        if codes_.shape[0] < cap else codes_[:cap]
+                    )
+                if is_str:
+                    doff_, dch_ = arglist[ai], arglist[ai + 1]
+                    from ..expr.eval import StrV
+                    from ..ops.filter_gather import gather_string
+
+                    D_ = doff_.shape[0] - 1
+                    dsv = StrV(doff_, dch_, jnp.ones(D_, jnp.bool_))
+                    out = gather_string(
+                        dsv, jnp.clip(codes_, 0, D_ - 1), validity, ccap)
+                    return out.offsets, out.chars, validity
+                dvals_ = arglist[ai]
+                data = jnp.take(
+                    dvals_, jnp.clip(codes_, 0, dvals_.shape[0] - 1),
+                    mode="clip")
+                data = jnp.where(validity, data,
+                                 jnp.zeros((), data.dtype))
+                return data, validity
+            words_ = arglist[ai]
+            if phys in ("INT32", "FLOAT"):
+                dt = _PHYS_NP[phys]
+                arr = jax.lax.bitcast_convert_type(words_, dt)
+            else:  # INT64 (words padded to even count on host)
+                from ..ops.filter_gather import _join64
+
+                lo = jax.lax.bitcast_convert_type(words_[0::2], jnp.int32)
+                hi = jax.lax.bitcast_convert_type(words_[1::2], jnp.int32)
+                arr = _join64(lo, hi, jnp.int64)
+            arr = (
+                jnp.concatenate(
+                    [arr, jnp.zeros(cap - arr.shape[0], arr.dtype)])
+                if arr.shape[0] < cap else arr[:cap]
+            )
+            if vidx is not None:
+                arr = jnp.take(arr, vidx, mode="clip")
+            arr = jnp.where(validity, arr, jnp.zeros((), arr.dtype))
+            return arr, validity
+
+        if len(_DECODE_CACHE) > 512:
+            _DECODE_CACHE.clear()
+        fn = _DECODE_CACHE[key_t] = jax.jit(run)
+    out = fn(args)
+    from ..columnar.column import DeviceColumn
+
+    if is_str:
+        offsets, chars, validity = out
+        return DeviceColumn(dtype_tpu, n, None, validity, offsets, chars)
+    data, validity = out
+    return DeviceColumn(dtype_tpu, n, data, validity)
+
+
+# ---------------------------------------------------------------------------
+# row group -> ColumnarBatch (with per-column host fallback)
+# ---------------------------------------------------------------------------
+def read_row_group_device(
+    path: str, pf, rg: int, columns: Sequence[str], tpu_fields,
+    file_bytes: Optional[bytes] = None,
+) -> Optional[Any]:
+    """Decode one row group into a ColumnarBatch, device-decoding every
+    supported column and host-decoding (pyarrow) the rest. Returns None
+    when NO column takes the device path (caller uses the plain reader)."""
+    from ..columnar.batch import ColumnarBatch
+    from ..types import StructType
+    from ..utils.bucketing import bucket_rows
+
+    md = pf.metadata
+    rgmd = md.row_group(rg)
+    pqschema = pf.schema  # parquet (physical) schema
+    name_to_ci = {
+        rgmd.column(i).path_in_schema: i for i in range(rgmd.num_columns)
+    }
+    n = rgmd.num_rows
+    cap = bucket_rows(max(1, n))
+
+    candidates = []
+    fallback_cols: List[str] = []
+    for name in columns:
+        ci = name_to_ci.get(name)
+        if ci is None:
+            fallback_cols.append(name)
+        else:
+            candidates.append((name, ci))
+    plans: Dict[str, ChunkPlan] = {}
+    if candidates:
+        if file_bytes is None:
+            with open(path, "rb") as f:
+                file_bytes = f.read()
+
+        def plan_one(item):
+            name, ci = item
+            pqcol = pqschema.column(ci)
+            try:
+                return name, plan_chunk(
+                    file_bytes, rgmd.column(ci),
+                    pqcol.max_definition_level, pqcol.max_repetition_level)
+            except Exception:
+                return name, None
+
+        # chunk planning is numpy-heavy (unpackbits/dot release the GIL):
+        # plan all columns of the row group in parallel (reference analog:
+        # the COALESCING reader's copy thread pool, GpuParquetScan.scala:900)
+        if len(candidates) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(candidates)),
+                    thread_name_prefix="srtpu-pqdec") as pool:
+                results = list(pool.map(plan_one, candidates))
+        else:
+            results = [plan_one(candidates[0])]
+        for name, plan in results:
+            if plan is None:
+                fallback_cols.append(name)
+            else:
+                plans[name] = plan
+    if not plans:
+        return None
+
+    host_table = None
+    if fallback_cols:
+        host_table = pf.read_row_groups([rg], columns=fallback_cols)
+
+    from .arrow_convert import arrow_to_batch
+
+    cols = []
+    fields = []
+    for name, f in zip(columns, tpu_fields):
+        if name in plans:
+            cols.append(chunk_to_device_column(plans[name], f.dataType, cap))
+            fields.append(f)
+        else:
+            sub = host_table.select([name])
+            b = arrow_to_batch(sub, StructType((f,)))
+            cols.append(b.columns[0])
+            fields.append(f)
+    return ColumnarBatch(cols, StructType(tuple(fields)), n)

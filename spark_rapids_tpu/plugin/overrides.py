@@ -142,6 +142,9 @@ for _cls, _name, _desc in [
     (E.EndsWith, "EndsWith", "suffix test"),
     (E.Contains, "Contains", "substring containment test"),
     (E.Like, "Like", "SQL LIKE pattern match"),
+    (E.RLike, "RLike", "regex match via compiled byte DFA"),
+    (E.RegExpReplace, "RegExpReplace",
+     "regex replace (literal-equivalent patterns)"),
     (E.StringLocate, "StringLocate", "substring position (1-based)"),
     (E.StringReplace, "StringReplace", "replace all occurrences"),
     (E.StringLPad, "StringLPad", "left-pad to length"),

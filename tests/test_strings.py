@@ -5,6 +5,7 @@ integration_tests string_test.py + CastOpSuite string rows), applied through
 the same two-engine diff used by test_expressions.py.
 """
 import random
+import zlib
 
 import pytest
 
@@ -158,7 +159,7 @@ def test_predicate_null_pattern():
     "a_c", "___", "%üñ%", "100\\%", "a\\_c",
 ])
 def test_like(pat):
-    check(E.Like(col("s"), lit(pat)), seed=hash(pat) & 0xFFF)
+    check(E.Like(col("s"), lit(pat)), seed=zlib.crc32(pat.encode()) & 0xFFF)
 
 
 def test_like_null_pattern():
@@ -354,3 +355,66 @@ def test_fused_string_pipeline():
         E.StringRPad(E.StringTrim(col("s")), lit(8), lit(".")),
     )
     check(e, seed=200)
+
+
+# ---------------------------------------------------------------------------
+# regex family (RLike via byte DFA; RegExpReplace via the literal guard)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("pat", [
+    "X", "abc", "a.c", "^a", "c$", "^aXbXc$", "a|b|üñ", "[abc]", "[^abc]",
+    "[a-fX-Z]", r"\d+", r"\d\d", r"[0-9]{2}", "a.*c", "X+", " *", "a?b",
+    r"\.", r"\s", r"\w+$", "(ab|cd)e?", "^$", "", "x{2,4}", r"\d{1,3}",
+    ".", "[%]lit[%]",
+])
+def test_rlike(pat):
+    check(E.RLike(col("s"), lit(pat)), seed=zlib.crc32(pat.encode()) & 0xFFF)
+
+
+def test_rlike_null_pattern():
+    check(E.RLike(col("s"), lit(None)), seed=321)
+
+
+@pytest.mark.parametrize("pat", [
+    "(a", "a**", "a(?=b)", "(a)\\1", "a*?", "[z-a]",
+    "..",  # UTF-8 codepoint expansion blows the 16-state DFA cap
+])
+def test_rlike_unsupported_falls_back(pat):
+    from spark_rapids_tpu.expr.eval import tpu_supports as probe
+
+    ok, why = probe(E.RLike(col("s"), lit(pat)), STR_SCHEMA)
+    assert not ok, pat
+
+
+def test_rlike_too_many_states_falls_back():
+    # distinct-literal alternation forces a wide DFA
+    pat = "|".join(f"w{i}xyz{i}" for i in range(20))
+    from spark_rapids_tpu.expr.eval import tpu_supports as probe
+
+    ok, why = probe(E.RLike(col("s"), lit(pat)), STR_SCHEMA)
+    assert not ok
+
+
+@pytest.mark.parametrize("pat,repl", [
+    ("X", "_"), (r"\.", ";"), ("aXb", ""), ("üñ", "u"), (r"100\%", "c"),
+])
+def test_regexp_replace_literal_guard(pat, repl):
+    check(E.RegExpReplace(col("s"), lit(pat), lit(repl)),
+          seed=zlib.crc32((pat + repl).encode()) & 0xFFF)
+
+
+def test_regexp_replace_nonliteral_falls_back():
+    from spark_rapids_tpu.expr.eval import tpu_supports as probe
+
+    for pat in (r"\d+", "a.c", "x|y"):
+        ok, why = probe(
+            E.RegExpReplace(col("s"), lit(pat), lit("_")), STR_SCHEMA)
+        assert not ok, pat
+    # group references in the replacement are also guarded
+    ok, why = probe(
+        E.RegExpReplace(col("s"), lit("X"), lit("$1")), STR_SCHEMA)
+    assert not ok
+
+
+def test_regexp_replace_nulls():
+    check(E.RegExpReplace(col("s"), lit(None), lit("_")), seed=322)
+    check(E.RegExpReplace(col("s"), lit("X"), lit(None)), seed=323)
