@@ -69,12 +69,33 @@ class HostColumn:
         else:
             storage = _np_storage(dtype)
             data = np.zeros(n, dtype=storage)
-            for i, v in enumerate(values):
-                if v is not None:
-                    data[i] = v
+            from ..types import DecimalType
+
+            if isinstance(dtype, DecimalType):
+                # DECIMAL64: python Decimal/str/int -> unscaled int64
+                import decimal as _d
+
+                q = _d.Decimal(1).scaleb(-dtype.scale)
+                for i, v in enumerate(values):
+                    if v is not None:
+                        data[i] = int(
+                            _d.Decimal(str(v)).quantize(
+                                q, rounding=_d.ROUND_HALF_UP)
+                            .scaleb(dtype.scale))
+            else:
+                for i, v in enumerate(values):
+                    if v is not None:
+                        data[i] = v
         return HostColumn(dtype, data, validity)
 
     def to_pylist(self) -> List[Any]:
+        from ..types import DecimalType
+
+        dec_scale = (
+            self.dtype.scale if isinstance(self.dtype, DecimalType) else None
+        )
+        if dec_scale is not None:
+            import decimal as _d
         out: List[Any] = []
         for i in range(len(self.data)):
             if not self.validity[i]:
@@ -83,6 +104,8 @@ class HostColumn:
                 v = self.data[i]
                 if isinstance(v, np.generic):
                     v = v.item()
+                if dec_scale is not None:
+                    v = _d.Decimal(v).scaleb(-dec_scale)
                 out.append(v)
         return out
 

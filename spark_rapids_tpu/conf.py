@@ -105,6 +105,11 @@ ENABLE_CAST_STRING_TO_FLOAT = conf(
 ENABLE_CAST_STRING_TO_TIMESTAMP = conf(
     "spark.rapids.tpu.sql.castStringToTimestamp.enabled", False,
     "String-to-timestamp casts support a subset of formats.")
+ENABLE_CAST_FLOAT_TO_TIMESTAMP = conf(
+    "spark.rapids.tpu.sql.castFloatToTimestamp.enabled", False,
+    "Float-to-timestamp casts round differently from Spark (reference "
+    "gates the same pair, RapidsConf.scala:487-533); additionally the "
+    "chip's f32-pair f64 emulation overflows for |x| > ~1e38.")
 ENABLE_CAST_STRING_TO_INTEGER = conf(
     "spark.rapids.tpu.sql.castStringToInteger.enabled", False,
     "String-to-integral casts can differ from Spark on malformed-input edge "
@@ -180,6 +185,19 @@ MEMORY_DEBUG = conf(
 SHUFFLE_MESH_SIZE = conf(
     "spark.rapids.tpu.shuffle.meshSize", 0,
     "Number of devices in the exchange mesh (0 = all local devices).")
+AQE_ENABLED = conf(
+    "spark.rapids.tpu.sql.adaptive.enabled", True,
+    "Re-plan exchange reads from materialized per-partition stats: "
+    "coalesce small partitions, split skewed join probes (reference: "
+    "GpuCustomShuffleReaderExec + ShuffledBatchRDD partition specs).")
+AQE_TARGET_ROWS = conf(
+    "spark.rapids.tpu.sql.adaptive.targetPartitionRows", 1 << 20,
+    "Advisory rows per post-AQE partition (coalesce/split target).",
+    check=_positive)
+AQE_SKEW_FACTOR = conf(
+    "spark.rapids.tpu.sql.adaptive.skewedPartitionFactor", 4.0,
+    "A join probe partition is skewed when its rows exceed this multiple "
+    "of the median (and the target rows).")
 SHUFFLE_MODE = conf(
     "spark.rapids.tpu.shuffle.mode", "auto",
     "Exchange lowering: 'ici' lowers shuffle-bounded stages to one SPMD "

@@ -157,6 +157,19 @@ def _cast_to_string(v: Any, frm: T.DataType) -> str:
     raise NotImplementedError(f"cpu cast {frm} -> string")
 
 
+def _dec_quantize(v, out: "T.DecimalType"):
+    """Quantize to the result type's scale (HALF_UP) with Spark's
+    nullOnOverflow: None when the value needs more than ``precision``
+    digits."""
+    import decimal as _dec
+
+    q = v.quantize(_dec.Decimal(1).scaleb(-out.scale),
+                   rounding=_dec.ROUND_HALF_UP)
+    if abs(int(q.scaleb(out.scale))) >= 10 ** out.precision:
+        return None
+    return q
+
+
 def _java_cast(v: Any, frm: T.DataType, to: T.DataType) -> Any:
     if v is None:
         return None
@@ -166,6 +179,23 @@ def _java_cast(v: Any, frm: T.DataType, to: T.DataType) -> Any:
         return _cast_from_string(v, to)
     if isinstance(to, T.StringType):
         return _cast_to_string(v, frm)
+    if isinstance(to, T.DecimalType):
+        import decimal as _dec
+
+        if frm.is_floating:
+            raise ValueError("float->decimal cast not supported")
+        return _dec_quantize(_dec.Decimal(str(v)), to)
+    if isinstance(frm, T.DecimalType):
+        import decimal as _dec
+
+        if to.is_floating:
+            f = float(v)
+            return _f32(f) if isinstance(to, T.FloatType) else f
+        if isinstance(to, T.BooleanType):
+            return v != 0
+        # truncate toward zero then wrap-narrow (Scala BigDecimal.toLong)
+        return _wrap_int(int(v.to_integral_value(
+            rounding=_dec.ROUND_DOWN)), to.name)
     if isinstance(frm, T.DateType) and isinstance(to, T.TimestampType):
         return v * 86_400_000_000
     if isinstance(frm, T.TimestampType) and isinstance(to, T.DateType):
@@ -287,11 +317,38 @@ def eval_row(expr: E.Expression, row: Sequence[Any]) -> Any:
     if isinstance(expr, E.BoundReference):
         return row[expr.ordinal]
 
+    if isinstance(expr, E._DecimalSumCheck):
+        v = ev(expr.child)
+        if v is None:
+            return None
+        import decimal as _dec
+
+        return _dec_quantize(_dec.Decimal(str(v)), expr.dtype)
+
+    if isinstance(expr, E._DecimalAvgEval):
+        s, c = ev(expr.sum), ev(expr.count)
+        if s is None or c is None or c == 0:
+            return None
+        import decimal as _dec
+
+        with _dec.localcontext() as ctx:
+            ctx.prec = 50
+            v = _dec.Decimal(str(s)) / _dec.Decimal(c)
+        return _dec_quantize(v, expr.dtype)
+
     if isinstance(expr, (E.Add, E.Subtract, E.Multiply)):
         l, r = ev(expr.left), ev(expr.right)
         if l is None or r is None:
             return None
         out = expr.dtype
+        if isinstance(out, T.DecimalType):
+            import decimal as _dec
+
+            v = _dec.Decimal(str(l))
+            w = _dec.Decimal(str(r))
+            v = (v + w if isinstance(expr, E.Add)
+                 else v - w if isinstance(expr, E.Subtract) else v * w)
+            return _dec_quantize(v, out)
         l = _java_cast(l, expr.left.dtype, out)
         r = _java_cast(r, expr.right.dtype, out)
         v = l + r if isinstance(expr, E.Add) else (l - r if isinstance(expr, E.Subtract) else l * r)
@@ -301,6 +358,17 @@ def eval_row(expr: E.Expression, row: Sequence[Any]) -> Any:
         l, r = ev(expr.left), ev(expr.right)
         if l is None or r is None:
             return None
+        out = expr.dtype
+        if isinstance(out, T.DecimalType):
+            import decimal as _dec
+
+            w = _dec.Decimal(str(r))
+            if w == 0:
+                return None
+            with _dec.localcontext() as ctx:
+                ctx.prec = 50
+                v = _dec.Decimal(str(l)) / w
+            return _dec_quantize(v, out)
         l, r = _java_cast(l, expr.left.dtype, T.DOUBLE), _java_cast(r, expr.right.dtype, T.DOUBLE)
         if r == 0:
             return None
@@ -698,8 +766,16 @@ def eval_row(expr: E.Expression, row: Sequence[Any]) -> Any:
         import re as _re
 
         # ASCII flag: Java's \w \d \s are ASCII-only (Spark semantics);
-        # Python's default is Unicode
-        return _re.search(p, v, _re.ASCII) is not None
+        # Python's default is Unicode. This CPU stand-in approximates Java
+        # regex with Python re: Java-only constructs (possessive
+        # quantifiers etc.) fail EXPLICITLY rather than silently diverge.
+        try:
+            rx = _re.compile(p, _re.ASCII)
+        except _re.error as e:
+            raise ValueError(
+                f"pattern {p!r} is outside the python-re-compatible "
+                f"subset of Java regex: {e}")
+        return rx.search(v) is not None
 
     if isinstance(expr, E.RegExpReplace):
         v = ev(expr.str)

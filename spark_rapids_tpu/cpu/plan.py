@@ -173,8 +173,17 @@ class CpuFileScanExec(CpuExec):
                         vals.append(None)
             else:
                 data, validity = parts
-                for i in range(n):
-                    vals.append(data[i].item() if validity[i] else None)
+                if isinstance(f.dataType, T.DecimalType):
+                    import decimal as _d
+
+                    s = f.dataType.scale
+                    for i in range(n):
+                        vals.append(
+                            _d.Decimal(int(data[i])).scaleb(-s)
+                            if validity[i] else None)
+                else:
+                    for i in range(n):
+                        vals.append(data[i].item() if validity[i] else None)
             cols.append(vals)
         pmap = dict(pvals)
         for k in pkeys:
@@ -453,10 +462,23 @@ class _AggState:
         if k == "sum":
             if self.count == 0:
                 return None
+            if isinstance(out_dtype, T.DecimalType):
+                from .interpreter import _dec_quantize
+                import decimal as _dec
+
+                return _dec_quantize(_dec.Decimal(self.sum), out_dtype)
             return float(self.sum) if out_dtype.is_floating else self.sum
         if k == "avg":
             if self.count == 0:
                 return None
+            if isinstance(out_dtype, T.DecimalType):
+                from .interpreter import _dec_quantize
+                import decimal as _dec
+
+                with _dec.localcontext() as ctx:
+                    ctx.prec = 50
+                    v = _dec.Decimal(self.sum) / _dec.Decimal(self.count)
+                return _dec_quantize(v, out_dtype)
             return float(self.sum) / self.count
         return self.value
 

@@ -9,6 +9,7 @@ the exchange layer) decides where partitions run.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
@@ -26,6 +27,27 @@ TOTAL_TIME = "totalTime"
 PEAK_DEVICE_MEMORY = "peakDevMemory"
 NUM_INPUT_ROWS = "numInputRows"
 NUM_INPUT_BATCHES = "numInputBatches"
+
+
+_PLANNING = threading.local()
+
+
+@contextlib.contextmanager
+def planning_mode():
+    """Marks plan CONSTRUCTION: adaptive reads report their static
+    partition count instead of materializing their stage (reference: AQE
+    only re-plans at stage boundaries during execution, never in
+    explain)."""
+    prev = getattr(_PLANNING, "on", False)
+    _PLANNING.on = True
+    try:
+        yield
+    finally:
+        _PLANNING.on = prev
+
+
+def in_planning() -> bool:
+    return getattr(_PLANNING, "on", False)
 
 
 class Metric:

@@ -93,7 +93,16 @@ class Count(AggregateFunction):
 
 def _sum_result_type(dt: DataType) -> DataType:
     if isinstance(dt, T.DecimalType):
-        return T.DecimalType(min(dt.precision + 10, T.DecimalType.MAX_PRECISION), dt.scale)
+        # Spark: sum(decimal(p,s)) = decimal(p+10, s). A DECIMAL64 engine
+        # cannot hold that for p > 8 — and silently clamping would let
+        # int64 accumulation wrap into a WRONG non-null answer — so the
+        # aggregate tags unsupported and falls back, exactly the
+        # reference's DECIMAL64 rejection (TypeChecks.scala:453).
+        if dt.precision + 10 > T.DecimalType.MAX_PRECISION:
+            raise TypeError(
+                f"sum({dt}) buffer needs precision {dt.precision + 10} > "
+                f"DECIMAL64 cap {T.DecimalType.MAX_PRECISION}")
+        return T.DecimalType(dt.precision + 10, dt.scale)
     if dt.is_integral or isinstance(dt, T.BooleanType):
         return T.LONG
     return T.DOUBLE
@@ -122,6 +131,9 @@ class Sum(AggregateFunction):
         return ("sum",)
 
     def evaluate(self, refs):
+        if isinstance(self.dtype, T.DecimalType):
+            # Spark wraps decimal sums in CheckOverflow (nullOnOverflow)
+            return E._DecimalSumCheck(refs[0], self.dtype)
         return refs[0]
 
 
@@ -175,28 +187,49 @@ class Max(AggregateFunction):
 
 @dataclasses.dataclass(frozen=True)
 class Average(AggregateFunction):
-    """avg(expr) -> double; buffer = (sum: double, count: long) like Spark."""
+    """avg(expr) -> double (decimal(p+4, s+4) for decimal input, Spark's
+    rule); buffer = (sum, count: long) like Spark."""
 
     child: E.Expression = None  # type: ignore[assignment]
     num_buffers = 2
 
+    def _decimal_in(self):
+        dt = self.child.dtype
+        return dt if isinstance(dt, T.DecimalType) else None
+
     @property
     def dtype(self):
+        d = self._decimal_in()
+        if d is not None:
+            if d.precision + 4 > T.DecimalType.MAX_PRECISION:
+                raise TypeError(
+                    f"avg({d}) result precision {d.precision + 4} > "
+                    f"DECIMAL64 cap")
+            return T.DecimalType(d.precision + 4, d.scale + 4)
+        return T.DOUBLE
+
+    def _sum_type(self):
+        d = self._decimal_in()
+        if d is not None:
+            return _sum_result_type(d)  # raises > DECIMAL64 -> fallback
         return T.DOUBLE
 
     @property
     def buffer_schema(self):
-        return (T.DOUBLE, T.LONG)
+        return (self._sum_type(), T.LONG)
 
     @property
     def update_ops(self):
-        return (("sum", E.Cast(self.child, T.DOUBLE)), ("count", self.child))
+        return (("sum", E.Cast(self.child, self._sum_type())),
+                ("count", self.child))
 
     @property
     def merge_ops(self):
         return ("sum", "sum")
 
     def evaluate(self, refs):
+        if self._decimal_in() is not None:
+            return E._DecimalAvgEval(refs[0], refs[1], self.dtype)
         # sum/count with count==0 -> null (Divide already nulls on 0)
         return E.Divide(refs[0], refs[1])
 

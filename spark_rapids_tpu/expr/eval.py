@@ -88,19 +88,159 @@ def _cast_data(data: jax.Array, frm: DataType, to: DataType) -> jax.Array:
         wide = "bigint" if to.name == "bigint" else "int"
         wdt, lo, hi = _INT_INFO[wide]
         d = jnp.where(jnp.isnan(data), 0.0, data)
-        t = jnp.trunc(d)
-        # avoid jnp.clip: inf propagates to nan on some backends
-        sat = jnp.where(t >= float(hi), hi, 0).astype(wdt)
-        mid = jnp.where((t > float(lo)) & (t < float(hi)), t, 0.0).astype(wdt)
-        low = jnp.where(t <= float(lo), lo, 0).astype(wdt)
+        # handle +-inf via masks BEFORE trunc: emulated-f64 backends turn
+        # trunc(inf) into NaN, which would defeat the saturation compares
+        fin = jnp.isfinite(d)
+        t = jnp.trunc(jnp.where(fin, d, 0.0))
+        sat = jnp.where(
+            jnp.isposinf(d) | (fin & (t >= float(hi))), hi, 0).astype(wdt)
+        mid = jnp.where(
+            fin & (t > float(lo)) & (t < float(hi)), t, 0.0).astype(wdt)
+        low = jnp.where(
+            jnp.isneginf(d) | (fin & (t <= float(lo))), lo, 0).astype(wdt)
         w = sat + mid + low
         return w.astype(npdt)
-    # int->int wraps (Java), int/float->float exact-ish, decimal passthrough
+    if isinstance(to, T.DecimalType):
+        # comparison/promote coercion: upscale to the common (max) scale —
+        # exact by the promote() precision check
+        fs = frm.scale if isinstance(frm, T.DecimalType) else 0
+        d = data.astype(jnp.int64)
+        if to.scale > fs:
+            d = d * jnp.int64(10 ** (to.scale - fs))
+        return d
+    if isinstance(frm, T.DecimalType):
+        if to.is_floating:
+            den = jax.lax.optimization_barrier(
+                jnp.float64(float(10 ** frm.scale)))
+            return (data.astype(jnp.float64) / den).astype(to.to_numpy())
+        return data.astype(to.to_numpy())  # unscaled passthrough (same scale)
+    # int->int wraps (Java), int/float->float exact-ish
     return data.astype(to.to_numpy())
 
 
 def _promote2(l: ColV, ldt, r: ColV, rdt, target: DataType) -> Tuple[jax.Array, jax.Array]:
     return _cast_data(l.data, ldt, target), _cast_data(r.data, rdt, target)
+
+
+# ---------------------------------------------------------------------------
+# DECIMAL64 kernels: int64 unscaled values (reference: the DECIMAL64 rows
+# of GpuCast.scala / decimalExpressions.scala, capped like
+# GpuOverrides.scala:562). Plan-time precision checks (decimal_binary_result)
+# guarantee every intermediate below fits int64; overflow vs the RESULT
+# precision nulls the row (Spark non-ANSI nullOnOverflow).
+# ---------------------------------------------------------------------------
+def _pow10(k: int) -> int:
+    return 10 ** k
+
+
+def _dec_upscale(data: jax.Array, delta: int) -> jax.Array:
+    """unscaled * 10^delta (delta >= 0; plan-time bounds keep it exact)."""
+    if delta == 0:
+        return data
+    return data * jnp.int64(_pow10(delta))
+
+
+def _div_half_up(num: jax.Array, den: jax.Array) -> jax.Array:
+    """round_half_up(num/den) on int64, den > 0, sign-correct (HALF_UP =
+    away from zero on .5, matching java.math.RoundingMode.HALF_UP)."""
+    q = _trunc_div(num, den)
+    rem = num - q * den
+    bump = (jnp.abs(rem) * 2) >= den
+    return jnp.where(bump, q + jnp.sign(num).astype(jnp.int64), q)
+
+
+def _dec_rescale(data: jax.Array, frm_scale: int, to_scale: int) -> jax.Array:
+    if to_scale >= frm_scale:
+        return _dec_upscale(data, to_scale - frm_scale)
+    return _div_half_up(data, jnp.int64(_pow10(frm_scale - to_scale)))
+
+
+def _dec_fits(data: jax.Array, precision: int) -> jax.Array:
+    bound = jnp.int64(_pow10(precision)) if precision < 19 else None
+    if bound is None:
+        return jnp.ones_like(data, jnp.bool_)
+    return (data < bound) & (data > -bound)
+
+
+def _decimal_arith(expr, l: ColV, r: ColV, out) -> ColV:
+    lt, rt = T.as_decimal(expr.left.dtype), T.as_decimal(expr.right.dtype)
+    ld = l.data.astype(jnp.int64)
+    rd = r.data.astype(jnp.int64)
+    valid = l.validity & r.validity
+    if isinstance(expr, E.Multiply):
+        res = ld * rd  # scale s1+s2 == out.scale by construction
+    else:
+        ld = _dec_upscale(ld, out.scale - lt.scale)
+        rd = _dec_upscale(rd, out.scale - rt.scale)
+        res = ld + rd if isinstance(expr, E.Add) else ld - rd
+    ok = _dec_fits(res, out.precision)
+    return ColV(jnp.where(ok, res, 0), valid & ok)
+
+
+def _decimal_divide(expr, l: ColV, r: ColV, out) -> ColV:
+    lt, rt = T.as_decimal(expr.left.dtype), T.as_decimal(expr.right.dtype)
+    # result_unscaled = round(l / r * 10^out.scale)
+    #                 = round(l_unscaled * 10^(out.scale - s1 + s2) / r_unscaled)
+    shift = out.scale - lt.scale + rt.scale
+    # plan-time feasibility: |l_unscaled| < 10^p1, so the shifted numerator
+    # needs p1 + shift <= 18 to stay exact in int64
+    if lt.precision + shift > 18:
+        raise UnsupportedExpressionError(
+            f"decimal divide needs {lt.precision + shift} digits > DECIMAL64")
+    ld = _dec_upscale(l.data.astype(jnp.int64), shift)
+    rd = r.data.astype(jnp.int64)
+    valid = l.validity & r.validity & (rd != 0)
+    safe_r = jnp.where(rd == 0, 1, rd)
+    num = jnp.where(rd < 0, -ld, ld)  # make denominator positive
+    res = _div_half_up(num, jnp.abs(safe_r))
+    ok = _dec_fits(res, out.precision)
+    return ColV(jnp.where(ok, res, 0), valid & ok)
+
+
+def _decimal_cast(c: ColV, frm, to) -> ColV:
+    data = c.data
+    valid = c.validity
+    if isinstance(frm, T.DecimalType) and isinstance(to, T.DecimalType):
+        delta = to.scale - frm.scale
+        if delta > 0 and frm.precision + delta > 18:
+            raise UnsupportedExpressionError(
+                "decimal rescale exceeds DECIMAL64 headroom")
+        res = _dec_rescale(data.astype(jnp.int64), frm.scale, to.scale)
+        ok = _dec_fits(res, to.precision)
+        return ColV(jnp.where(ok, res, 0), valid & ok)
+    if isinstance(to, T.DecimalType):
+        if frm.is_floating:
+            raise UnsupportedExpressionError(
+                "float->decimal cast not supported (string-mediated in "
+                "Spark; falls back like the reference's gated casts)")
+        d = data.astype(jnp.int64)
+        if to.scale > 0:
+            # overflow-safe: values needing more than 18-scale integer
+            # digits null out; test BEFORE multiplying
+            limit = jnp.int64(_pow10(18 - to.scale))
+            pre_ok = (d < limit) & (d > -limit)
+            res = jnp.where(pre_ok, d, 0) * jnp.int64(_pow10(to.scale))
+        else:
+            pre_ok = jnp.ones_like(d, jnp.bool_)
+            res = d
+        ok = pre_ok & _dec_fits(res, to.precision)
+        return ColV(jnp.where(ok, res, 0), valid & ok)
+    # FROM decimal
+    assert isinstance(frm, T.DecimalType)
+    if to.is_floating:
+        # the barrier stops XLA folding /10^s into a reciprocal multiply,
+        # which is 1 ulp off the correctly-rounded quotient Java produces
+        den = jax.lax.optimization_barrier(
+            jnp.float64(float(_pow10(frm.scale))))
+        f = data.astype(jnp.float64) / den
+        return ColV(f.astype(to.to_numpy()), valid)
+    if isinstance(to, T.BooleanType):
+        return ColV(data != 0, valid)
+    # integral: truncate toward zero on the scaled value, then wrap-narrow
+    # (Scala BigDecimal.toLong semantics)
+    whole = _trunc_div(
+        data.astype(jnp.int64), jnp.int64(_pow10(frm.scale)))
+    return ColV(whole.astype(to.to_numpy()), valid)
 
 
 def _trunc_div(l: jax.Array, r: jax.Array) -> jax.Array:
@@ -115,7 +255,10 @@ def _trunc_div(l: jax.Array, r: jax.Array) -> jax.Array:
 def _java_rem(l: jax.Array, r: jax.Array) -> jax.Array:
     if jnp.issubdtype(l.dtype, jnp.floating):
         # C fmod == Java %: NaN for zero divisor/inf dividend, x % inf == x
-        return jnp.fmod(l, r)
+        # (the inf-divisor case restored explicitly: emulated-f64 fmod
+        # NaNs out on it)
+        m = jnp.fmod(l, r)
+        return jnp.where(jnp.isinf(r) & jnp.isfinite(l), l, m)
     rs = jnp.where(r == 0, 1, r)
     return l - _trunc_div(l, rs) * rs
 
@@ -151,20 +294,55 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
             return ColV(jnp.zeros(cap, jnp.bool_), jnp.zeros(cap, jnp.bool_))
         dt = _storage(expr.data_type)
         v = expr.value
+        if v is not None and isinstance(expr.data_type, T.DecimalType):
+            import decimal as _d
+
+            v = int(
+                _d.Decimal(str(v)).scaleb(expr.data_type.scale)
+                .to_integral_value(rounding=_d.ROUND_HALF_UP))
         data = jnp.full((cap,), v if v is not None else 0, dtype=dt)
         valid = jnp.full((cap,), v is not None)
         return ColV(data, valid)
+
+    if isinstance(expr, E._DecimalSumCheck):
+        c = ev(expr.child)
+        ok = _dec_fits(c.data.astype(jnp.int64), expr.result.precision)
+        return ColV(jnp.where(ok, c.data, 0), c.validity & ok)
+
+    if isinstance(expr, E._DecimalAvgEval):
+        s, cnt = ev(expr.sum), ev(expr.count)
+        sum_dt = expr.sum.dtype
+        out = expr.result
+        sd = s.data.astype(jnp.int64)
+        cd = cnt.data.astype(jnp.int64)
+        valid = s.validity & cnt.validity & (cd > 0)
+        safe_c = jnp.where(cd <= 0, 1, cd)
+        shift = jnp.int64(_pow10(out.scale - sum_dt.scale))
+        # avg = round((sum * 10^shift) / count) without overflowing:
+        # q*10^shift + round(rem*10^shift / count); |rem| < count so the
+        # scaled remainder stays far inside int64
+        q = _trunc_div(sd, safe_c)
+        rem = sd - q * safe_c
+        frac = _div_half_up(rem * shift, safe_c)
+        res = q * shift + frac
+        ok = _dec_fits(res, out.precision)
+        return ColV(jnp.where(ok, res, 0), valid & ok)
 
     # ----- arithmetic -----------------------------------------------------
     if isinstance(expr, (E.Add, E.Subtract, E.Multiply)):
         out = expr.dtype
         l, r = ev(expr.left), ev(expr.right)
+        if isinstance(out, T.DecimalType):
+            return _decimal_arith(expr, l, r, out)
         ld, rd = _promote2(l, expr.left.dtype, r, expr.right.dtype, out)
         op = {E.Add: jnp.add, E.Subtract: jnp.subtract, E.Multiply: jnp.multiply}[type(expr)]
         return ColV(op(ld, rd), l.validity & r.validity)
 
     if isinstance(expr, E.Divide):
+        out = expr.dtype
         l, r = ev(expr.left), ev(expr.right)
+        if isinstance(out, T.DecimalType):
+            return _decimal_divide(expr, l, r, out)
         ld = _cast_data(l.data, expr.left.dtype, T.DOUBLE)
         rd = _cast_data(r.data, expr.right.dtype, T.DOUBLE)
         valid = l.validity & r.validity & (rd != 0)
@@ -424,6 +602,8 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
             from .eval_strings import lower_cast_to_string
 
             return lower_cast_to_string(c, frm, cap)
+        if isinstance(frm, T.DecimalType) or isinstance(to, T.DecimalType):
+            return _decimal_cast(c, frm, to)
         valid = c.validity
         if frm.is_floating and isinstance(to, T.TimestampType):
             valid = valid & jnp.isfinite(c.data)  # Spark: NaN/inf -> null
@@ -450,15 +630,36 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
             safe = jnp.where(bad, 1.0 - t, x)
             base = {E.Log: jnp.log, E.Log10: jnp.log10, E.Log2: jnp.log2,
                     E.Log1p: jnp.log1p}[kind]
-            return ColV(base(safe), c.validity & ~bad)
-        return ColV(fns[kind](x), c.validity)
+            r = base(safe)
+            # emulated-f64 backends lose inf through the kernel: log(inf)
+            # is inf by IEEE, restore it explicitly
+            r = jnp.where(jnp.isposinf(x), jnp.inf, r)
+            return ColV(r, c.validity & ~bad)
+        r = fns[kind](x)
+        if kind is E.Sqrt:
+            r = jnp.where(jnp.isposinf(x), jnp.inf, r)
+        elif kind is E.Tanh:
+            # emulated tanh NaNs out for large |x|; the limit is +-1
+            r = jnp.where(jnp.abs(x) > 30.0, jnp.sign(x), r)
+        elif kind in (E.Sinh, E.Cosh):
+            r = jnp.where(jnp.isposinf(x), jnp.inf, r)
+            if kind is E.Sinh:
+                r = jnp.where(jnp.isneginf(x), -jnp.inf, r)
+            else:
+                r = jnp.where(jnp.isneginf(x), jnp.inf, r)
+        return ColV(r, c.validity)
 
     if isinstance(expr, (E.Floor, E.Ceil)):
         c = ev(expr.child)
         if not expr.child.dtype.is_floating:
             return c
         fn = jnp.floor if isinstance(expr, E.Floor) else jnp.ceil
-        return ColV(_cast_data(fn(c.data), T.DOUBLE, T.LONG), c.validity)
+        x = c.data
+        # emulated-f64 floor/ceil NaN out on +-inf; they are identities
+        # there, and the long cast saturates them
+        d = jnp.where(jnp.isfinite(x), fn(jnp.where(jnp.isfinite(x), x, 0.0)),
+                      x)
+        return ColV(_cast_data(d, T.DOUBLE, T.LONG), c.validity)
 
     if isinstance(expr, E.Round):
         c = ev(expr.child)
@@ -477,8 +678,20 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
         return ColV(r.astype(dt.to_numpy()), c.validity)
 
     if isinstance(expr, E.Rint):
+        # Math.rint = round half to even, built from floor + fraction
+        # compare: the composed form stays correct on pair-emulated f64
+        # where the fused round primitive drops the low word at .5 ties
         c = ev(expr.child)
-        return ColV(jnp.round(_cast_data(c.data, expr.child.dtype, T.DOUBLE)), c.validity)
+        x = _cast_data(c.data, expr.child.dtype, T.DOUBLE)
+        fin = jnp.isfinite(x)
+        xs = jnp.where(fin, x, 0.0)
+        f = jnp.floor(xs)
+        d = xs - f
+        even_down = (f % 2.0) == 0.0
+        r = jnp.where(
+            d > 0.5, f + 1.0,
+            jnp.where(d < 0.5, f, jnp.where(even_down, f, f + 1.0)))
+        return ColV(jnp.where(fin, r, x), c.validity)
 
     if isinstance(expr, E.Pow):
         l, r = ev(expr.left), ev(expr.right)

@@ -245,6 +245,12 @@ def _rlike(expr: E.RLike, c: StrV, cap: int) -> ColV:
         return _all_null_col(cap)
     from ..ops import regex as RX
 
+    literal = RX.regex_as_literal(pat)
+    if literal:
+        # literal-equivalent pattern: unanchored search == Contains, with
+        # no DFA state cap (the reference's treated-as-literal guard)
+        synth = E.Contains(expr.left, E.Literal(literal, T.STRING))
+        return _string_predicate(synth, c, cap)
     dfa = _DFA_CACHE.get(pat)
     if dfa is None:
         try:

@@ -118,6 +118,14 @@ class Literal(Expression):
             return Literal(value, T.STRING)
         if isinstance(value, bytes):
             return Literal(value, T.BINARY)
+        import decimal as _dec
+
+        if isinstance(value, _dec.Decimal):
+            # Spark literal decimals take their written precision/scale
+            t = value.as_tuple()
+            scale = max(0, -t.exponent)
+            digits = max(len(t.digits) + max(0, t.exponent), scale + 1)
+            return Literal(value, T.DecimalType(min(digits, 18), scale))
         raise TypeError(f"cannot make literal from {type(value)}")
 
 
@@ -171,7 +179,11 @@ class _BinaryNumeric(Expression):
 
     @property
     def dtype(self):
-        return T.promote(self.left.dtype, self.right.dtype)
+        lt, rt = self.left.dtype, self.right.dtype
+        if isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType):
+            op = {"+": "add", "-": "sub", "*": "mul"}[self.symbol]
+            return T.decimal_binary_result(op, lt, rt)
+        return T.promote(lt, rt)
 
 
 class Add(_BinaryNumeric):
@@ -188,13 +200,17 @@ class Multiply(_BinaryNumeric):
 
 @dataclasses.dataclass(frozen=True)
 class Divide(Expression):
-    """Spark `/` is always floating point; x/0 -> NULL (non-ANSI)."""
+    """Spark `/`: floating point, except decimal/decimal which follows
+    DecimalPrecision division rules; x/0 -> NULL (non-ANSI)."""
 
     left: Expression
     right: Expression
 
     @property
     def dtype(self):
+        lt, rt = self.left.dtype, self.right.dtype
+        if isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType):
+            return T.decimal_binary_result("div", lt, rt)
         return T.DOUBLE
 
 
@@ -856,6 +872,35 @@ class SubstringIndex(Expression):
     @property
     def dtype(self):
         return T.STRING
+
+
+@dataclasses.dataclass(frozen=True)
+class _DecimalSumCheck(Expression):
+    """Internal: nullOnOverflow for decimal SUM results — validity clears
+    when the accumulated unscaled value needs more digits than the result
+    precision (Spark's CheckOverflow around Sum, decimalExpressions.scala)."""
+
+    child: Expression
+    result: "T.DecimalType" = None  # type: ignore[assignment]
+
+    @property
+    def dtype(self):
+        return self.result
+
+
+@dataclasses.dataclass(frozen=True)
+class _DecimalAvgEval(Expression):
+    """Internal: decimal AVERAGE finalization — sum/count rounded HALF_UP
+    at the Spark result scale (s+4), computed exactly in int64 by long
+    division + scaled-remainder division (no 128-bit intermediate)."""
+
+    sum: Expression
+    count: Expression
+    result: "T.DecimalType" = None  # type: ignore[assignment]
+
+    @property
+    def dtype(self):
+        return self.result
 
 
 @dataclasses.dataclass(frozen=True)

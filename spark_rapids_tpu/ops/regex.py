@@ -491,8 +491,13 @@ def _build_dfa(nfa: _Nfa, start: int, accept: int, absorbing: bool) -> Dfa:
         row = [None] * 256
         if cur is ACCEPT_SENTINEL or (absorbing and accept in cur):
             # absorbing accept: all bytes stay accepted
-            aid = dfa_ids.setdefault(ACCEPT_SENTINEL, len(order))
-            if aid == len(order):
+            aid = dfa_ids.get(ACCEPT_SENTINEL)
+            if aid is None:
+                aid = len(order)
+                if aid >= MAX_DFA_STATES:
+                    raise RegexUnsupported(
+                        f"DFA exceeds {MAX_DFA_STATES} states")
+                dfa_ids[ACCEPT_SENTINEL] = aid
                 order.append(ACCEPT_SENTINEL)
             trans.append([aid] * 256)
             continue
@@ -570,10 +575,12 @@ def dfa_accept_rows(offsets, chars, validity, dfa: Dfa):
     ci = chars.astype(jnp.int32)
     lo = jnp.take(lut_lo, ci, mode="clip")
     hi = jnp.take(lut_hi, ci, mode="clip")
-    # segment resets at row starts
+    # segment resets at row starts; out-of-range starts (empty/padding
+    # rows at the end of a FULL char pool) must DROP, not clip — a clip
+    # would plant a bogus reset on the last real byte
     reset = (
         jnp.zeros(ncap, jnp.bool_)
-        .at[jnp.clip(offsets[:cap], 0, max(ncap - 1, 0))]
+        .at[offsets[:cap]]
         .set(True, mode="drop")
     )
 

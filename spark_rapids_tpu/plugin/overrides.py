@@ -23,6 +23,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple, Type
 from .. import types as T
 from ..conf import (
     DECIMAL_ENABLED,
+    ENABLE_CAST_FLOAT_TO_TIMESTAMP,
     ENABLE_CAST_STRING_TO_FLOAT,
     ENABLE_CAST_STRING_TO_INTEGER,
     ENABLE_CAST_STRING_TO_TIMESTAMP,
@@ -246,6 +247,12 @@ def _gated_cast_reasons(bound: E.Expression, conf: RapidsConf) -> List[str]:
     reasons: List[str] = []
 
     def visit(node: E.Expression):
+        if (isinstance(node, E.Cast) and node.child.dtype.is_floating
+                and isinstance(node.to, T.TimestampType)
+                and not conf.get(ENABLE_CAST_FLOAT_TO_TIMESTAMP)):
+            reasons.append(
+                "casting float to timestamp is disabled; set "
+                "spark.rapids.tpu.sql.castFloatToTimestamp.enabled=true")
         if isinstance(node, E.Cast) and isinstance(
             node.child.dtype, T.StringType
         ):
@@ -503,8 +510,18 @@ def _convert_aggregate(cpu: C.CpuHashAggregateExec, conf, children):
         part = HashPartitioning(
             list(range(nk)), _shuffle_partitions(conf, child))
     exchanged = TpuShuffleExchangeExec(conf, partial, part)
+    final_child: TpuExec = exchanged
+    from ..conf import AQE_ENABLED
+
+    if conf.get(AQE_ENABLED) and nk > 0:
+        # lazy AQE: the coalesce plan needs map-side stats, which only
+        # exist at execute time — wrap in a thunk exec that re-plans on
+        # first touch (reference: AQE re-optimizes between query stages)
+        from ..exec.exchange import TpuLazyAQEReadExec
+
+        final_child = TpuLazyAQEReadExec(conf, exchanged)
     return XA.TpuHashAggregateExec(
-        conf, cpu.group_exprs, cpu.agg_exprs, exchanged, A.FINAL)
+        conf, cpu.group_exprs, cpu.agg_exprs, final_child, A.FINAL)
 
 
 def _sortable(dt: T.DataType) -> bool:
@@ -690,6 +707,16 @@ def _convert_join(cpu: C.CpuJoinExec, conf, children):
             partitioned = False
         left = TpuShuffleExchangeExec(conf, left, lpart)
         right = TpuShuffleExchangeExec(conf, right, rpart)
+        from ..conf import AQE_ENABLED
+
+        if partitioned and conf.get(AQE_ENABLED) and cpu.join_type != "full":
+            # skew-split the probe side + coalesce small pairs, specs
+            # index-aligned across both exchanges (full outer excluded:
+            # its unmatched-build pass would emit once per probe slice)
+            from ..exec.exchange import lazy_aqe_join_pair
+
+            left, right = lazy_aqe_join_pair(
+                conf, left, right, probe_left=cpu.join_type != "right")
         return TpuShuffledHashJoinExec(
             conf, left, right, cpu.left_keys, cpu.right_keys,
             cpu.join_type, cpu.condition, partitioned=partitioned,
@@ -889,21 +916,25 @@ class TpuOverrides:
         """CPU plan -> (executable plan, is_tpu_topmost)."""
         if not self.conf.get(SQL_ENABLED):
             return plan, False
-        meta = PlanMeta(plan, self.conf)
-        meta.tag_for_tpu()
-        self.last_meta = meta
-        self.last_explain = explain_plan(meta, self.conf)
-        if self.conf.get(TEST_CONF):
-            allowed = {
-                s.strip()
-                for s in self.conf.get(TEST_ALLOWED_NONTPU).split(",")
-                if s.strip()
-            }
-            bad = [names[0] for names in meta.fallback_name_sets()
-                   if not any(n in allowed for n in names)]
-            if bad:
-                raise AssertionError(
-                    "Part of the plan is not columnar "
-                    f"(fell back to CPU): {bad}\n" + "\n".join(meta.explain_lines())
-                )
-        return meta.convert_if_needed()
+        from ..exec.base import planning_mode
+
+        with planning_mode():  # adaptive reads must not run stages here
+            meta = PlanMeta(plan, self.conf)
+            meta.tag_for_tpu()
+            self.last_meta = meta
+            self.last_explain = explain_plan(meta, self.conf)
+            if self.conf.get(TEST_CONF):
+                allowed = {
+                    s.strip()
+                    for s in self.conf.get(TEST_ALLOWED_NONTPU).split(",")
+                    if s.strip()
+                }
+                bad = [names[0] for names in meta.fallback_name_sets()
+                       if not any(n in allowed for n in names)]
+                if bad:
+                    raise AssertionError(
+                        "Part of the plan is not columnar "
+                        f"(fell back to CPU): {bad}\n"
+                        + "\n".join(meta.explain_lines())
+                    )
+            return meta.convert_if_needed()

@@ -323,6 +323,42 @@ def type_from_name(name: str) -> DataType:
         raise ValueError(f"unknown SQL type name: {name!r}") from None
 
 
+def as_decimal(dt: DataType) -> DecimalType:
+    """The decimal a numeric type widens to for mixed decimal arithmetic
+    (Spark DecimalPrecision). LONG needs decimal(20,0) > DECIMAL64, so
+    mixed long/decimal arithmetic is rejected — same practical limit as
+    the reference's DECIMAL64 cap."""
+    if isinstance(dt, DecimalType):
+        return dt
+    widths = {"tinyint": 3, "smallint": 5, "int": 10}
+    if dt.name in widths:
+        return DecimalType(widths[dt.name], 0)
+    raise TypeError(f"{dt} does not widen to a DECIMAL64 decimal")
+
+
+def decimal_binary_result(op: str, a: DataType, b: DataType) -> DecimalType:
+    """Spark's decimal result types for +,-,*,/ (DecimalPrecision), with
+    the reference's DECIMAL64 rejection when precision exceeds 18
+    (TypeChecks.scala:453 decimal rows): over-cap expressions tag
+    unsupported and fall back instead of adjusting precision."""
+    da, db = as_decimal(a), as_decimal(b)
+    if op in ("add", "sub"):
+        s = max(da.scale, db.scale)
+        p = max(da.precision - da.scale, db.precision - db.scale) + s + 1
+    elif op == "mul":
+        p, s = da.precision + db.precision + 1, da.scale + db.scale
+    elif op == "div":
+        s = max(6, da.scale + db.precision + 1)
+        p = da.precision - da.scale + db.scale + s
+    else:
+        raise ValueError(op)
+    if p > DecimalType.MAX_PRECISION:
+        raise TypeError(
+            f"decimal result {op}({da},{db}) needs precision {p} > "
+            f"DECIMAL64 cap {DecimalType.MAX_PRECISION}")
+    return DecimalType(p, min(s, p))
+
+
 #: numeric widening lattice used by binary-expression type coercion
 _PROMOTION_ORDER = ["tinyint", "smallint", "int", "bigint", "float", "double"]
 
@@ -331,6 +367,11 @@ def promote(a: DataType, b: DataType) -> DataType:
     """Smallest common numeric type (Spark's findTightestCommonType, simplified)."""
     if a == b:
         return a
+    if isinstance(a, DecimalType) != isinstance(b, DecimalType):
+        other = b if isinstance(a, DecimalType) else a
+        if other.is_floating:
+            return DOUBLE  # Spark compares decimal with float as double
+        a, b = as_decimal(a), as_decimal(b)  # raises for bigint (>18 digits)
     if isinstance(a, DecimalType) and isinstance(b, DecimalType):
         # Spark's DecimalPrecision widening with precision-overflow handling:
         # keep integer digits, shed fractional digits (down to a floor) when

@@ -1,0 +1,272 @@
+"""DECIMAL64 differential tests: TPU int64-unscaled kernels vs the CPU
+python-Decimal oracle.
+
+Reference analog: the DECIMAL64 rows of GpuCast.scala /
+decimalExpressions.scala with the precision-18 cap (GpuOverrides.scala:562,
+TypeChecks.scala:453). Covers arithmetic rescaling, overflow-to-null edges,
+casts, comparisons, and sum/avg aggregates.
+"""
+import decimal
+import random
+from decimal import Decimal
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import ColumnarBatch, schema_of
+from spark_rapids_tpu.cpu import eval_expression_rows
+from spark_rapids_tpu.expr import bind_references, col, evaluate_projection, lit
+from spark_rapids_tpu.expr import expressions as E
+from spark_rapids_tpu.expr.eval import tpu_supports
+
+N = 128
+
+
+def gen_decimals(n, rng, p, s, null_prob=0.15, edge_prob=0.2):
+    lim = 10 ** p - 1
+    edges = [0, lim, -lim, 10 ** (p - 1), -(10 ** (p - 1)), 1, -1,
+             lim - 1, -(lim - 1)]
+    out = []
+    for _ in range(n):
+        r = rng.random()
+        if r < null_prob:
+            out.append(None)
+            continue
+        unscaled = (
+            rng.choice(edges) if r < null_prob + edge_prob
+            else rng.randint(-lim, lim)
+        )
+        out.append(Decimal(unscaled).scaleb(-s))
+    return out
+
+
+def make_batch(pa, sa, pb, sb, seed, null_prob=0.15):
+    rng = random.Random(seed)
+    schema = schema_of(a=T.DecimalType(pa, sa), b=T.DecimalType(pb, sb))
+    data = {
+        "a": gen_decimals(N, rng, pa, sa, null_prob),
+        "b": gen_decimals(N, rng, pb, sb, null_prob),
+    }
+    return ColumnarBatch.from_pydict(data, schema), data, schema
+
+
+def check(expr, pa=7, sa=2, pb=7, sb=2, seed=0):
+    from data_gen import ON_TPU, approx_equal
+
+    batch, data, schema = make_batch(pa, sa, pb, sb, seed)
+    bound = bind_references(expr, schema)
+    [tpu_col] = evaluate_projection([bound], batch)
+    tpu_vals = tpu_col.to_pylist()
+    rows = list(zip(data["a"], data["b"]))
+    cpu_vals = eval_expression_rows(bound, rows)
+    for i, (tv, cv) in enumerate(zip(tpu_vals, cpu_vals)):
+        if ON_TPU and isinstance(cv, float):
+            # decimal->float rides the chip's emulated f64 divide: a few
+            # ulps off the correctly-rounded quotient (documented incompat)
+            assert approx_equal(tv, cv, 1e-9), (
+                f"row {i}: tpu={tv!r} cpu={cv!r} expr={expr}")
+            continue
+        assert tv == cv, (
+            f"row {i}: tpu={tv!r} cpu={cv!r} expr={expr} in={rows[i]!r}")
+
+
+# ---------------------------------------------------------------------------
+# arithmetic
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("op", [E.Add, E.Subtract])
+def test_add_sub_same_scale(op):
+    check(op(col("a"), col("b")), seed=1)
+
+
+@pytest.mark.parametrize("op", [E.Add, E.Subtract])
+def test_add_sub_mixed_scale(op):
+    check(op(col("a"), col("b")), pa=9, sa=4, pb=6, sb=1, seed=2)
+
+
+def test_add_overflow_edges():
+    # (18,0) + (18,0) would need precision 19 -> plan-time fallback
+    ok, why = tpu_supports(
+        E.Add(col("a"), col("b")),
+        schema_of(a=T.DecimalType(18, 0), b=T.DecimalType(18, 0)))
+    assert not ok and "DECIMAL64" in why
+
+
+def test_multiply():
+    check(E.Multiply(col("a"), col("b")), pa=7, sa=2, pb=8, sb=3, seed=3)
+
+
+def test_multiply_precision_cap_falls_back():
+    ok, _ = tpu_supports(
+        E.Multiply(col("a"), col("b")),
+        schema_of(a=T.DecimalType(10, 2), b=T.DecimalType(10, 2)))
+    assert not ok
+
+
+def test_divide():
+    check(E.Divide(col("a"), col("b")), pa=5, sa=2, pb=4, sb=1, seed=4)
+
+
+def test_divide_by_zero_is_null():
+    schema = schema_of(a=T.DecimalType(5, 2), b=T.DecimalType(4, 1))
+    batch = ColumnarBatch.from_pydict(
+        {"a": [Decimal("1.25"), Decimal("-3.50")],
+         "b": [Decimal("0.0"), Decimal("0.0")]}, schema)
+    bound = bind_references(E.Divide(col("a"), col("b")), schema)
+    [c] = evaluate_projection([bound], batch)
+    assert c.to_pylist() == [None, None]
+
+
+def test_decimal_int_mixed():
+    schema = schema_of(a=T.DecimalType(7, 2), b=T.INT)
+    rng = random.Random(5)
+    data = {
+        "a": gen_decimals(N, rng, 7, 2),
+        "b": [None if rng.random() < 0.1 else rng.randint(-1000, 1000)
+              for _ in range(N)],
+    }
+    batch = ColumnarBatch.from_pydict(data, schema)
+    bound = bind_references(E.Add(col("a"), col("b")), schema)
+    [c] = evaluate_projection([bound], batch)
+    cpu = eval_expression_rows(bound, list(zip(data["a"], data["b"])))
+    assert c.to_pylist() == cpu
+
+
+def test_unary_minus_abs():
+    check(E.UnaryMinus(col("a")), seed=6)
+    check(E.Abs(col("a")), seed=7)
+
+
+# ---------------------------------------------------------------------------
+# comparisons
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("op", [
+    E.EqualTo, E.LessThan, E.GreaterThan, E.LessThanOrEqual,
+    E.GreaterThanOrEqual,
+])
+def test_comparisons_mixed_scale(op):
+    check(op(col("a"), col("b")), pa=9, sa=4, pb=7, sb=1, seed=8)
+
+
+def test_compare_with_literal():
+    check(E.GreaterThan(col("a"), lit(Decimal("12.34"))), seed=9)
+
+
+# ---------------------------------------------------------------------------
+# casts
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("to", [
+    T.DecimalType(9, 4), T.DecimalType(7, 2), T.DecimalType(5, 0),
+    T.DecimalType(4, 2),
+])
+def test_cast_decimal_to_decimal(to):
+    check(E.Cast(col("a"), to), pa=7, sa=2, seed=10)
+
+
+@pytest.mark.parametrize("to", [T.INT, T.LONG, T.DOUBLE, T.FLOAT, T.BOOLEAN])
+def test_cast_decimal_to_numeric(to):
+    check(E.Cast(col("a"), to), pa=9, sa=3, seed=11)
+
+
+def test_cast_int_to_decimal():
+    schema = schema_of(a=T.INT, b=T.INT)
+    rng = random.Random(12)
+    data = {
+        "a": [None if rng.random() < 0.1
+              else rng.choice([0, 1, -1, 2**31 - 1, -(2**31), 4242])
+              for _ in range(N)],
+        "b": [0] * N,
+    }
+    batch = ColumnarBatch.from_pydict(data, schema)
+    bound = bind_references(E.Cast(col("a"), T.DecimalType(12, 2)), schema)
+    [c] = evaluate_projection([bound], batch)
+    cpu = eval_expression_rows(bound, list(zip(data["a"], data["b"])))
+    assert c.to_pylist() == cpu
+
+
+def test_cast_int_to_small_decimal_overflows_null():
+    schema = schema_of(a=T.INT, b=T.INT)
+    batch = ColumnarBatch.from_pydict(
+        {"a": [12345, 12, -99999], "b": [0, 0, 0]}, schema)
+    bound = bind_references(E.Cast(col("a"), T.DecimalType(4, 2)), schema)
+    [c] = evaluate_projection([bound], batch)
+    assert c.to_pylist() == [None, Decimal("12.00"), None]
+
+
+def test_float_to_decimal_falls_back():
+    ok, why = tpu_supports(
+        E.Cast(col("a"), T.DecimalType(9, 2)), schema_of(a=T.DOUBLE, b=T.INT))
+    assert not ok
+
+
+# ---------------------------------------------------------------------------
+# aggregates (through the exec layer: TPU vs CPU plan)
+# ---------------------------------------------------------------------------
+def _agg_both(data, schema, keys, aggs):
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.exec import InMemoryScanExec, TpuHashAggregateExec
+    from spark_rapids_tpu.cpu.plan import (
+        CpuHashAggregateExec,
+        CpuScanExec,
+    )
+
+    conf = RapidsConf({})
+    batch = ColumnarBatch.from_pydict(data, schema)
+    tpu = TpuHashAggregateExec(
+        conf, keys, aggs, InMemoryScanExec(conf, [[batch]], schema))
+    trows = []
+    for b in tpu.execute_columnar():
+        trows.extend(b.to_rows())
+    rows = list(zip(*[data[f.name] for f in schema.fields]))
+    cpu = CpuHashAggregateExec(
+        conf, keys, aggs, CpuScanExec(conf, [rows], schema))
+    crows = cpu.collect()
+    return sorted(trows, key=repr), sorted(crows, key=repr)
+
+
+def test_sum_avg_group_by():
+    from spark_rapids_tpu.expr import aggregates as A
+
+    rng = random.Random(13)
+    schema = schema_of(k=T.INT, d=T.DecimalType(7, 2))
+    data = {
+        "k": [rng.randint(0, 5) for _ in range(N)],
+        "d": gen_decimals(N, rng, 7, 2),
+    }
+    t, c = _agg_both(
+        data, schema, [col("k")],
+        [A.agg(A.Sum(col("d")), "s"), A.agg(A.Average(col("d")), "m"),
+         A.agg(A.Min(col("d")), "lo"), A.agg(A.Max(col("d")), "hi")])
+    assert t == c
+
+
+def test_sum_beyond_decimal64_falls_back():
+    from spark_rapids_tpu.expr import aggregates as A
+
+    # Spark types sum(decimal(p,s)) as decimal(p+10,s): beyond the
+    # DECIMAL64 cap the aggregate must REJECT (int64 accumulation could
+    # wrap into a wrong non-null answer) — review regression
+    with pytest.raises(TypeError, match="DECIMAL64"):
+        _ = A.Sum(E.BoundReference(0, T.DecimalType(18, 0), True)).dtype
+    # p <= 8 stays on device
+    assert isinstance(
+        A.Sum(E.BoundReference(0, T.DecimalType(8, 2), True)).dtype,
+        T.DecimalType)
+
+
+def test_avg_precision_cap_falls_back():
+    from spark_rapids_tpu.expr import aggregates as A
+
+    with pytest.raises(TypeError):
+        A.Average(col("x")).__class__(
+            E.BoundReference(0, T.DecimalType(17, 2), True)).dtype
+
+
+# ---------------------------------------------------------------------------
+# round-trip
+# ---------------------------------------------------------------------------
+def test_roundtrip_pydict():
+    schema = schema_of(a=T.DecimalType(6, 3), b=T.INT)
+    vals = [Decimal("1.234"), None, Decimal("-999.999"), Decimal("0.000")]
+    batch = ColumnarBatch.from_pydict(
+        {"a": vals, "b": [1, 2, 3, 4]}, schema)
+    assert [r[0] for r in batch.to_rows()] == vals
