@@ -978,6 +978,11 @@ def eval_row(expr: E.Expression, row: Sequence[Any]) -> Any:
             return None
         return d.toordinal() - _EPOCH_ORD
 
+    if isinstance(expr, E.NativeUDF):
+        # CPU fallback = the UDF's row function (reference: a RapidsUDF
+        # still has its ordinary row-based evaluate)
+        return expr.row_fn(*[ev(c) for c in expr.children_])
+
     if isinstance(expr, E.PythonUDF):
         # row-by-row python execution — the fallback path for UDFs the
         # bytecode compiler can't lower (reference: ScalaUDF staying on the

@@ -328,6 +328,12 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
         ok = _dec_fits(res, out.precision)
         return ColV(jnp.where(ok, res, 0), valid & ok)
 
+    if isinstance(expr, E.NativeUDF):
+        # native UDF (reference: RapidsUDF.evaluateColumnar) traced INTO
+        # the fused projection program
+        vals = [ev(c) for c in expr.children_]
+        return expr.columnar_fn(cap, *vals)
+
     # ----- arithmetic -----------------------------------------------------
     if isinstance(expr, (E.Add, E.Subtract, E.Multiply)):
         out = expr.dtype
@@ -814,6 +820,20 @@ def tpu_supports(expr: E.Expression, schema: T.StructType) -> Tuple[bool, str]:
         return False, str(e)
     except TypeError as e:
         return False, str(e)
+    except Exception as e:  # noqa: BLE001
+        # a native UDF's columnar function may raise anything during the
+        # abstract trace (reference: a RapidsUDF throwing in
+        # evaluateColumnar falls back to the row path)
+        if any(isinstance(n, E.NativeUDF)
+               for n in _walk_expressions(expr)):
+            return False, f"native UDF columnar trace failed: {e}"
+        raise
+
+
+def _walk_expressions(expr: E.Expression):
+    yield expr
+    for c in expr.children:
+        yield from _walk_expressions(c)
 
 
 def evaluate_projection(

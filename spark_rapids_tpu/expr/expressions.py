@@ -1150,6 +1150,27 @@ class PythonUDF(Expression):
         return f"pythonUDF({getattr(self.func, '__name__', '?')})"
 
 
+@dataclasses.dataclass(frozen=True)
+class NativeUDF(Expression):
+    """A native TPU UDF (reference: RapidsUDF.java:22): the user supplies
+    a COLUMNAR JAX/Pallas function the engine traces into its fused
+    projection, plus the row function for the CPU fallback — exactly the
+    evaluateColumnar/evaluate pairing of the reference's interface."""
+
+    columnar_fn: Any
+    row_fn: Any
+    children_: Tuple[Expression, ...]
+    return_type: DataType
+
+    @property
+    def dtype(self):
+        return self.return_type
+
+    @property
+    def pretty_name(self):
+        return f"nativeUDF({getattr(self.columnar_fn, '__name__', '?')})"
+
+
 # ---------------------------------------------------------------------------
 # Binding / resolution
 # ---------------------------------------------------------------------------

@@ -72,9 +72,27 @@ def _float_radix(data: jax.Array) -> jax.Array:
     zero64 = jnp.float64(0.0)
     d = jnp.where(jnp.isnan(data), canon_nan64, data)
     d = jnp.where(d == zero64, zero64, d)
-    bits = lax.bitcast_convert_type(d, jnp.uint64)
-    sign64 = jnp.uint64(1 << 63)
-    return jnp.where(bits & sign64 != 0, ~bits, bits ^ sign64)
+    import jax as _jax
+
+    if _jax.default_backend() == "cpu":
+        bits = lax.bitcast_convert_type(d, jnp.uint64)
+        sign64 = jnp.uint64(1 << 63)
+        return jnp.where(bits & sign64 != 0, ~bits, bits ^ sign64)
+    # TPU: f64 is f32-PAIR emulated and the x64 rewriter has no 64-bit
+    # bitcast. The pair decomposition (hi = fl32(x), lo = x - hi) is
+    # order-preserving — hi is monotone in x, lo orders equal-hi values —
+    # and captures every value this number system can represent.
+    hi = d.astype(jnp.float32)
+    lo = (d - hi.astype(jnp.float64)).astype(jnp.float32)
+    lo = jnp.where(jnp.isnan(lo), jnp.float32(0), lo)  # NaN rows: hi wins
+
+    def f32key(x):
+        b = lax.bitcast_convert_type(x, jnp.uint32)
+        s = jnp.uint32(1 << 31)
+        return jnp.where(b & s != 0, ~b, b ^ s)
+
+    return (f32key(hi).astype(jnp.uint64) << 32) | f32key(lo).astype(
+        jnp.uint64)
 
 
 def fixed_radix_keys(col: ColV, dtype: T.DataType, order: SortOrder) -> List[jax.Array]:

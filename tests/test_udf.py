@@ -13,6 +13,9 @@ from spark_rapids_tpu.expr import expressions as E
 from spark_rapids_tpu.expr.expressions import col, lit
 from spark_rapids_tpu.sql import TpuSession
 from spark_rapids_tpu.udf import compile_udf, udf
+from spark_rapids_tpu.columnar import ColumnarBatch, schema_of
+from spark_rapids_tpu.cpu import eval_expression_rows
+from spark_rapids_tpu.expr import bind_references, evaluate_projection
 
 from harness import assert_tpu_and_cpu_equal, compare_rows
 
@@ -181,3 +184,79 @@ def test_disabled_key_keeps_udf_on_cpu():
     u = udf(lambda x: x + 1)
     df.where(E.IsNotNull(col("a"))).select(E.Alias(u(col("a")), "r")).collect()
     assert "CpuProjectExec" in sess.last_executed_plan.tree_string()
+
+
+# ---------------------------------------------------------------------------
+# native (JAX/Pallas) UDFs — reference: RapidsUDF.java:22 + the in-tree
+# CUDA example (string_word_count.cu)
+# ---------------------------------------------------------------------------
+def test_native_udf_numeric():
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.expr.eval import ColV
+    from spark_rapids_tpu.udf.native import tpu_udf
+
+    def columnar(cap, a, b):
+        return ColV(a.data * 2 + b.data, a.validity & b.validity)
+
+    def row(a, b):
+        if a is None or b is None:
+            return None
+        return a * 2 + b
+
+    f = tpu_udf(columnar, row, T.LONG)
+    schema = schema_of(a=T.LONG, b=T.LONG)
+    batch = ColumnarBatch.from_pydict(
+        {"a": [1, None, 3, -5], "b": [10, 20, None, 40]}, schema)
+    bound = bind_references(f(col("a"), col("b")), schema)
+    [r] = evaluate_projection([bound], batch)
+    cpu = eval_expression_rows(bound, [(1, 10), (None, 20), (3, None), (-5, 40)])
+    assert r.to_pylist() == cpu == [12, None, None, 30]
+
+
+def test_native_udf_fuses_with_projection():
+    """The native UDF lowers INSIDE the fused projection (no special exec)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.expr.eval import ColV, tpu_supports
+    from spark_rapids_tpu.udf.native import tpu_udf
+
+    f = tpu_udf(lambda cap, a: ColV(a.data + 1, a.validity),
+                lambda a: None if a is None else a + 1, T.LONG)
+    schema = schema_of(a=T.LONG, b=T.LONG)
+    expr = E.Multiply(f(col("a")), lit(3))
+    ok, why = tpu_supports(expr, schema)
+    assert ok, why
+    batch = ColumnarBatch.from_pydict({"a": [1, 2], "b": [0, 0]}, schema)
+    [r] = evaluate_projection([bind_references(expr, schema)], batch)
+    assert r.to_pylist() == [6, 9]
+
+
+def test_native_udf_bad_columnar_falls_back():
+    from spark_rapids_tpu.expr.eval import tpu_supports
+    from spark_rapids_tpu.udf.native import tpu_udf
+
+    def broken(cap, a):
+        raise RuntimeError("no kernel for this dtype")
+
+    f = tpu_udf(broken, lambda a: a, T.LONG)
+    ok, why = tpu_supports(f(col("a")), schema_of(a=T.LONG, b=T.LONG))
+    assert not ok
+
+
+def test_string_word_count_pallas():
+    """The in-tree Pallas example vs the row oracle (reference:
+    string_word_count.cu differential tests)."""
+    from spark_rapids_tpu.udf.native import string_word_count
+
+    vals = ["hello world", "", None, "  leading", "trailing  ", "a",
+            "tabs\tand\nnewlines\there", "   ", "ünï códe wörds",
+            "x " * 200, "one-token", " a b c d e f g "]
+    schema = schema_of(s=T.STRING, t=T.STRING)
+    batch = ColumnarBatch.from_pydict(
+        {"s": vals, "t": [""] * len(vals)}, schema)
+    bound = bind_references(string_word_count(col("s")), schema)
+    [r] = evaluate_projection([bound], batch)
+    cpu = eval_expression_rows(bound, [(v, "") for v in vals])
+    assert r.to_pylist() == cpu
+    assert cpu[0] == 2 and cpu[1] == 0 and cpu[2] is None and cpu[8] == 3
