@@ -9,7 +9,8 @@ import subprocess
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-SOURCES = [os.path.join(HERE, "src", "lz4.cpp")]
+SOURCES = [os.path.join(HERE, "src", "lz4.cpp"),
+           os.path.join(HERE, "src", "parquet_decode.cpp")]
 OUT = os.path.join(HERE, "libsrtpu.so")
 
 

@@ -1,0 +1,130 @@
+// Parquet RLE/bit-packed hybrid stream decoder, C++17, no dependencies.
+//
+// Reference analog: the native half of the reference's parquet decode —
+// cudf's gpuDecodePages kernels behind GpuParquetScan.scala:1157. On TPU
+// the dictionary-code EXPANSION happens on-device (XLA gathers,
+// io/parquet_device.py); this native routine covers the host half that was
+// previously vectorized-numpy: expanding the RLE/bit-packed hybrid streams
+// (dictionary indices and definition levels) into narrow integer arrays.
+// Called per page through ctypes; releases the GIL, so the per-column
+// planning thread pool gets real parallelism.
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// Decode one hybrid stream of n values (bit width bw) into out[0..n).
+// Returns the byte position just after the stream, or -1 on malformed /
+// short input. T is the output element (u8/u16/i32 picked by caller).
+template <typename T>
+int64_t decode_hybrid(const uint8_t* data, int64_t pos, int64_t end, int bw,
+                      int64_t n, T* out) {
+    if (bw == 0) {
+        std::memset(out, 0, sizeof(T) * static_cast<size_t>(n));
+        return pos;
+    }
+    if (bw < 0 || bw > 24) return -1;
+    const int byte_w = (bw + 7) / 8;
+    const uint32_t mask = (1u << bw) - 1;
+    constexpr int64_t kMaxRuns = int64_t{1} << 20;  // adversarial-file guard
+    int64_t got = 0;
+    int64_t runs = 0;
+    while (got < n && pos < end) {
+        if (++runs > kMaxRuns) return -1;
+        uint64_t header = 0;
+        int shift = 0;
+        while (true) {
+            if (pos >= end || shift > 56) return -1;
+            uint8_t b = data[pos++];
+            header |= static_cast<uint64_t>(b & 0x7F) << shift;
+            if (!(b & 0x80)) break;
+            shift += 7;
+        }
+        if (header & 1) {  // bit-packed run: (header>>1) groups of 8
+            const int64_t groups = static_cast<int64_t>(header >> 1);
+            // bound BEFORE multiplying: a huge varint must not wrap the
+            // products negative and slip past the range checks below
+            if (groups < 0 || groups > (end - pos) / bw + 8) return -1;
+            const int64_t count = groups * 8;
+            const int64_t nbytes = groups * bw;
+            if (pos + nbytes > end) return -1;
+            const int64_t take = std::min(count, n - got);
+            const uint8_t* p = data + pos;
+            uint64_t buf = 0;
+            int bits = 0;
+            int64_t bi = 0;
+            for (int64_t i = 0; i < take; ++i) {
+                while (bits < bw) {
+                    buf |= static_cast<uint64_t>(p[bi++]) << bits;
+                    bits += 8;
+                }
+                out[got + i] = static_cast<T>(buf & mask);
+                buf >>= bw;
+                bits -= bw;
+            }
+            pos += nbytes;
+            got += count;  // trailing pad values advance the logical count
+        } else {  // RLE run
+            const int64_t count = static_cast<int64_t>(header >> 1);
+            if (pos + byte_w > end) return -1;
+            uint32_t v = 0;
+            for (int i = 0; i < byte_w; ++i)
+                v |= static_cast<uint32_t>(data[pos + i]) << (8 * i);
+            pos += byte_w;
+            const int64_t take = std::min(count, n - got);
+            std::fill(out + got, out + got + take, static_cast<T>(v));
+            got += count;
+        }
+    }
+    return got < n ? -1 : pos;
+}
+
+}  // namespace
+
+extern "C" {
+
+// out_width selects the output element size: 1 (u8), 2 (u16), 4 (i32).
+// Returns the byte position after the stream, or -1 on error.
+int64_t srtpu_pq_hybrid_decode(const uint8_t* data, int64_t pos, int64_t end,
+                               int32_t bw, int64_t n, int32_t out_width,
+                               void* out) {
+    switch (out_width) {
+        case 1:
+            return decode_hybrid(data, pos, end, bw, n,
+                                 static_cast<uint8_t*>(out));
+        case 2:
+            return decode_hybrid(data, pos, end, bw, n,
+                                 static_cast<uint16_t*>(out));
+        case 4:
+            return decode_hybrid(data, pos, end, bw, n,
+                                 static_cast<int32_t*>(out));
+        default:
+            return -1;
+    }
+}
+
+// Parse a BYTE_ARRAY PLAIN dictionary page: count (u32-len, bytes) entries.
+// Writes count+1 int32 offsets and the concatenated chars; returns total
+// char bytes, or -1 if the payload is malformed / chars overflow char_cap.
+int64_t srtpu_pq_binary_dict(const uint8_t* raw, int64_t len, int64_t count,
+                             int32_t* offsets, uint8_t* chars,
+                             int64_t char_cap) {
+    int64_t p = 0;
+    int64_t total = 0;
+    offsets[0] = 0;
+    for (int64_t i = 0; i < count; ++i) {
+        if (p + 4 > len) return -1;
+        uint32_t ln;
+        std::memcpy(&ln, raw + p, 4);
+        p += 4;
+        if (p + ln > len || total + ln > char_cap) return -1;
+        std::memcpy(chars + total, raw + p, ln);
+        p += ln;
+        total += ln;
+        offsets[i + 1] = static_cast<int32_t>(total);
+    }
+    return total;
+}
+
+}  // extern "C"

@@ -246,10 +246,12 @@ class TpuHashAggregateExec(TpuExec):
 
     def _run_batch(self, batch: ColumnarBatch, ops: Sequence[str],
                    value_exprs: Sequence[Optional[E.Expression]],
-                   chain=()) -> ColumnarBatch:
+                   chain=(), live=None) -> ColumnarBatch:
         """Aggregate one (source) batch into a [keys..., buffers...] batch,
         fusing any fusable child execs into the same XLA program. The group
-        count stays a device scalar — no sync."""
+        count stays a device scalar — no sync. ``live``: optional (cap,)
+        bool mask overriding the batch's prefix row count (used by the
+        sync-free merge, where live rows are NOT a prefix)."""
         cap = batch.capacity if batch.columns else bucket_rows(
             batch.num_rows, self.conf.shape_bucket_min)
         sml = self._str_max_lens(batch, direct=not chain)
@@ -263,24 +265,81 @@ class TpuHashAggregateExec(TpuExec):
             sides=sides,
         )
         keys, aggs, nseg = fn(
-            vals_of_batch(batch), count_scalar(batch.num_rows_lazy), sides)
+            vals_of_batch(batch),
+            live if live is not None else count_scalar(batch.num_rows_lazy),
+            sides)
         vals = list(keys) + list(aggs)
         return batch_from_vals(vals, self._buffer_schema, nseg)
+
+    #: sync-free merges stack partials at CAPACITY; above this many stacked
+    #: rows the dead-row blowup outweighs the saved host RTT (low-
+    #: cardinality aggregates over many batches), so the synced path wins
+    _SYNC_FREE_MERGE_MAX_ROWS = 1 << 24
+
+    def _merge_fixed_width(self, partials: List[ColumnarBatch]) -> ColumnarBatch:
+        """Sync-free merge for fixed-width buffer schemas: partials stack
+        at capacity on device with a live mask, so row counts never leave
+        the device (a host pull costs a full tunnel RTT per batch)."""
+        caps = [max(1, b.capacity) for b in partials]
+        out_cap = bucket_rows(sum(caps), self.conf.shape_bucket_min)
+        cols, mask, total = concat_ops.concat_padded_cols(
+            [vals_of_batch(b) for b in partials],
+            [count_scalar(b.num_rows_lazy) for b in partials], out_cap)
+        merged_in = batch_from_vals(cols, self._buffer_schema, total)
+        nk = len(self._key_fields)
+        merge_exprs: List[Optional[E.Expression]] = [
+            E.BoundReference(nk + j, f.dataType, True)
+            for j, f in enumerate(self._buf_fields)
+        ]
+        saved_bound = self._bound_keys
+        self._bound_keys = [
+            E.BoundReference(i, f.dataType, f.nullable)
+            for i, f in enumerate(self._key_fields)
+        ]
+        try:
+            return self._run_batch(
+                merged_in, self._merge_ops, merge_exprs, live=mask)
+        finally:
+            self._bound_keys = saved_bound
 
     def _merge(self, partials: List[ColumnarBatch]) -> ColumnarBatch:
         """Concat partial batches and re-aggregate with merge ops
         (reference: concatenateBatches + merge pass, aggregate.scala:451-476)."""
+        str_cols = [
+            j for j, f in enumerate(self._buffer_schema.fields)
+            if isinstance(f.dataType, (T.StringType, T.BinaryType))
+        ]
+        if (len(partials) > 1 and not str_cols
+                and sum(max(1, b.capacity) for b in partials)
+                <= self._SYNC_FREE_MERGE_MAX_ROWS):
+            return self._merge_fixed_width(partials)
         while len(partials) > 1:
-            lengths = [b.num_rows for b in partials]
+            # ONE batched host pull for every row count and string byte
+            # length (each separate pull pays a tunnel RTT)
+            import jax as _jax
+
+            head = [count_scalar(b.num_rows_lazy) for b in partials]
+            nb = len(partials)
+            for b in partials:
+                for j in str_cols:
+                    c = b.columns[j]
+                    nr = b.num_rows_lazy
+                    idx = (min(nr, c.offsets.shape[0] - 1)
+                           if isinstance(nr, int) else nr)
+                    head.append(c.offsets[idx])
+            pulled = [int(x) for x in _jax.device_get(head)]
+            lengths = pulled[:nb]
+            for b, n in zip(partials, lengths):
+                if not isinstance(b.num_rows_lazy, int):
+                    b._num_rows = n
+                    for c in b.columns:
+                        c.length = n
             total = sum(lengths)
             out_cap = bucket_rows(total, self.conf.shape_bucket_min)
-            str_cols = [
-                j for j, f in enumerate(self._buffer_schema.fields)
-                if isinstance(f.dataType, (T.StringType, T.BinaryType))
-            ]
+            ns = len(str_cols)
             byte_lengths = [
-                [int(b.columns[j].offsets[b.num_rows]) for j in str_cols]
-                for b in partials
+                pulled[nb + i * ns : nb + (i + 1) * ns]
+                for i in range(nb)
             ]
             out_char_caps = [
                 bucket_rows(max(1, sum(bl[k] for bl in byte_lengths)), 128)
@@ -296,19 +355,17 @@ class TpuHashAggregateExec(TpuExec):
                 E.BoundReference(nk + j, f.dataType, True)
                 for j, f in enumerate(self._buf_fields)
             ]
-            saved_keys, saved_bound = self._key_fields, self._bound_keys
-            self_bound = [
+            saved_bound = self._bound_keys
+            self._bound_keys = [
                 E.BoundReference(i, f.dataType, f.nullable)
                 for i, f in enumerate(self._key_fields)
             ]
-            self._bound_keys = self_bound
             try:
                 partials = [
                     self._run_batch(merged_in, self._merge_ops, merge_exprs)
                 ]
             finally:
                 self._bound_keys = saved_bound
-                self._key_fields = saved_keys
         return partials[0]
 
     def _evaluate(self, buffers: ColumnarBatch) -> ColumnarBatch:

@@ -281,6 +281,34 @@ def hybrid_decode_np(data: bytes, pos: int, end: int, bw: int,
     return out, pos
 
 
+def _code_dtype(bw: int):
+    return (np.uint8 if bw <= 8 else
+            np.uint16 if bw <= 16 else np.int32)
+
+
+def hybrid_decode(data, pos: int, end: int, bw: int,
+                  n: int) -> Tuple[np.ndarray, int]:
+    """Hybrid-stream decode, native C++ when available (releases the GIL,
+    so the per-column planning pool gets real parallelism; reference
+    analog: cudf's native page decode behind GpuParquetScan.scala:1157).
+    Output dtype is the narrowest holding the bit width."""
+    if bw == 0:
+        return np.zeros(n, np.uint8), pos
+    if bw > 24:
+        raise _FallbackError(f"bit width {bw}")
+    from ..native import pq_hybrid_decode
+
+    out = np.empty(n, _code_dtype(bw))
+    try:
+        newpos = pq_hybrid_decode(data, pos, end, bw, n, out)
+    except ValueError as e:
+        raise _FallbackError(str(e))
+    if newpos is None:  # no native toolchain: vectorized-numpy fallback
+        vals, newpos = hybrid_decode_np(data, pos, end, bw, n)
+        return vals.astype(out.dtype, copy=False), newpos
+    return out, newpos
+
+
 # ---------------------------------------------------------------------------
 # host planning: file bytes -> upload arrays per column chunk
 # ---------------------------------------------------------------------------
@@ -363,7 +391,7 @@ def plan_chunk(
         nonlocal saw_dict_page, saw_plain_page
         if enc in (ENC_RLE_DICTIONARY, ENC_PLAIN_DICTIONARY):
             bw = raw[p] if p < len(raw) else 0
-            vals, _ = hybrid_decode_np(raw, p + 1, pend, bw, presents)
+            vals, _ = hybrid_decode(raw, p + 1, pend, bw, presents)
             code_pages.append(vals)
             saw_dict_page = True
         elif enc == ENC_PLAIN:
@@ -401,7 +429,7 @@ def plan_chunk(
                 (ln,) = _struct.unpack_from("<I", raw, p)
                 p += 4
                 if has_nulls:
-                    levels, _ = hybrid_decode_np(
+                    levels, _ = hybrid_decode(
                         raw, p, p + ln, 1, ph.num_values)
                     vp = levels == 1
                     valid_pages.append(vp)
@@ -417,7 +445,7 @@ def plan_chunk(
                 ph.num_nulls if max_def > 0 else 0)
             if max_def > 0 and has_nulls:
                 if ph.def_levels_len:
-                    levels, _ = hybrid_decode_np(
+                    levels, _ = hybrid_decode(
                         payload, 0, ph.def_levels_len, 1, ph.num_values)
                     valid_pages.append(levels == 1)
                 else:
@@ -436,13 +464,20 @@ def plan_chunk(
     if valid_pages:
         plan.validity = np.concatenate(valid_pages)
     if code_pages:
+        # pages already decoded to the narrowest dtype for their bit width;
+        # concatenate promotes to the widest page's dtype
         codes = (np.concatenate(code_pages) if len(code_pages) > 1
                  else code_pages[0])
         plan.n_present = codes.shape[0]
-        mx = int(codes.max()) if codes.shape[0] else 0
-        plan.codes = codes.astype(
-            np.uint8 if mx < 256 else
-            np.uint16 if mx < 65536 else np.int32)
+        if codes.dtype.itemsize > 1 and codes.shape[0]:
+            # narrow further when the observed max allows (pages of one
+            # chunk may carry a wider bit width than the values need)
+            mx = int(codes.max())
+            want = (np.uint8 if mx < 256 else
+                    np.uint16 if mx < 65536 else None)
+            if want is not None and np.dtype(want).itemsize < codes.dtype.itemsize:
+                codes = codes.astype(want)
+        plan.codes = codes
     elif plain_parts:
         plan.plain_bytes = b"".join(plain_parts)
         dt = _PHYS_NP[phys]
@@ -455,6 +490,20 @@ def plan_chunk(
 
 def _load_dictionary(plan: ChunkPlan, raw: bytes, count: int) -> None:
     if plan.phys == "BYTE_ARRAY":
+        from ..native import pq_binary_dict
+
+        offs32 = np.empty(count + 1, np.int32)
+        cap = max(1, len(raw) - 4 * count)
+        chars_buf = np.empty(cap, np.uint8)
+        try:
+            total = pq_binary_dict(raw, count, offs32, chars_buf)
+        except ValueError:
+            raise _FallbackError("malformed binary dictionary")
+        if total is not None:
+            plan.dict_offsets = offs32.astype(np.int64)
+            plan.dict_chars = (chars_buf[:total].copy() if total
+                               else np.zeros(1, np.uint8))
+            return
         offs = np.zeros(count + 1, np.int64)
         chars = []
         p = 0

@@ -45,6 +45,15 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.srtpu_lz4_decompress.argtypes = [
                 ctypes.c_char_p, ctypes.c_int,
                 ctypes.POINTER(ctypes.c_char), ctypes.c_int]
+            lib.srtpu_pq_hybrid_decode.restype = ctypes.c_int64
+            lib.srtpu_pq_hybrid_decode.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_void_p]
+            lib.srtpu_pq_binary_dict.restype = ctypes.c_int64
+            lib.srtpu_pq_binary_dict.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
             _LIB = lib
         except Exception:
             _LIB = None
@@ -67,6 +76,44 @@ def lz4_compress(data: bytes) -> bytes:
     if n <= 0:
         raise RuntimeError("lz4 compression failed")
     return buf.raw[:n]
+
+
+def pq_hybrid_decode(data, pos: int, end: int, bw: int, n: int, out):
+    """Expand one parquet RLE/bit-packed hybrid stream into ``out`` (a
+    contiguous numpy array of u8/u16/i32, len >= n). Returns the byte
+    position after the stream or None when the native library is
+    unavailable; raises ValueError on malformed input. Releases the GIL
+    for the duration of the decode."""
+    lib = _load()
+    if lib is None:
+        return None
+    import numpy as np
+
+    src = np.frombuffer(data, np.uint8)  # zero-copy view (bytes or mmap)
+    rc = lib.srtpu_pq_hybrid_decode(
+        src.ctypes.data, pos, min(end, src.shape[0]), bw, n,
+        out.dtype.itemsize, out.ctypes.data)
+    if rc < 0:
+        raise ValueError(f"malformed hybrid stream (bw={bw}, n={n})")
+    return int(rc)
+
+
+def pq_binary_dict(raw: bytes, count: int, offsets, chars) -> Optional[int]:
+    """Parse a BYTE_ARRAY PLAIN dictionary page into offsets/chars numpy
+    arrays. Returns total char bytes, None when the library is
+    unavailable; raises ValueError on malformed input."""
+    lib = _load()
+    if lib is None:
+        return None
+    import numpy as np
+
+    src = np.frombuffer(raw, np.uint8)
+    rc = lib.srtpu_pq_binary_dict(
+        src.ctypes.data, src.shape[0], count,
+        offsets.ctypes.data, chars.ctypes.data, chars.shape[0])
+    if rc < 0:
+        raise ValueError("malformed binary dictionary page")
+    return int(rc)
 
 
 def lz4_decompress(data: bytes, out_size: int) -> bytes:

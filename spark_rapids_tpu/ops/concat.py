@@ -31,6 +31,46 @@ def concat_fixed(parts: Sequence[ColV], lengths: Sequence[int], out_cap: int) ->
     return ColV(data, validity)
 
 
+def concat_padded_cols(
+    col_parts: Sequence[Sequence[ColV]],
+    counts: Sequence[jax.Array],
+    out_cap: int,
+) -> Tuple[List[ColV], jax.Array, jax.Array]:
+    """Sync-free concat for FIXED-WIDTH columns: parts stack at their full
+    capacities (no compaction) and the returned (out_cap,) live MASK marks
+    which rows are real — row counts stay device scalars, so no host
+    round-trip. Downstream fused ops consume the mask via live_of
+    (reference contrast: the cudf concat path syncs row counts;
+    GpuCoalesceBatches.scala:398 — on TPU a sync costs a tunnel RTT, so
+    the merge loop avoids it entirely)."""
+    caps = [cp[0].validity.shape[0] for cp in col_parts]
+    masks = [
+        jnp.arange(c, dtype=jnp.int32) < jnp.int32(cnt)
+        for c, cnt in zip(caps, counts)
+    ]
+    mask = jnp.concatenate(masks)
+    if mask.shape[0] < out_cap:
+        mask = jnp.concatenate(
+            [mask, jnp.zeros(out_cap - mask.shape[0], jnp.bool_)])
+    else:
+        mask = mask[:out_cap]
+    ncols = len(col_parts[0])
+    out: List[ColV] = []
+    for j in range(ncols):
+        parts = [cp[j] for cp in col_parts]
+        data = jnp.concatenate([p.data for p in parts])
+        valid = jnp.concatenate([p.validity for p in parts])
+        if data.shape[0] < out_cap:
+            pad = out_cap - data.shape[0]
+            data = jnp.concatenate([data, jnp.zeros(pad, data.dtype)])
+            valid = jnp.concatenate([valid, jnp.zeros(pad, jnp.bool_)])
+        else:
+            data, valid = data[:out_cap], valid[:out_cap]
+        out.append(ColV(data, valid & mask))
+    total = sum(jnp.int32(c) for c in counts)
+    return out, mask, total
+
+
 def concat_string(
     parts: Sequence[StrV],
     lengths: Sequence[int],
