@@ -248,6 +248,19 @@ PARQUET_DEVICE_DECODE = conf(
     "the TPU analog of cudf's GPU decoder (GpuParquetScan.scala:1157 "
     "Table.readParquet). Columns with unsupported encodings fall back to "
     "the host arrow decoder per-column.")
+SCAN_DEVICE_CACHE = conf(
+    "spark.rapids.tpu.scan.deviceCache.enabled", True,
+    "Keep decoded scan columns resident in HBM keyed by "
+    "(file, mtime, size, row group) so hot files upload once — the TPU "
+    "engine's buffer pool. The CPU engine's scans enjoy the OS page "
+    "cache; on TPU the host link is the scarce resource, so the pool "
+    "caches the post-link artifact (reference analog: the columnar "
+    "cache serializer, ParquetCachedBatchSerializer.scala, plus every "
+    "database's buffer pool). Invalidated by file mtime/size changes; "
+    "evicted LRU under scan.deviceCache.maxBytes.")
+SCAN_DEVICE_CACHE_MAX_BYTES = conf(
+    "spark.rapids.tpu.scan.deviceCache.maxBytes", 2 << 30,
+    "LRU byte budget for the device scan cache.", check=_positive)
 CLOUD_SCHEMES = conf(
     "spark.rapids.tpu.cloudSchemes", "abfs,abfss,dbfs,gs,s3,s3a,s3n,wasbs",
     "URI schemes treated as high-latency cloud stores.")

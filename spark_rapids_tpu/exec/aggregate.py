@@ -109,14 +109,24 @@ class TpuHashAggregateExec(TpuExec):
         self.mode = mode
         child_schema = child.output_schema
 
-        # group key output fields
+        # group key output fields. FINAL consumes a partial's
+        # [keys..., buffers...] output, where computed key EXPRESSIONS are
+        # already evaluated — keys bind positionally there, never by
+        # re-binding the original expression (whose input columns no
+        # longer exist; reference: the FINAL GpuHashAggregateExec binds
+        # against the partial attributes, aggregate.scala:341)
         self._key_fields: List[StructField] = []
         self._bound_keys: List[E.Expression] = []
         for i, g in enumerate(self.group_exprs):
             name = g.name if isinstance(g, (E.UnresolvedAttribute,)) else (
                 g.name if isinstance(g, E.Alias) else f"key{i}"
             )
-            b = E.bind_references(g, child_schema)
+            if self.mode == A.FINAL:
+                cf = child_schema.fields[i]
+                b: E.Expression = E.BoundReference(
+                    i, cf.dataType, cf.nullable)
+            else:
+                b = E.bind_references(g, child_schema)
             self._key_fields.append(StructField(name, b.dtype, b.nullable))
             self._bound_keys.append(b)
 
@@ -368,8 +378,8 @@ class TpuHashAggregateExec(TpuExec):
                 self._bound_keys = saved_bound
         return partials[0]
 
-    def _evaluate(self, buffers: ColumnarBatch) -> ColumnarBatch:
-        """Final projection from [keys..., buffers...] to results."""
+    def _eval_exprs(self) -> List[E.Expression]:
+        """Result projection over [keys..., buffers...]."""
         exprs: List[E.Expression] = [
             E.BoundReference(i, f.dataType, f.nullable)
             for i, f in enumerate(self._key_fields)
@@ -381,12 +391,127 @@ class TpuHashAggregateExec(TpuExec):
                 for j in range(s, e)
             )
             exprs.append(f.evaluate(refs))
+        return exprs
+
+    def _evaluate(self, buffers: ColumnarBatch) -> ColumnarBatch:
+        """Final projection from [keys..., buffers...] to results."""
+        exprs = self._eval_exprs()
         from .basic import _project_pipeline
 
         cap = buffers.columns[0].capacity if buffers.columns else 1
         fn = _project_pipeline(tuple(exprs), batch_signature(buffers), cap)
         vals = fn(vals_of_batch(buffers))
         return batch_from_vals(vals, self._schema, buffers.num_rows_lazy)
+
+    # -- whole-stage fusion ------------------------------------------------
+    def _can_fuse_stage(self) -> bool:
+        """Fused scan→agg stages cover fixed-width keys/buffers updating
+        straight from a source (string keys need a host max-length sync;
+        FINAL mode consumes exchanged partials, not a scan)."""
+        if self.mode == A.FINAL:
+            return False
+        return not any(
+            isinstance(f.dataType, (T.StringType, T.BinaryType))
+            for f in self._buffer_schema.fields
+        )
+
+    def _run_fused_stage(self, stage, chain) -> ColumnarBatch:
+        """ONE jitted program for the whole stage: per-row-group parquet
+        decode → fused child chain → update groupby → padded concat →
+        merge groupby → (COMPLETE) result projection. Collapsing the stage
+        to a single executable removes every intermediate program boundary
+        — each boundary costs a dispatch/queue round trip on the TPU host
+        link, and intermediate batches cost extra HBM passes (reference
+        contrast: the GPU plan runs one kernel set per exec,
+        aggregate.scala:341; TPU+XLA lets the whole stage fuse)."""
+        from ..conf import IMPROVED_FLOAT_OPS
+        from .base import side_signature
+
+        approx = self.conf.get(IMPROVED_FLOAT_OPS)
+        sides = [e.side_vals() for e in chain]
+        chain_t = tuple(chain)
+        rg_meta = []  # structural identity per row group
+        all_args = []
+        all_runs = []
+        for n, cap, entries in stage:
+            rg_meta.append((n, cap, tuple(k for (_, k, _, _) in entries)))
+            all_args.append([list(a) for (a, _, _, _) in entries])
+            all_runs.append([r for (_, _, r, _) in entries])
+        key = (
+            "stage", tuple(rg_meta),
+            tuple(e.fusion_key() for e in chain_t),
+            tuple(self._bound_keys), self._key_dtypes(),
+            tuple(self._update_exprs), tuple(self._update_ops),
+            tuple(self._merge_ops), self.mode, approx,
+            side_signature(sides), self.conf.shape_bucket_min,
+        )
+        fn = _AGG_CACHE.get(key)
+        if fn is None:
+            key_exprs = tuple(self._bound_keys)
+            key_dts = self._key_dtypes()
+            value_exprs = tuple(self._update_exprs)
+            update_ops = tuple(self._update_ops)
+            merge_ops = tuple(self._merge_ops)
+            nkeys = len(key_exprs)
+            eval_exprs = (tuple(self._eval_exprs())
+                          if self.mode != A.PARTIAL else None)
+            bucket_min = self.conf.shape_bucket_min
+            metas = tuple(rg_meta)
+            runs_t = tuple(tuple(r) for r in all_runs)
+
+            def run(args_nested, side_args):
+                from ..ops.filter_gather import live_of
+
+                def agg_once(keys, vals, ops_, live):
+                    if key_exprs:
+                        k_, a_, nseg = groupby_ops.groupby_agg(
+                            keys, list(key_dts), vals, list(ops_), live,
+                            (), approx_float_sum=approx)
+                        return list(k_) + list(a_), nseg
+                    a_ = groupby_ops.reduce_no_keys(vals, list(ops_), live)
+                    return list(a_), jnp.int32(1)
+
+                partial_sets = []
+                for (n, cap, _), rg_args, rg_runs in zip(
+                        metas, args_nested, runs_t):
+                    cols: List[Val] = []
+                    for a, r in zip(rg_args, rg_runs):
+                        out = r(a)
+                        cols.append(
+                            ColV(out[0], out[1]) if len(out) == 2
+                            else StrV(out[0], out[1], out[2]))
+                    live = live_of(n, cap)
+                    for e, s in zip(chain_t, side_args):
+                        cols, live = e.lower_batch(cols, live, cap, s)
+                    keys = [lower(e, cols, cap) for e in key_exprs]
+                    vals = [None if e is None else lower(e, cols, cap)
+                            for e in value_exprs]
+                    partial_sets.append(agg_once(keys, vals, update_ops, live))
+                if len(partial_sets) == 1:
+                    merged_vals, nseg = partial_sets[0]
+                else:
+                    col_parts = [p[0] for p in partial_sets]
+                    counts = [p[1] for p in partial_sets]
+                    caps = [p[0][0].validity.shape[0] for p in partial_sets]
+                    out_cap = bucket_rows(sum(caps), bucket_min)
+                    cols2, mask, _ = concat_ops.concat_padded_cols(
+                        col_parts, counts, out_cap)
+                    merged_vals, nseg = agg_once(
+                        cols2[:nkeys], cols2[nkeys:], merge_ops, mask)
+                if eval_exprs is not None:
+                    ocap = (merged_vals[0].validity.shape[0]
+                            if merged_vals else 1)
+                    return [lower(e, merged_vals, ocap)
+                            for e in eval_exprs], nseg
+                return merged_vals, nseg
+
+            if len(_AGG_CACHE) > 512:
+                _AGG_CACHE.clear()
+            fn = _AGG_CACHE[key] = jax.jit(run)
+        vals, nseg = fn(all_args, sides)
+        schema = (self._buffer_schema if self.mode == A.PARTIAL
+                  else self._schema)
+        return batch_from_vals(vals, schema, nseg)
 
     # -- execution ---------------------------------------------------------
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
@@ -399,6 +524,14 @@ class TpuHashAggregateExec(TpuExec):
             source, chain = child.fused_source_chain()
         else:
             source, chain = child, ()
+        fsp = getattr(source, "fused_stage_plans", None)
+        if fsp is not None and self._can_fuse_stage():
+            stage = fsp(index)
+            if stage:
+                with timed(self.metrics[TOTAL_TIME]):
+                    out = self._run_fused_stage(stage, tuple(chain))
+                yield self.record_batch(out)
+                return
         for batch in source.execute_partition(index):
             nr = batch.num_rows_lazy
             if isinstance(nr, int) and nr == 0 and self.group_exprs and not chain:

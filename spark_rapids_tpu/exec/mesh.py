@@ -13,9 +13,14 @@ partition i living on mesh shard i % n. XLA schedules the ICI collectives
 against compute; nothing touches the host between the child batches and the
 stage output.
 
-Columns crossing the mesh must be fixed-width (the collective exchange's
-contract); the planner keeps string-bearing stages on the single-host
-exchange path (exec/exchange.py).
+Fixed-width columns cross the mesh as data/validity planes; STRING columns
+cross as offsets/chars/validity planes with the chars riding the
+collective's byte-plane all_to_all (parallel/collective.py) — the same
+type-agnostic contract as the reference's UCX transport
+(RapidsShuffleClient.scala:35-98). Staging computes a static max byte
+length per string column, so string GROUP KEYS must be direct column
+references (computed string keys have no staged bound and stay on the
+single-host exchange, as do binary columns).
 """
 from __future__ import annotations
 
@@ -81,10 +86,10 @@ class _MeshStage(TpuExec):
         layout, str_max_lens). Child partition p maps to shard p % n.
 
         layout[i] is ("f",) for a fixed column or ("s", char_cap) for a
-        string column (offsets/chars/validity planes); str_max_lens holds
-        the max byte length per string column (a STATIC bound the sort /
-        hash kernels need, computed host-side here — staging already
-        touches every byte)."""
+        string column (offsets/chars/validity planes); str_max_lens[i] is
+        0 for fixed columns and the bucketed max byte length for string
+        columns (a STATIC bound the sort / hash kernels need, computed
+        host-side here — staging already touches every byte)."""
         schema = child.output_schema
         per_shard: List[List[ColumnarBatch]] = [[] for _ in range(self.n_shards)]
         for p in range(child.num_partitions):
@@ -120,6 +125,7 @@ class _MeshStage(TpuExec):
         for j in range(ncols):
             if not is_str[j]:
                 layout.append(("f",))
+                smls.append(0)
                 continue
             max_bytes = 1
             max_len = 1
@@ -170,6 +176,40 @@ class _MeshStage(TpuExec):
         sh = row_sharding(self.mesh)
         out = [jax.device_put(a.reshape(-1), sh) for a in planes]
         return out, counts, cap, tuple(layout), tuple(smls)
+
+    @staticmethod
+    def _cols_of_flat(colflat: Sequence[jax.Array], layout) -> List:
+        """Per-shard flat planes -> ColV/StrV column list (inside
+        shard_map: a string column is offsets/chars/validity planes)."""
+        from ..expr.eval import StrV
+
+        cols: List = []
+        gi = 0
+        for lay in layout:
+            if lay[0] == "f":
+                cols.append(ColV(colflat[gi], colflat[gi + 1]))
+                gi += 2
+            else:
+                cols.append(
+                    StrV(colflat[gi], colflat[gi + 1], colflat[gi + 2]))
+                gi += 3
+        return cols
+
+    @staticmethod
+    def _flatten_vals(outs) -> Tuple[List[jax.Array], Tuple[tuple, ...]]:
+        """Column values -> flat planes + an output layout for _emit."""
+        from ..expr.eval import StrV
+
+        flat: List[jax.Array] = []
+        layout: List[tuple] = []
+        for o in outs:
+            if isinstance(o, StrV):
+                flat.extend([o.offsets, o.chars, o.validity])
+                layout.append(("s",))
+            else:
+                flat.extend([o.data, o.validity])
+                layout.append(("f",))
+        return flat, tuple(layout)
 
     def _emit(self, schema: StructType, global_cols: Sequence[jax.Array],
               counts: np.ndarray, cap: int,
@@ -274,7 +314,7 @@ class TpuMeshAggregateExec(_MeshStage):
 
     def _materialize(self) -> None:
         child = self.children[0]
-        global_cols, counts, cap, _layout, _smls = self._stage_child(child)
+        global_cols, counts, cap, layout, smls = self._stage_child(child)
         nk = len(self._key_fields)
         key_dtypes = list(self._key_dtypes())
         bound_keys = tuple(self._bound_keys)
@@ -286,14 +326,19 @@ class TpuMeshAggregateExec(_MeshStage):
         buf_slices = tuple(self._buf_slices)
         n_shards = self.n_shards
         mesh = self.mesh
+        # static byte bound per STRING group key: the referenced source
+        # column's staged max (planner gates string keys to direct refs)
+        key_smls = tuple(
+            smls[b.ordinal]
+            for b in bound_keys
+            if isinstance(b, E.BoundReference) and T.is_string(b.dtype)
+        )
+        out_layouts: dict = {}
 
         def build():
             def shard_fn(*flat):
                 *colflat, cnt = flat
-                cols = [
-                    ColV(colflat[2 * j], colflat[2 * j + 1])
-                    for j in range(len(colflat) // 2)
-                ]
+                cols = self._cols_of_flat(colflat, layout)
                 n = cnt[0]
                 keys = [lower(b, cols, cap) for b in bound_keys]
                 vals = [
@@ -302,7 +347,8 @@ class TpuMeshAggregateExec(_MeshStage):
                 ]
                 rkeys, raggs, rn = D.dist_groupby(
                     keys, key_dtypes, vals, list(update_ops),
-                    list(merge_ops), n, AXIS, n_shards)
+                    list(merge_ops), n, AXIS, n_shards,
+                    str_max_lens=key_smls)
                 # result projection over [keys..., buffers...], per shard
                 allv = list(rkeys) + list(raggs)
                 rcap = allv[0].validity.shape[0] if allv else 1
@@ -317,10 +363,8 @@ class TpuMeshAggregateExec(_MeshStage):
                     )
                     exprs.append(f.evaluate(refs))
                 outs = [lower(x, allv, rcap) for x in exprs]
-                flat_out = []
-                for o in outs:
-                    flat_out.append(o.data)
-                    flat_out.append(o.validity)
+                flat_out, out_lay = self._flatten_vals(outs)
+                out_layouts["lay"] = out_lay
                 flat_out.append(rn.reshape(1))
                 return tuple(flat_out)
 
@@ -330,18 +374,21 @@ class TpuMeshAggregateExec(_MeshStage):
                 in_specs=tuple([P(AXIS)] * nin + [P(AXIS)]),
                 out_specs=P(AXIS),
             )
-            return jax.jit(fn)
+            return jax.jit(fn), out_layouts
 
         sig = tuple((str(a.dtype), a.shape) for a in global_cols)
-        fn = _cached_program(
-            ("agg", self.fusion_sig(), sig, cap, n_shards), build)
+        fn, out_layouts = _cached_program(
+            ("agg", self.fusion_sig(), sig, cap, n_shards, key_smls), build)
         cnt_in = jax.device_put(
             np.asarray(counts, np.int32), row_sharding(mesh))
         res = fn(*global_cols, cnt_in)
         *out_cols, out_counts = res
-        rcap = out_cols[0].shape[0] // n_shards
+        out_lay = out_layouts.get("lay") or tuple(
+            ("s",) if T.is_string(f.dataType) else ("f",)
+            for f in self._schema.fields)
         self._outputs = self._emit(
-            self._schema, list(out_cols), _np_of(out_counts), rcap)
+            self._schema, list(out_cols), _np_of(out_counts), 0,
+            layout=out_lay)
 
     def fusion_sig(self):
         return (
@@ -368,26 +415,26 @@ class TpuMeshSortExec(_MeshStage):
 
     def _materialize(self) -> None:
         child = self.children[0]
-        global_cols, counts, cap, _layout, _smls = self._stage_child(child)
+        global_cols, counts, cap, layout, smls = self._stage_child(child)
         key_dtypes = [
             self._schema.fields[i].dataType for i in self.key_indices
         ]
         n_shards, mesh = self.n_shards, self.mesh
         key_ix, orders = list(self.key_indices), list(self.orders)
+        key_smls = tuple(
+            smls[i] for i in key_ix
+            if T.is_string(self._schema.fields[i].dataType))
+        out_layouts: dict = {}
 
         def build():
             def shard_fn(*flat):
                 *colflat, cnt = flat
-                cols = [
-                    ColV(colflat[2 * j], colflat[2 * j + 1])
-                    for j in range(len(colflat) // 2)
-                ]
+                cols = self._cols_of_flat(colflat, layout)
                 out, rn = D.dist_sort(
-                    cols, key_ix, key_dtypes, orders, cnt[0], AXIS, n_shards)
-                flat_out = []
-                for o in out:
-                    flat_out.append(o.data)
-                    flat_out.append(o.validity)
+                    cols, key_ix, key_dtypes, orders, cnt[0], AXIS, n_shards,
+                    str_max_lens=key_smls)
+                flat_out, out_lay = self._flatten_vals(out)
+                out_layouts["lay"] = out_lay
                 flat_out.append(rn.reshape(1))
                 return tuple(flat_out)
 
@@ -395,19 +442,23 @@ class TpuMeshSortExec(_MeshStage):
             return jax.jit(shard_map(
                 shard_fn, mesh=mesh,
                 in_specs=tuple([P(AXIS)] * (nin + 1)),
-                out_specs=P(AXIS)))
+                out_specs=P(AXIS))), out_layouts
 
         sig = tuple((str(a.dtype), a.shape) for a in global_cols)
-        fn = _cached_program(
+        fn, out_layouts = _cached_program(
             ("sort", tuple(key_ix), tuple((o.ascending, o.nulls_first)
-                                          for o in orders), sig, n_shards),
+                                          for o in orders), sig, n_shards,
+             key_smls),
             build)
         cnt_in = jax.device_put(np.asarray(counts, np.int32), row_sharding(mesh))
         res = fn(*global_cols, cnt_in)
         *out_cols, out_counts = res
-        rcap = out_cols[0].shape[0] // n_shards
+        out_lay = out_layouts.get("lay") or tuple(
+            ("s",) if T.is_string(f.dataType) else ("f",)
+            for f in self._schema.fields)
         self._outputs = self._emit(
-            self._schema, list(out_cols), _np_of(out_counts), rcap)
+            self._schema, list(out_cols), _np_of(out_counts), 0,
+            layout=out_lay)
 
 
 class TpuMeshHashJoinExec(_MeshStage):
@@ -433,48 +484,67 @@ class TpuMeshHashJoinExec(_MeshStage):
 
     def _materialize(self) -> None:
         left, right = self.children
-        l_cols, l_counts, lcap, _llay, _lsml = self._stage_child(left)
-        r_cols, r_counts, rcap, _rlay, _rsml = self._stage_child(right)
+        l_cols, l_counts, lcap, llay, lsml = self._stage_child(left)
+        r_cols, r_counts, rcap, rlay, rsml = self._stage_child(right)
         n_shards, mesh = self.n_shards, self.mesh
         l_ix, r_ix, kd = list(self.left_ix), list(self.right_ix), list(
             self._key_dtypes)
-        nl = len(left.output_schema.fields)
+        lf = left.output_schema.fields
+        rf = right.output_schema.fields
         out_cap = bucket_rows(
             max(lcap, rcap) * 2, self.conf.shape_bucket_min)
+        # string keys compare via chunk keys: the byte bound must be
+        # SHARED by both sides (same word count per key)
+        key_smls = tuple(
+            max(lsml[li], rsml[ri])
+            for li, ri in zip(l_ix, r_ix)
+            if T.is_string(lf[li].dataType)
+        )
+        # per-shard byte pools for string outputs: the post-exchange pool
+        # is n_shards x the staged local pool; 1:1 joins fit, fan-out
+        # retries double alongside out_cap
+        base_ccaps = tuple(
+            [lay[1] * n_shards for lay in llay if lay[0] == "s"]
+            + [lay[1] * n_shards for lay in rlay if lay[0] == "s"])
+        ccap_scale = 1
 
         for attempt in range(8):
-            def build(out_cap=out_cap):
+            out_ccaps = tuple(
+                bucket_rows(c * ccap_scale, 128) for c in base_ccaps)
+
+            def build(out_cap=out_cap, out_ccaps=out_ccaps):
                 def shard_fn(*flat):
-                    lflat = flat[: 2 * nl]
-                    rflat = flat[2 * nl:-2]
+                    nlp = sum(2 if lay[0] == "f" else 3 for lay in llay)
+                    lflat = flat[:nlp]
+                    rflat = flat[nlp:-2]
                     lcnt, rcnt = flat[-2], flat[-1]
-                    lc = [ColV(lflat[2 * j], lflat[2 * j + 1])
-                          for j in range(nl)]
-                    rc = [ColV(rflat[2 * j], rflat[2 * j + 1])
-                          for j in range(len(rflat) // 2)]
+                    lc = self._cols_of_flat(lflat, llay)
+                    rc = self._cols_of_flat(rflat, rlay)
                     out, cnt, ok = D.dist_hash_join(
                         lc, l_ix, rc, r_ix, kd, lcnt[0], rcnt[0],
-                        AXIS, n_shards, out_cap)
-                    flat_out = []
-                    for o in out:
-                        flat_out.append(o.data)
-                        flat_out.append(o.validity)
+                        AXIS, n_shards, out_cap,
+                        key_str_max_lens=key_smls,
+                        out_char_caps=out_ccaps)
+                    flat_out, out_lay = self._flatten_vals(out)
+                    out_layouts["lay"] = out_lay
                     flat_out.append(cnt.reshape(1))
                     flat_out.append(ok.reshape(1))
                     return tuple(flat_out)
 
-                nin = 2 * nl + len(r_cols) + 2
+                nin = len(l_cols) + len(r_cols) + 2
                 return jax.jit(shard_map(
                     shard_fn, mesh=mesh,
                     in_specs=tuple([P(AXIS)] * nin),
-                    out_specs=P(AXIS)))
+                    out_specs=P(AXIS))), out_layouts
 
+            out_layouts: dict = {}
             sig = (
                 tuple((str(a.dtype), a.shape) for a in l_cols),
                 tuple((str(a.dtype), a.shape) for a in r_cols),
             )
-            fn = _cached_program(
-                ("join", tuple(l_ix), tuple(r_ix), sig, out_cap, n_shards),
+            fn, out_layouts = _cached_program(
+                ("join", tuple(l_ix), tuple(r_ix), sig, out_cap, n_shards,
+                 key_smls, out_ccaps),
                 build)
             sh = row_sharding(mesh)
             res = fn(*l_cols, *r_cols,
@@ -482,13 +552,17 @@ class TpuMeshHashJoinExec(_MeshStage):
                      jax.device_put(np.asarray(r_counts, np.int32), sh))
             *out_cols, out_counts, oks = res
             if bool(np.all(_np_of(oks))):
-                ocap = out_cols[0].shape[0] // n_shards
+                out_lay = out_layouts.get("lay") or tuple(
+                    ("s",) if T.is_string(f.dataType) else ("f",)
+                    for f in self._schema.fields)
                 self._outputs = self._emit(
-                    self._schema, list(out_cols), _np_of(out_counts), ocap)
+                    self._schema, list(out_cols), _np_of(out_counts), 0,
+                    layout=out_lay)
                 return
             # overflow: double the per-shard output capacity and recompile
             # (the reference's bounce-buffer windowing retries similarly)
             out_cap *= 2
+            ccap_scale *= 2
         raise RuntimeError("mesh join output capacity retry limit exceeded")
 
 

@@ -109,6 +109,19 @@ class TpuFileSourceScanExec(TpuExec):
             cols.append(constant_string_column(pmap.get(k), n, cap))
         return ColumnarBatch(cols, schema, n)
 
+    def fused_stage_plans(self, index: int):
+        """Stage fusion: hand the consumer exec the traced per-row-group
+        decode programs so scan→…→aggregate compiles to ONE executable
+        (each extra program in a dependency chain pays a dispatch/queue
+        round trip on the TPU host link). None = use execute_partition."""
+        if index >= self.scanner.num_splits():
+            return None
+        fn = getattr(self.scanner, "device_stage_plans", None)
+        if fn is None:
+            return None
+        with timed(self.metrics[SCAN_TIME]):
+            return fn(index)
+
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
         from ..io.arrow_convert import arrow_to_batch
 

@@ -18,6 +18,14 @@ def committed_file(path: str):
     try:
         yield tmp
         os.replace(tmp, path)  # commit
+        # a rewrite makes any device-cached scan of the old file dead
+        # weight (the mtime/size key already prevents stale READS; this
+        # frees the HBM promptly)
+        from .scan_cache import DeviceScanCache
+
+        inst = DeviceScanCache._instance
+        if inst is not None:
+            inst.invalidate_path(path)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)

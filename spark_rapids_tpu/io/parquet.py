@@ -262,11 +262,22 @@ class ParquetScanner:
         s = self.splits()[i]
         if not s.row_groups:
             return None, s.partition_values
-        pf = pq.ParquetFile(s.path)
+        from .scan_cache import DeviceScanCache, file_key
+
+        cache = DeviceScanCache.get_instance(self.conf)
         file_cols = [c for c in self.columns if c not in split_pcols(s)]
         nfields = [
             f for f in self.schema.fields if f.name in file_cols
         ]
+        # probe the cache BEFORE opening the file: a fully-hot file must
+        # not re-pay the footer parse / mmap it is cached to avoid
+        keys = ([file_key(s.path, rg, file_cols, "batch")
+                 for rg in s.row_groups] if cache is not None else None)
+        batches = [cache.get(k) for k in keys] if cache is not None else [
+            None] * len(s.row_groups)
+        if all(b is not None for b in batches):
+            return batches, s.partition_values
+        pf = pq.ParquetFile(s.path)
         # mmap: plan_chunk touches only the selected chunks' byte ranges,
         # so the OS pages in just those — no O(splits x file) reads
         import mmap
@@ -278,14 +289,74 @@ class ParquetScanner:
             file_bytes = b""
         finally:
             f.close()
-        batches = []
-        for rg in s.row_groups:
+        for i, rg in enumerate(s.row_groups):
+            if batches[i] is not None:
+                continue
             b = read_row_group_device(
                 s.path, pf, rg, file_cols, nfields, file_bytes)
             if b is None:
                 return None, s.partition_values
-            batches.append(b)
+            if cache is not None:
+                cache.put(keys[i], b, b.device_memory_size())
+            batches[i] = b
         return batches, s.partition_values
+
+    def device_stage_plans(self, i: int):
+        """Stage-fusion entry: per-row-group decode plans for split i
+        WITHOUT dispatching device work, so a consumer exec can splice the
+        decode into its own jitted program (one executable per scan→agg
+        stage; reference contrast: the GPU decode is one cudf call but
+        still a separate kernel launch from the query stage,
+        GpuParquetScan.scala:1157). Returns a list per row group of
+        ``(num_rows, cap, entries)`` with ``entries`` =
+        ``[(args, key, run, field), ...]`` per column, or None when any
+        column needs the host decoder (caller uses execute_partition)."""
+        import pyarrow.parquet as pq
+
+        from ..conf import PARQUET_DEVICE_DECODE
+        from .parquet_device import row_group_device_plans
+
+        if not self.conf.get(PARQUET_DEVICE_DECODE):
+            return None
+        s = self.splits()[i]
+        if not s.row_groups or self.partition_cols:
+            return None
+        from .scan_cache import DeviceScanCache, file_key
+
+        cache = DeviceScanCache.get_instance(self.conf)
+        file_cols = [c for c in self.columns if c not in split_pcols(s)]
+        nfields = [f for f in self.schema.fields if f.name in file_cols]
+        # probe the cache BEFORE opening the file (see read_split_device)
+        keys = ([file_key(s.path, rg, file_cols, "stage")
+                 for rg in s.row_groups] if cache is not None else None)
+        out = [cache.get(k) for k in keys] if cache is not None else [
+            None] * len(s.row_groups)
+        if all(x is not None for x in out):
+            return out
+        pf = pq.ParquetFile(s.path)
+        import mmap
+
+        f = open(s.path, "rb")
+        try:
+            file_bytes = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except ValueError:  # empty file
+            file_bytes = b""
+        finally:
+            f.close()
+        for i, rg in enumerate(s.row_groups):
+            if out[i] is not None:
+                continue
+            stage = row_group_device_plans(
+                s.path, pf, rg, file_cols, nfields, file_bytes)
+            if stage is None:
+                return None
+            if cache is not None:
+                nbytes = sum(
+                    int(a.size) * a.dtype.itemsize
+                    for (args, _, _, _) in stage[2] for a in args)
+                cache.put(keys[i], stage, nbytes)
+            out[i] = stage
+        return out
 
 
 

@@ -568,9 +568,16 @@ def _np_plain_words(plan: ChunkPlan) -> np.ndarray:
     )
 
 
-def chunk_to_device_column(plan: ChunkPlan, dtype_tpu, cap: int):
-    """Upload a ChunkPlan's payloads and expand to a DeviceColumn in ONE
-    jitted program (per structural cache key)."""
+def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int):
+    """Build the device half of one chunk decode WITHOUT dispatching:
+    returns ``(args, key, run)`` where ``args`` are the host arrays to
+    upload, ``key`` is the structural cache key, and ``run(arglist)`` is a
+    PURE traced function producing ``(data, validity)`` for fixed-width or
+    ``(offsets, chars, validity)`` for strings. Callers either jit one
+    column (chunk_to_device_column) or splice many columns — and whole
+    exec chains — into a single fused stage program (exec/aggregate's
+    scan→agg stage; reference contrast: cudf decodes a whole table in one
+    kernel launch batch, GpuParquetScan.scala:1157)."""
     import jax
     import jax.numpy as jnp
 
@@ -583,15 +590,16 @@ def chunk_to_device_column(plan: ChunkPlan, dtype_tpu, cap: int):
     if is_str and not is_dict:
         raise _FallbackError("PLAIN BYTE_ARRAY")
     if n == 0:
-        from ..columnar.column import DeviceColumn
-
         if is_str:
-            return DeviceColumn(
-                dtype_tpu, 0, None, jnp.zeros(cap, jnp.bool_),
-                jnp.zeros(cap + 1, jnp.int32), jnp.zeros(1, jnp.uint8))
+            def run_empty_str(arglist):
+                return (jnp.zeros(cap + 1, jnp.int32),
+                        jnp.zeros(1, jnp.uint8), jnp.zeros(cap, jnp.bool_))
+            return [], ("pqdec0", "str", cap), run_empty_str
         dt = _PHYS_NP[plan.phys]
-        return DeviceColumn(
-            dtype_tpu, 0, jnp.zeros(cap, dt), jnp.zeros(cap, jnp.bool_))
+
+        def run_empty(arglist):
+            return jnp.zeros(cap, dt), jnp.zeros(cap, jnp.bool_)
+        return [], ("pqdec0", str(dt), cap), run_empty
 
     args: List[Any] = []
     key: List[Any] = ["pqdec", plan.phys, str(dtype_tpu), cap, n, has_def,
@@ -636,12 +644,9 @@ def chunk_to_device_column(plan: ChunkPlan, dtype_tpu, cap: int):
         args.append(jnp.asarray(words))
         key.append(int(words.shape[0]))
 
-    key_t = tuple(key)
-    fn = _DECODE_CACHE.get(key_t)
-    if fn is None:
-        phys = plan.phys
+    phys = plan.phys
 
-        def run(arglist):
+    def run(arglist):
             ai = 0
             if has_def:
                 validity = unpack_bit_words(arglist[ai], cap)
@@ -702,13 +707,25 @@ def chunk_to_device_column(plan: ChunkPlan, dtype_tpu, cap: int):
             arr = jnp.where(validity, arr, jnp.zeros((), arr.dtype))
             return arr, validity
 
+    return args, tuple(key), run
+
+
+def chunk_to_device_column(plan: ChunkPlan, dtype_tpu, cap: int):
+    """Upload a ChunkPlan's payloads and expand to a DeviceColumn in ONE
+    jitted program (per structural cache key)."""
+    import jax
+
+    args, key_t, run = plan_decode(plan, dtype_tpu, cap)
+    fn = _DECODE_CACHE.get(key_t)
+    if fn is None:
         if len(_DECODE_CACHE) > 512:
             _DECODE_CACHE.clear()
         fn = _DECODE_CACHE[key_t] = jax.jit(run)
     out = fn(args)
     from ..columnar.column import DeviceColumn
 
-    if is_str:
+    n = plan.num_values
+    if plan.phys == "BYTE_ARRAY":
         offsets, chars, validity = out
         return DeviceColumn(dtype_tpu, n, None, validity, offsets, chars)
     data, validity = out
@@ -718,26 +735,9 @@ def chunk_to_device_column(plan: ChunkPlan, dtype_tpu, cap: int):
 # ---------------------------------------------------------------------------
 # row group -> ColumnarBatch (with per-column host fallback)
 # ---------------------------------------------------------------------------
-def read_row_group_device(
-    path: str, pf, rg: int, columns: Sequence[str], tpu_fields,
-    file_bytes: Optional[bytes] = None,
-) -> Optional[Any]:
-    """Decode one row group into a ColumnarBatch, device-decoding every
-    supported column and host-decoding (pyarrow) the rest. Returns None
-    when NO column takes the device path (caller uses the plain reader)."""
-    from ..columnar.batch import ColumnarBatch
-    from ..types import StructType
-    from ..utils.bucketing import bucket_rows
-
-    md = pf.metadata
-    rgmd = md.row_group(rg)
-    pqschema = pf.schema  # parquet (physical) schema
-    name_to_ci = {
-        rgmd.column(i).path_in_schema: i for i in range(rgmd.num_columns)
-    }
-    n = rgmd.num_rows
-    cap = bucket_rows(max(1, n))
-
+def _plan_columns(path, pf, rgmd, pqschema, name_to_ci, columns, file_bytes):
+    """Host-plan every requested column chunk of one row group.
+    Returns (plans by name, fallback column names)."""
     candidates = []
     fallback_cols: List[str] = []
     for name in columns:
@@ -762,9 +762,10 @@ def read_row_group_device(
             except Exception:
                 return name, None
 
-        # chunk planning is numpy-heavy (unpackbits/dot release the GIL):
-        # plan all columns of the row group in parallel (reference analog:
-        # the COALESCING reader's copy thread pool, GpuParquetScan.scala:900)
+        # chunk planning is native-decode-heavy (the C++ calls release the
+        # GIL): plan all columns of the row group in parallel (reference
+        # analog: the COALESCING reader's copy thread pool,
+        # GpuParquetScan.scala:900)
         if len(candidates) > 1:
             from concurrent.futures import ThreadPoolExecutor
 
@@ -779,6 +780,62 @@ def read_row_group_device(
                 fallback_cols.append(name)
             else:
                 plans[name] = plan
+    return plans, fallback_cols
+
+
+def row_group_device_plans(
+    path: str, pf, rg: int, columns: Sequence[str], tpu_fields,
+    file_bytes: Optional[bytes] = None,
+):
+    """Stage-fusion variant of read_row_group_device: host-plan ALL
+    columns and return ``(num_rows, cap, entries)`` with entries =
+    ``[(args, key, run, field), ...]`` — no device dispatch happens here
+    beyond the argument uploads, so the consumer can splice ``run`` into
+    one fused stage program. Returns None when ANY column needs the host
+    decoder (the fused program has no host path)."""
+    from ..utils.bucketing import bucket_rows
+
+    md = pf.metadata
+    rgmd = md.row_group(rg)
+    pqschema = pf.schema
+    name_to_ci = {
+        rgmd.column(i).path_in_schema: i for i in range(rgmd.num_columns)
+    }
+    n = rgmd.num_rows
+    cap = bucket_rows(max(1, n))
+    plans, fallback_cols = _plan_columns(
+        path, pf, rgmd, pqschema, name_to_ci, columns, file_bytes)
+    if fallback_cols or len(plans) != len(columns):
+        return None
+    entries = []
+    for name, f in zip(columns, tpu_fields):
+        args, key, run = plan_decode(plans[name], f.dataType, cap)
+        entries.append((args, key, run, f))
+    return n, cap, entries
+
+
+def read_row_group_device(
+    path: str, pf, rg: int, columns: Sequence[str], tpu_fields,
+    file_bytes: Optional[bytes] = None,
+) -> Optional[Any]:
+    """Decode one row group into a ColumnarBatch, device-decoding every
+    supported column and host-decoding (pyarrow) the rest. Returns None
+    when NO column takes the device path (caller uses the plain reader)."""
+    from ..columnar.batch import ColumnarBatch
+    from ..types import StructType
+    from ..utils.bucketing import bucket_rows
+
+    md = pf.metadata
+    rgmd = md.row_group(rg)
+    pqschema = pf.schema  # parquet (physical) schema
+    name_to_ci = {
+        rgmd.column(i).path_in_schema: i for i in range(rgmd.num_columns)
+    }
+    n = rgmd.num_rows
+    cap = bucket_rows(max(1, n))
+
+    plans, fallback_cols = _plan_columns(
+        path, pf, rgmd, pqschema, name_to_ci, columns, file_bytes)
     if not plans:
         return None
 

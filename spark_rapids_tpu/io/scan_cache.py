@@ -1,0 +1,94 @@
+"""Device scan cache: an HBM buffer pool for hot file scans.
+
+Reference analog: the columnar cache serializer
+(shims/spark311/.../ParquetCachedBatchSerializer.scala) gives cached
+dataframes a GPU-columnar representation; on TPU the engine caches the
+POST-LINK artifact (uploaded+decodable column payloads) because the host
+link — not decode — is the scarce resource (measured 25-75 MB/s with
+~0.6 s fixed cost per fresh-buffer program execution on tunneled devices,
+vs >100 GB/s HBM). The CPU engine's repeated scans get the same effect
+for free from the OS page cache.
+
+Keys carry (path, mtime, size), so a rewritten file never serves stale
+data. Values are opaque (the scanner stores whatever it rebuilds per row
+group); byte accounting is supplied by the caller. Eviction is LRU under
+a conf byte budget.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Optional, Tuple
+
+
+class DeviceScanCache:
+    _instance: Optional["DeviceScanCache"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, Tuple[Any, int]]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    @classmethod
+    def get_instance(cls, conf) -> Optional["DeviceScanCache"]:
+        from ..conf import SCAN_DEVICE_CACHE, SCAN_DEVICE_CACHE_MAX_BYTES
+
+        if not conf.get(SCAN_DEVICE_CACHE):
+            return None
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = DeviceScanCache(
+                    conf.get(SCAN_DEVICE_CACHE_MAX_BYTES))
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._instance_lock:
+            cls._instance = None
+
+    def get(self, key: tuple) -> Optional[Any]:
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return hit[0]
+
+    def put(self, key: tuple, value: Any, nbytes: int) -> None:
+        with self._lock:
+            if key in self._entries:
+                _, old = self._entries.pop(key)
+                self._bytes -= old
+            # one oversized entry must not wedge the pool
+            if nbytes > self.max_bytes:
+                return
+            self._entries[key] = (value, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and self._entries:
+                _, (_, sz) = self._entries.popitem(last=False)
+                self._bytes -= sz
+
+    def invalidate_path(self, path: str) -> None:
+        """Drop every entry of one file (the writers' commit protocol
+        calls this, io/commit.py — reads stay correct either way via the
+        mtime/size key; this just frees the HBM promptly)."""
+        with self._lock:
+            dead = [k for k in self._entries if k and k[0] == path]
+            for k in dead:
+                _, sz = self._entries.pop(k)
+                self._bytes -= sz
+
+
+def file_key(path: str, rg: int, columns, cap_hint=None) -> tuple:
+    """Cache key pinned to file identity (mtime+size catch rewrites)."""
+    import os
+
+    st = os.stat(path)
+    return (path, int(st.st_mtime_ns), st.st_size, rg, tuple(columns),
+            cap_hint)

@@ -163,26 +163,42 @@ def dist_hash_join(
     axis_name: str,
     n_shards: int,
     out_cap: int,
+    key_str_max_lens: Sequence[int] = (),
+    out_char_caps: Sequence[int] = (),
 ) -> Tuple[List[ColV], jax.Array, jax.Array]:
     """Inner equi-join: hash-exchange both sides, join locally.
 
     ``out_cap`` is the static per-shard output capacity (callers size it
-    from expected selectivity; overflow reports ok=False). Returns
+    from expected selectivity; overflow reports ok=False). String key
+    columns compare through the same chunk-key encoding on both sides, so
+    ``key_str_max_lens`` must be the SHARED byte bound per string key.
+    ``out_char_caps`` sizes the output byte pools per string column of the
+    combined (left..right) output; byte overflow also reports ok=False so
+    callers can retry with bigger pools. Returns
     (cols = left..right, match count, ok).
     """
+    from ..expr.eval import StrV
+
     def exchange_side(cols, key_ix, rows):
         kc = [cols[i] for i in key_ix]
-        h = hashing.murmur3(kc, list(key_dtypes))
+        h = hashing.murmur3(
+            kc, list(key_dtypes), str_max_lens=list(key_str_max_lens))
         pids = hashing.partition_ids(h, n_shards)
         return all_to_all_exchange(cols, pids, rows, axis_name, n_shards)
 
     l_cols, ln, ok1 = exchange_side(left_cols, left_keys, left_rows)
     r_cols, rn, ok2 = exchange_side(right_cols, right_keys, right_rows)
 
+    def cap_of(cols):
+        c0 = cols[0]
+        return (c0.offsets.shape[0] - 1 if isinstance(c0, StrV)
+                else c0.validity.shape[0])
+
     # build = right side: sort by key words, probe with binary search
     rkc = [r_cols[i] for i in right_keys]
-    rwords, r_null = join_ops.radix_key_words(rkc, key_dtypes)
-    rcap = r_cols[0].validity.shape[0]
+    rwords, r_null = join_ops.radix_key_words(
+        rkc, key_dtypes, key_str_max_lens)
+    rcap = cap_of(r_cols)
     r_live = jnp.arange(rcap, dtype=jnp.int32) < rn
     ok_rows = r_live & ~r_null
     order_rank = jnp.where(ok_rows, 0, 1).astype(jnp.uint32)
@@ -196,8 +212,9 @@ def dist_hash_join(
     build_count = jnp.sum(ok_rows.astype(jnp.int32))
 
     lkc = [l_cols[i] for i in left_keys]
-    lwords, l_null = join_ops.radix_key_words(lkc, key_dtypes)
-    lcap = l_cols[0].validity.shape[0]
+    lwords, l_null = join_ops.radix_key_words(
+        lkc, key_dtypes, key_str_max_lens)
+    lcap = cap_of(l_cols)
     l_live = (jnp.arange(lcap, dtype=jnp.int32) < ln) & ~l_null
     lo, hi = join_ops.probe_ranges(sorted_rwords, build_count, lwords, l_live)
     counts = jnp.where(l_live, hi - lo, 0)
@@ -205,6 +222,16 @@ def dist_hash_join(
     ok = ok1 & ok2 & (total <= out_cap)
 
     p, build_row, slot_live = join_ops.expansion_plan(counts, lo, out_cap)
-    left_out = gather(l_cols, p, slot_live)
-    right_out = gather(sorted_build, build_row, slot_live)
-    return list(left_out) + list(right_out), total.astype(jnp.int32), ok
+    nstr_left = sum(1 for c in l_cols if isinstance(c, StrV))
+    lcc = list(out_char_caps[:nstr_left])
+    rcc = list(out_char_caps[nstr_left:])
+    left_out = gather(l_cols, p, slot_live, char_caps=lcc or None)
+    right_out = gather(
+        sorted_build, build_row, slot_live, char_caps=rcc or None)
+    out = list(left_out) + list(right_out)
+    # byte-pool overflow check: gather_string truncates chars but keeps the
+    # true cumsum in offsets, so the last offset reveals overflow
+    for o in out:
+        if isinstance(o, StrV):
+            ok = ok & (o.offsets[-1] <= o.chars.shape[0])
+    return out, total.astype(jnp.int32), ok

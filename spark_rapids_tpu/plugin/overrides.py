@@ -465,12 +465,16 @@ def _shuffle_partitions(conf, child) -> int:
 
 def _mesh_eligible(conf, *schemas) -> bool:
     """True when the exchange-bounded stage can lower to ONE shard_map
-    program over the device mesh (exec/mesh.py): mesh mode on and every
-    column crossing the collective is fixed-width."""
-    from ..exec.mesh import fixed_width_schema, mesh_available
+    program over the device mesh (exec/mesh.py). Strings cross the
+    collective as a second byte plane (parallel/collective.py), matching
+    the reference's type-agnostic UCX transport
+    (RapidsShuffleClient.scala:35-98); other non-fixed types (binary)
+    stay on the single-host exchange."""
+    from ..exec.mesh import mesh_available
 
     return mesh_available(conf) and all(
-        fixed_width_schema(s) for s in schemas)
+        T.is_fixed_width(f.dataType) or isinstance(f.dataType, T.StringType)
+        for s in schemas for f in s.fields)
 
 
 def _convert_aggregate(cpu: C.CpuHashAggregateExec, conf, children):
@@ -483,13 +487,20 @@ def _convert_aggregate(cpu: C.CpuHashAggregateExec, conf, children):
     # RapidsShuffleInternalManager.scala:58-150)
     if cpu.group_exprs and _mesh_eligible(conf, child.output_schema):
         try:
-            key_dts = [
-                E.bind_references(g, child.output_schema).dtype
+            bound_keys = [
+                E.bind_references(g, child.output_schema)
                 for g in cpu.group_exprs
             ]
         except (ValueError, KeyError):
-            key_dts = [T.STRING]
-        if all(T.is_fixed_width(dt) for dt in key_dts):
+            bound_keys = None
+        # string group keys need the staged source column's byte bound, so
+        # they must be DIRECT column references; computed string keys
+        # (concat, substring, ...) stay on the single-host exchange
+        if bound_keys is not None and all(
+            T.is_fixed_width(b.dtype)
+            or (T.is_string(b.dtype) and isinstance(b, E.BoundReference))
+            for b in bound_keys
+        ):
             from ..exec.mesh import TpuMeshAggregateExec
 
             return TpuMeshAggregateExec(

@@ -192,8 +192,11 @@ def test_scan_feeds_partitioned_aggregate_through_exchange(tmpd):
     t = _mixed_table(1200, seed=12)
     for i in range(4):
         pq.write_table(t.slice(i * 300, 300), f"{tmpd}/p{i}.parquet")
+    # shuffle.mode=host pins the single-host exchange path under test
+    # (string-bearing schemas are otherwise mesh-eligible now)
     s = TpuSession({
-        "spark.rapids.tpu.sql.format.parquet.reader.type": "PERFILE"})
+        "spark.rapids.tpu.sql.format.parquet.reader.type": "PERFILE",
+        "spark.rapids.tpu.shuffle.mode": "host"})
     df = s.read.parquet(tmpd).group_by("k").agg(
         A.agg(A.Sum(E.col("l")), "sl"))
     out = df.collect()

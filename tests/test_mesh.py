@@ -145,20 +145,95 @@ def test_mesh_matches_host_exchange():
     compare_rows(ra, rb, ignore_order=True, approx_float=False)
 
 
-def test_string_stage_falls_back_to_host_exchange():
-    """String columns can't cross the collective; the planner must pick the
-    single-host exchange, not fail."""
+STR_SCHEMA = T.StructType([
+    T.StructField("s", T.STRING),
+    T.StructField("v", T.LONG),
+    T.StructField("p", T.STRING),
+])
+
+
+def _str_data(n=600):
+    pool = ["alpha", "beta-longer-key", "", "gamma", None, "déjà"]
+    return {
+        "s": [pool[i % len(pool)] for i in range(n)],
+        "v": [None if i % 23 == 0 else i * 3 - n for i in range(n)],
+        "p": [f"payload-{i % 11}-{'x' * (i % 5)}" for i in range(n)],
+    }
+
+
+def make_str_df(s, n=600, parts=4):
+    return s.create_dataframe(_str_data(n), STR_SCHEMA, num_partitions=parts)
+
+
+def test_mesh_string_key_aggregate_differential():
+    """String group keys cross the mesh via the collective's byte plane
+    (reference bar: the UCX shuffle is type-agnostic,
+    RapidsShuffleClient.scala:35-98)."""
+    def build(s):
+        return make_str_df(s).group_by("s").agg(
+            A.agg(A.Count(None), "n"), A.agg(A.Sum(col("v")), "sv"))
+
+    assert_tpu_and_cpu_equal(build, conf=ICI)
     sess = TpuSession({**ICI, "spark.rapids.tpu.sql.test.enabled": True})
-    schema = T.StructType([
-        T.StructField("s", T.STRING), T.StructField("v", T.LONG)])
-    df = sess.create_dataframe(
-        {"s": [f"s{i % 5}" for i in range(200)],
-         "v": list(range(200))}, schema, num_partitions=3)
-    rows = df.group_by("s").agg(A.agg(A.Sum(col("v")), "sv")).collect()
+    make_str_df(sess).group_by("s").agg(A.agg(A.Count(None), "n")).collect()
+    assert "TpuMeshAggregateExec" in _plan(sess)
+
+
+def test_mesh_string_key_join_differential():
+    def build(s):
+        left = make_str_df(s, n=300, parts=3)
+        right = s.create_dataframe(
+            {"s2": ["alpha", "beta-longer-key", "", "zeta"],
+             "w": ["W-alpha", "W-beta", "W-empty", "W-zeta"]},
+            T.StructType([T.StructField("s2", T.STRING),
+                          T.StructField("w", T.STRING)]),
+            num_partitions=2)
+        return left.join(right, on=[("s", "s2")])
+
+    assert_tpu_and_cpu_equal(build, conf=ICI)
+    sess = TpuSession({**ICI, "spark.rapids.tpu.sql.test.enabled": True})
+    left = make_str_df(sess, n=120, parts=2)
+    right = sess.create_dataframe(
+        {"s2": ["alpha"], "w": ["W"]},
+        T.StructType([T.StructField("s2", T.STRING),
+                      T.StructField("w", T.STRING)]), num_partitions=2)
+    left.join(right, on=[("s", "s2")]).collect()
+    assert "TpuMeshHashJoinExec" in _plan(sess)
+
+
+def test_mesh_string_sort_differential():
+    def build(s):
+        return make_str_df(s).order_by(col("s"))
+
+    assert_tpu_and_cpu_equal(build, conf=ICI)
+
+
+def test_mesh_string_matches_host_exchange():
+    def build(s):
+        return make_str_df(s).group_by("s").agg(
+            A.agg(A.Sum(col("v")), "sv"), A.agg(A.Count(None), "n"))
+
+    a = TpuSession({**ICI, "spark.rapids.tpu.sql.test.enabled": True})
+    b = TpuSession({**HOST, "spark.rapids.tpu.sql.test.enabled": True})
+    ra = build(a).collect()
+    rb = build(b).collect()
+    assert "TpuMeshAggregateExec" in _plan(a)
+    assert "TpuShuffleExchangeExec" in _plan(b)
+    compare_rows(ra, rb, ignore_order=True, approx_float=False)
+
+
+def test_computed_string_key_falls_back_to_host_exchange():
+    """COMPUTED string keys have no staged byte bound; the planner must
+    pick the single-host exchange, not fail."""
+    sess = TpuSession({**ICI, "spark.rapids.tpu.sql.test.enabled": True})
+    df = make_str_df(sess, n=200, parts=3)
+    rows = df.group_by(E.Alias(E.Upper(col("s")), "u")).agg(
+        A.agg(A.Sum(col("v")), "sv")).collect()
     plan = _plan(sess)
     assert "TpuMeshAggregateExec" not in plan
     assert "TpuShuffleExchangeExec" in plan
-    assert len(rows) == 5
+    # pool: ALPHA, BETA-LONGER-KEY, "", GAMMA, None, DÉJÀ
+    assert len(rows) == 6
 
 
 def test_mesh_empty_and_skewed_partitions():
