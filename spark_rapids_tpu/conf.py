@@ -208,9 +208,23 @@ SHUFFLE_MODE = conf(
 SHUFFLE_TRANSPORT_CLASS = conf(
     "spark.rapids.tpu.shuffle.transport.class", "device",
     "Transport for exchange pieces: 'device' (pieces stay TPU-resident in "
-    "the shuffle catalog, the UCX device-cache analog) or 'host' "
-    "(serialized host bytes, the fallback-serializer analog).",
-    valid_values=("device", "host"))
+    "the shuffle catalog, the UCX device-cache analog), 'host' "
+    "(serialized host bytes, the fallback-serializer analog), or "
+    "'network' (TCP block server/client across worker processes, the "
+    "RapidsShuffleServer/Client analog — selection by conf mirrors "
+    "RapidsShuffleTransport.scala:328-411 + RapidsConf.scala:696).",
+    valid_values=("device", "host", "network"))
+SHUFFLE_NETWORK_PEERS = conf(
+    "spark.rapids.tpu.shuffle.network.peers", "",
+    "Comma-separated host:port list of the OTHER workers' shuffle "
+    "servers; fetches merge local pieces with every peer's (reference: "
+    "RapidsCachingReader splits local catalog hits from transport "
+    "fetches, RapidsCachingReader.scala:60-155).")
+SHUFFLE_NETWORK_LISTEN_PORT = conf(
+    "spark.rapids.tpu.shuffle.network.listenPort", 0,
+    "TCP port for this process's shuffle block server; 0 picks an "
+    "ephemeral port (the chosen address is in the transport's "
+    "server.address).")
 SHUFFLE_COMPRESSION_CODEC = conf(
     "spark.rapids.tpu.shuffle.compression.codec", "none",
     "Codec for host-path shuffle payloads: none/zstd/lz4. lz4 is the "

@@ -306,6 +306,11 @@ def _spark_compare(expr: E.Expression, l, r):
     return l >= r
 
 
+#: partition context for nondeterministic/metadata expressions, set by
+#: CpuProjectExec around each row (pid, row index in partition, file path)
+ROW_CTX: dict = {"pid": 0, "row": 0, "file": ""}
+
+
 def eval_row(expr: E.Expression, row: Sequence[Any]) -> Any:
     """Evaluate one bound expression against one row (values may be None)."""
     ev = lambda e: eval_row(e, row)  # noqa: E731
@@ -316,6 +321,24 @@ def eval_row(expr: E.Expression, row: Sequence[Any]) -> Any:
         return expr.value
     if isinstance(expr, E.BoundReference):
         return row[expr.ordinal]
+
+    if isinstance(expr, E.SparkPartitionID):
+        return ROW_CTX["pid"]
+    if isinstance(expr, E.MonotonicallyIncreasingID):
+        return (ROW_CTX["pid"] << 33) + ROW_CTX["row"]
+    if isinstance(expr, E.InputFileName):
+        return ROW_CTX["file"]
+    if isinstance(expr, E.Rand):
+        from ..expr.nondet import rand_double_scalar
+
+        return rand_double_scalar(expr.seed, ROW_CTX["pid"], ROW_CTX["row"])
+    if isinstance(expr, E.Murmur3Hash):
+        from ..expr.nondet import murmur3_scalar
+
+        h = expr.seed
+        for c in expr.exprs:
+            h = murmur3_scalar(ev(c), c.dtype, h)
+        return h
 
     if isinstance(expr, E._DecimalSumCheck):
         v = ev(expr.child)

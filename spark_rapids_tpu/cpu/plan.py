@@ -232,7 +232,34 @@ class CpuProjectExec(CpuExec):
     def estimated_size_bytes(self):
         return self._child_size_estimate()
 
+    def _source_file(self, index: int) -> str:
+        node: CpuExec = self.children[0]
+        while True:
+            scanner = getattr(node, "scanner", None)
+            if scanner is not None and hasattr(scanner, "splits"):
+                splits = scanner.splits()
+                return splits[index].path if index < len(splits) else ""
+            kids = node.children
+            if len(kids) != 1:
+                return ""
+            node = kids[0]
+
     def execute_rows_partition(self, index: int) -> Iterator[tuple]:
+        if any(E.has_context_expr(b) for b in self._bound):
+            # partition context (pid / row index / file) for the
+            # nondeterministic+metadata family — mirrors the TPU project's
+            # context columns so differential tests compare exactly
+            from .interpreter import ROW_CTX
+
+            fpath = self._source_file(index)
+            for i, row in enumerate(
+                    self.children[0].execute_rows_partition(index)):
+                ROW_CTX.update(pid=index, row=i, file=fpath)
+                try:
+                    yield tuple(eval_row(b, row) for b in self._bound)
+                finally:
+                    ROW_CTX.update(pid=0, row=0, file="")
+            return
         for row in self.children[0].execute_rows_partition(index):
             yield tuple(eval_row(b, row) for b in self._bound)
 
@@ -819,10 +846,45 @@ class CpuWindowExec(CpuExec):
                 yield row + tuple(extra)
 
     def _frame_rows(self, part, okeys, i, whole, range_frame):
+        from ..expr import windows as W
+
         frame = self.spec.resolved_frame()
         if not whole and not frame.is_running and frame.is_bounded_rows:
             lo, hi = frame.row_bounds()
             return range(max(i + lo, 0), min(i + hi, len(part) - 1) + 1)
+        if (not whole and not frame.is_running
+                and frame.frame_type == W.RANGE and frame.is_bounded_range
+                and len(self._order) == 1):
+            # literal RANGE frame: rows whose key value falls in
+            # [key_i + lo, key_i + hi]; a null key's frame is all nulls
+            lo, hi = frame.range_bounds()
+            ki = eval_row(self._order[0], part[i])
+            out = []
+            for j, r in enumerate(part):
+                kj = eval_row(self._order[0], r)
+                if ki is None:
+                    # bounded sides land on the null peer block (nulls are
+                    # mutual peers); unbounded sides keep partition edges
+                    if kj is None or (
+                        (lo is None and j < i) or (hi is None and j > i)
+                    ):
+                        out.append(j)
+                    continue
+                if kj is None:
+                    # a null row joins a NON-null row's frame only through
+                    # an unbounded side reaching past it
+                    nf = self._orders[0][1]
+                    asc = self._orders[0][0]
+                    nulls_first = asc if nf is None else nf
+                    if (nulls_first and lo is None) or (
+                            not nulls_first and hi is None):
+                        out.append(j)
+                    continue
+                asc = self._orders[0][0]
+                d = (kj - ki) if asc else (ki - kj)
+                if (lo is None or d >= lo) and (hi is None or d <= hi):
+                    out.append(j)
+            return out
         if whole:
             return range(len(part))
         if range_frame:

@@ -103,9 +103,13 @@ def _project_pipeline(exprs: Tuple[E.Expression, ...], sig: tuple, cap: int):
 class TpuProjectExec(TpuExec):
     """reference: GpuProjectExec (basicPhysicalOperators.scala:48-61).
 
-    Fusable: a project never dispatches alone if its neighbors fuse too."""
-
-    fusable = True
+    Fusable: a project never dispatches alone if its neighbors fuse too.
+    Partition-context expressions (rand / monotonically_increasing_id /
+    spark_partition_id / input_file_name, plus hash() over strings, which
+    needs a host-synced byte bound) evaluate at the exec boundary as
+    appended input columns — the same treatment Spark gives
+    nondeterministic expressions by pinning them in their own Project —
+    and such a project does not fuse."""
 
     def __init__(self, conf: RapidsConf, exprs: Sequence[E.Expression], child: TpuExec):
         super().__init__(conf, [child])
@@ -114,6 +118,32 @@ class TpuProjectExec(TpuExec):
         self._bound = tuple(
             E.bind_references(e, child.output_schema) for e in self.exprs
         )
+        self._ctx_exprs = self._collect_ctx_exprs()
+
+    def _collect_ctx_exprs(self):
+        """Distinct context subexpressions, in first-appearance order.
+        Equal nodes share one column — Spark semantics: two rand(5) calls
+        draw the same per-row sequence (same seeded generator)."""
+        out = []
+
+        def walk(e):
+            if isinstance(e, E.NONDETERMINISTIC_CONTEXT_EXPRS) or (
+                isinstance(e, E.Murmur3Hash)
+                and any(T.is_string(c.dtype) for c in e.exprs)
+            ):
+                if e not in out:
+                    out.append(e)
+                return
+            for c in e.children:
+                walk(c)
+
+        for b in self._bound:
+            walk(b)
+        return tuple(out)
+
+    @property
+    def fusable(self):  # type: ignore[override]
+        return not self._ctx_exprs
 
     @property
     def output_schema(self):
@@ -132,7 +162,107 @@ class TpuProjectExec(TpuExec):
         from .base import run_fused_chain
 
         with timed(self.metrics[TOTAL_TIME], "TpuProject", self.conf.get(ENABLE_TRACE)):
-            yield from run_fused_chain(self, index)
+            if self._ctx_exprs:
+                yield from self._execute_with_context(index)
+            else:
+                yield from run_fused_chain(self, index)
+
+    # -- partition-context evaluation --------------------------------------
+    def _source_file(self, index: int) -> str:
+        """File path for input_file_name: walk single-child row-preserving
+        execs down to a file scan (partition indices pass through 1:1)."""
+        node: TpuExec = self.children[0]
+        while True:
+            scanner = getattr(node, "scanner", None)
+            if scanner is not None and hasattr(scanner, "splits"):
+                splits = scanner.splits()
+                return splits[index].path if index < len(splits) else ""
+            kids = node.children
+            if len(kids) != 1 or not getattr(node, "fusable", False):
+                return ""  # not a file scan source (Spark returns "")
+            node = kids[0]
+
+    def _ctx_columns(self, batch, index: int, row_base, cap: int, fpath: str):
+        """Materialize one DeviceColumn per context expression."""
+        import jax.numpy as jnp
+
+        from ..expr.nondet import rand_double_jax
+        from ..ops import hashing
+        from ..ops.sort import max_string_len
+        from .base import count_scalar
+        from .scan import constant_string_column
+
+        cols = []
+        fields = []
+        n = batch.num_rows_lazy
+        idx64 = jnp.arange(cap, dtype=jnp.int64)
+        for k, e in enumerate(self._ctx_exprs):
+            if isinstance(e, E.SparkPartitionID):
+                c = DeviceColumn(
+                    T.INT, n, jnp.full(cap, index, jnp.int32),
+                    jnp.ones(cap, jnp.bool_))
+            elif isinstance(e, E.MonotonicallyIncreasingID):
+                base = (jnp.int64(index) << 33) + count_scalar(
+                    row_base).astype(jnp.int64)
+                c = DeviceColumn(
+                    T.LONG, n, base + idx64, jnp.ones(cap, jnp.bool_))
+            elif isinstance(e, E.Rand):
+                rows = count_scalar(row_base).astype(jnp.int64) + idx64
+                c = DeviceColumn(
+                    T.DOUBLE, n, rand_double_jax(e.seed, index, rows),
+                    jnp.ones(cap, jnp.bool_))
+            elif isinstance(e, E.InputFileName):
+                nn = n if isinstance(n, int) else cap
+                c = constant_string_column(fpath, nn, cap)
+            else:  # Murmur3Hash with string children
+                vals = [lower(x, vals_of_batch(batch), cap)
+                        for x in e.exprs]
+                smls = [
+                    max(4, int(max_string_len(v)))
+                    for v in vals if hasattr(v, "offsets")
+                ]
+                h = hashing.murmur3(
+                    vals, [x.dtype for x in e.exprs], e.seed, smls)
+                c = DeviceColumn(T.INT, n, h, jnp.ones(cap, jnp.bool_))
+            cols.append(c)
+            fields.append(StructField(f"_ctx{k}", c.dtype, False))
+        return cols, fields
+
+    def _execute_with_context(self, index: int) -> Iterator[ColumnarBatch]:
+        from .base import count_scalar
+
+        child = self.children[0]
+        child_schema = child.output_schema
+        nbase = len(child_schema.fields)
+        subst = {e: i for i, e in enumerate(self._ctx_exprs)}
+
+        def rewrite(node):
+            i = subst.get(node)
+            if i is not None:
+                return E.BoundReference(
+                    nbase + i, node.dtype, node.nullable)
+            return node
+
+        rewritten = tuple(b.transform(rewrite) for b in self._bound)
+        fpath = self._source_file(index)
+        row_base = 0
+        for batch in child.execute_partition(index):
+            cap = batch.capacity if batch.columns else 128
+            extra_cols, extra_fields = self._ctx_columns(
+                batch, index, row_base, cap, fpath)
+            ext = ColumnarBatch(
+                list(batch.columns) + extra_cols,
+                StructType(tuple(child_schema.fields) + tuple(extra_fields)),
+                batch.num_rows_lazy)
+            fn = _project_pipeline(
+                rewritten, batch_signature(ext), cap)
+            vals = fn(vals_of_batch(ext))
+            yield self.record_batch(
+                batch_from_vals(vals, self._schema, batch.num_rows_lazy))
+            nr = batch.num_rows_lazy
+            row_base = (row_base + nr if isinstance(nr, int)
+                        and isinstance(row_base, int)
+                        else count_scalar(row_base) + count_scalar(nr))
 
 
 class TpuFilterExec(TpuExec):

@@ -56,6 +56,25 @@ def make_transport(conf: RapidsConf) -> ShuffleTransport:
     kind = conf.get(SHUFFLE_TRANSPORT_CLASS)
     if kind == "host":
         return SerializedShuffleTransport(conf.get(SHUFFLE_COMPRESSION_CODEC))
+    if kind == "network":
+        # conf-selected server/client transport (reference: transport
+        # selection by conf, RapidsShuffleTransport.scala:328-411); the
+        # process-wide server owns this worker's map output and fetches
+        # merge every peer's pieces
+        from ..conf import SHUFFLE_NETWORK_LISTEN_PORT, SHUFFLE_NETWORK_PEERS
+        from ..shuffle.network import NetworkShuffleTransport, local_server
+
+        remotes = []
+        for p in conf.get(SHUFFLE_NETWORK_PEERS).split(","):
+            p = p.strip()
+            if p:
+                host, _, port = p.rpartition(":")
+                remotes.append((host, int(port)))
+        return NetworkShuffleTransport(
+            server=local_server(conf.get(SHUFFLE_NETWORK_LISTEN_PORT)),
+            remotes=tuple(remotes),
+            codec=conf.get(SHUFFLE_COMPRESSION_CODEC),
+            owns_server=False)
     return DeviceShuffleTransport()
 
 

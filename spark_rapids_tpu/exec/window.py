@@ -121,6 +121,13 @@ class TpuWindowExec(TpuExec):
         whole = frame.is_whole_partition or not self._order_keys
         bounded = frame.is_bounded_rows and not whole and not frame.is_running
         blo, bhi = frame.row_bounds() if bounded else (0, 0)
+        # literal RANGE frame over the single numeric ORDER BY key value
+        branged = (frame.is_bounded_range and not whole
+                   and bool(self._order_keys))
+        # DESC normalizes by NEGATING the key (exec below); "preceding"
+        # flips direction with the key, so the offsets carry over as-is:
+        # kj in [ki-hi, ki+(-lo)] <=> -kj in [-ki+lo, -ki+hi]
+        rlo, rhi = frame.range_bounds() if branged else (None, None)
 
         def run(cols, num_rows):
             live = filter_gather.live_of(num_rows, cap)
@@ -150,6 +157,21 @@ class TpuWindowExec(TpuExec):
             ps, pe, qs, qe, seg = window_ops.boundaries_from_radix(
                 part_radix, order_radix, live_s)
 
+            range_key = None
+            if branged:
+                rk = lower(self._order_keys[0], sorted_cols, cap)
+                if not self._orders[0].ascending:
+                    rk = ColV(-rk.data, rk.validity)  # ASC-normalize
+                range_key = rk
+                nf = self._orders[0].nulls_first
+                range_nulls_first = (
+                    self._orders[0].ascending if nf is None else nf)
+
+            def ranged(op_, v_):
+                return window_ops.bounded_range_agg(
+                    op_, v_, range_key, ps, pe, qs, qe, live_s, rlo, rhi,
+                    range_nulls_first)
+
             out = list(sorted_cols)
             for we, f in zip(self.window_exprs, self._bound_funcs):
                 if isinstance(f, W.RowNumber):
@@ -169,7 +191,10 @@ class TpuWindowExec(TpuExec):
                         v, off, ps, pe, live_s, dflt))
                 elif isinstance(f, A.Average):
                     v = lower(E.Cast(f.child, T.DOUBLE), sorted_cols, cap)
-                    if bounded:
+                    if branged:
+                        s = ranged("sum", v)
+                        c = ranged("count", v)
+                    elif bounded:
                         s = window_ops.bounded_row_agg(
                             "sum", v, ps, pe, live_s, blo, bhi)
                         c = window_ops.bounded_row_agg(
@@ -196,7 +221,9 @@ class TpuWindowExec(TpuExec):
                         cast_to = f.dtype if isinstance(f, A.Sum) else None
                         e = E.Cast(f.child, cast_to) if cast_to else f.child
                         v = lower(e, sorted_cols, cap)
-                    if bounded:
+                    if branged:
+                        out.append(ranged(op, v))
+                    elif bounded:
                         out.append(window_ops.bounded_row_agg(
                             op, v, ps, pe, live_s, blo, bhi))
                     else:

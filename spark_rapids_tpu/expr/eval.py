@@ -304,6 +304,16 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
         valid = jnp.full((cap,), v is not None)
         return ColV(data, valid)
 
+    if isinstance(expr, E.Murmur3Hash):
+        # fixed-width children lower inline; string children are routed
+        # through the project exec's context path (needs a host-synced
+        # byte bound) — reference: HashFunctions.scala:43
+        from ..ops import hashing
+
+        vals = [ev(c) for c in expr.exprs]
+        h = hashing.murmur3(vals, [c.dtype for c in expr.exprs], expr.seed)
+        return ColV(h, jnp.ones(cap, jnp.bool_))
+
     if isinstance(expr, E._DecimalSumCheck):
         c = ev(expr.child)
         ok = _dec_fits(c.data.astype(jnp.int64), expr.result.precision)

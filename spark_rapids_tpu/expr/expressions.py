@@ -679,6 +679,103 @@ class ShiftRightUnsigned(Expression):
 
 
 # ---------------------------------------------------------------------------
+# Nondeterministic / metadata expressions (reference:
+# GpuRandomExpressions.scala:31, GpuMonotonicallyIncreasingID.scala,
+# GpuSparkPartitionID.scala, GpuInputFileBlock.scala, HashFunctions.scala:43)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Rand(Expression):
+    """rand(seed): uniform [0, 1) doubles, deterministic per
+    (seed, partition, row) — a counter-based generator rather than the
+    JVM's sequential XORShift (the TPU-native design, same guarantee
+    Spark documents: reproducible given the seed and partitioning;
+    reference: GpuRandomExpressions.scala:31)."""
+
+    seed: int = 0
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class MonotonicallyIncreasingID(Expression):
+    """(partition_id << 33) + row_index_in_partition — Spark's exact
+    layout (reference: GpuMonotonicallyIncreasingID.scala)."""
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class SparkPartitionID(Expression):
+    """Current partition index (reference: GpuSparkPartitionID.scala)."""
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class InputFileName(Expression):
+    """Path of the file being scanned, or '' when the source is not a
+    file scan (reference: GpuInputFileBlock.scala — same empty-string
+    contract as Spark's InputFileName)."""
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    @property
+    def nullable(self):
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class Murmur3Hash(Expression):
+    """hash(cols...): Spark's murmur3_32 with seed 42, int32 result
+    (reference: HashFunctions.scala:43 GpuMurmur3Hash; kernel:
+    ops/hashing.py murmur3)."""
+
+    exprs: Tuple[Expression, ...]
+    seed: int = 42
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+
+NONDETERMINISTIC_CONTEXT_EXPRS = (
+    Rand, MonotonicallyIncreasingID, SparkPartitionID, InputFileName)
+
+
+def has_context_expr(e: Expression) -> bool:
+    """True when the tree contains a partition-context expression (these
+    evaluate at the exec boundary, like Spark pulling nondeterministic
+    expressions into their own Project)."""
+    if isinstance(e, NONDETERMINISTIC_CONTEXT_EXPRS):
+        return True
+    return any(has_context_expr(c) for c in e.children)
+
+
+# ---------------------------------------------------------------------------
 # Strings (reference: sql/rapids/stringFunctions.scala, 889 LoC)
 # ---------------------------------------------------------------------------
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,131 @@
+"""Counter-based rand shared by the TPU kernel and the CPU oracle.
+
+Reference analog: GpuRandomExpressions.scala:31 seeds an XORShiftRandom
+with (seed + partitionIndex) and draws sequentially. A sequential
+generator is the wrong shape for a vector machine; the TPU-native design
+is a COUNTER-BASED generator (the same idea as JAX's own threefry PRNG):
+value = mix(seed, partition, row_index). That keeps Spark's documented
+guarantee — deterministic given the seed and the partitioning — and the
+CPU oracle below is bit-identical, so the differential suite can compare
+exactly.
+
+The mixer is splitmix64 (Steele et al., "Fast Splittable Pseudorandom
+Number Generators"), a public-domain finalizer with full 64-bit
+avalanche. Doubles take the top 53 bits / 2^53, exactly like
+java.util.SplittableRandom.nextDouble.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_MASK64 = (1 << 64) - 1
+_GOLDEN = 0x9E3779B97F4A7C15
+_MIX1 = 0xBF58476D1CE4E5B9
+_MIX2 = 0x94D049BB133111EB
+_INV53 = 1.0 / (1 << 53)
+
+
+def _splitmix64_scalar(z: int) -> int:
+    z = (z + _GOLDEN) & _MASK64
+    z = ((z ^ (z >> 30)) * _MIX1) & _MASK64
+    z = ((z ^ (z >> 27)) * _MIX2) & _MASK64
+    return z ^ (z >> 31)
+
+
+def rand_double_scalar(seed: int, pid: int, row: int) -> float:
+    """One uniform double in [0, 1) — the CPU oracle path."""
+    base = _splitmix64_scalar((seed & _MASK64) ^ ((pid & _MASK64) * _GOLDEN))
+    h = _splitmix64_scalar(base ^ (row & _MASK64))
+    return (h >> 11) * _INV53
+
+
+def rand_double_jax(seed: int, pid: int, rows):
+    """Vector of uniform doubles for row indices ``rows`` (traced i64
+    array) — bit-identical to rand_double_scalar. uint64 ops run through
+    the x64 rewriter on TPU; all operations are exact integer arithmetic,
+    and (h >> 11) * 2^-53 is exactly representable in f64."""
+    import jax.numpy as jnp
+
+    def mix(z):
+        z = z + jnp.uint64(_GOLDEN)
+        z = (z ^ (z >> 30)) * jnp.uint64(_MIX1)
+        z = (z ^ (z >> 27)) * jnp.uint64(_MIX2)
+        return z ^ (z >> 31)
+
+    base = _splitmix64_scalar((seed & _MASK64) ^ ((pid & _MASK64) * _GOLDEN))
+    h = mix(jnp.uint64(base) ^ rows.astype(jnp.uint64))
+    return (h >> 11).astype(jnp.float64) * _INV53
+
+
+# ---------------------------------------------------------------------------
+# Spark Murmur3_x86_32, scalar (the CPU oracle for the Murmur3Hash
+# expression; the TPU kernel is ops/hashing.py)
+# ---------------------------------------------------------------------------
+_M32 = 0xFFFFFFFF
+
+
+def _rotl32(x: int, r: int) -> int:
+    return ((x << r) | (x >> (32 - r))) & _M32
+
+
+def _mixk1(k1: int) -> int:
+    k1 = (k1 * 0xCC9E2D51) & _M32
+    k1 = _rotl32(k1, 15)
+    return (k1 * 0x1B873593) & _M32
+
+
+def _mixh1(h1: int, k1: int) -> int:
+    h1 = (h1 ^ k1) & _M32
+    h1 = _rotl32(h1, 13)
+    return (h1 * 5 + 0xE6546B64) & _M32
+
+
+def _fmix(h1: int, length: int) -> int:
+    h1 = (h1 ^ length) & _M32
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & _M32
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & _M32
+    return h1 ^ (h1 >> 16)
+
+
+def _as_i32(u: int) -> int:
+    return u - (1 << 32) if u >= (1 << 31) else u
+
+
+def murmur3_scalar(value, dtype, seed: int) -> int:
+    """Hash one value with Spark's semantics: null leaves the seed
+    untouched; int-family/date hash as one word, long/timestamp as two,
+    float/double via their bits, strings as UTF-8 bytes."""
+    from .. import types as T
+
+    h = seed & _M32
+    if value is None:
+        return _as_i32(h)
+    if isinstance(dtype, (T.BooleanType,)):
+        return _as_i32(_fmix(_mixh1(h, _mixk1(1 if value else 0)), 4))
+    if isinstance(dtype, (T.ByteType, T.ShortType, T.IntegerType, T.DateType)):
+        return _as_i32(_fmix(_mixh1(h, _mixk1(int(value) & _M32)), 4))
+    if isinstance(dtype, (T.LongType, T.TimestampType)):
+        x = int(value) & _MASK64
+        h = _mixh1(h, _mixk1(x & _M32))
+        h = _mixh1(h, _mixk1((x >> 32) & _M32))
+        return _as_i32(_fmix(h, 8))
+    if isinstance(dtype, T.FloatType):
+        bits = int(np.float32(value).view(np.int32)) & _M32
+        return _as_i32(_fmix(_mixh1(h, _mixk1(bits)), 4))
+    if isinstance(dtype, T.DoubleType):
+        bits = int(np.float64(value).view(np.int64)) & _MASK64
+        h = _mixh1(h, _mixk1(bits & _M32))
+        h = _mixh1(h, _mixk1((bits >> 32) & _M32))
+        return _as_i32(_fmix(h, 8))
+    if isinstance(dtype, (T.StringType, T.BinaryType)):
+        b = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+        n = len(b) - len(b) % 4
+        for i in range(0, n, 4):
+            h = _mixh1(h, _mixk1(int.from_bytes(b[i: i + 4], "little")))
+        for i in range(n, len(b)):
+            sbyte = b[i] - 256 if b[i] >= 128 else b[i]
+            h = _mixh1(h, _mixk1(sbyte & _M32))
+        return _as_i32(_fmix(h, len(b)))
+    raise ValueError(f"murmur3 of {dtype.simpleString} not supported")

@@ -68,6 +68,32 @@ class WindowFrame:
         hi = 0 if self.upper == CURRENT_ROW else self.upper
         return int(lo), int(hi)
 
+    @property
+    def is_bounded_range(self) -> bool:
+        """Literal RANGE frame over the ORDER BY key VALUE: lower/upper
+        are numeric offsets (preceding = negative, like row_bounds) or
+        one-sided sentinels. Reference: RangeFrame handling in
+        GpuWindowExpression.scala:88,168."""
+        if self.frame_type != RANGE:
+            return False
+        if self.is_running or self.is_whole_partition:
+            return False  # cheaper dedicated kernels handle these
+        lo_ok = self.lower in (UNBOUNDED_PRECEDING, CURRENT_ROW) or \
+            isinstance(self.lower, (int, float))
+        hi_ok = self.upper in (UNBOUNDED_FOLLOWING, CURRENT_ROW) or \
+            isinstance(self.upper, (int, float))
+        return lo_ok and hi_ok
+
+    def range_bounds(self):
+        """(lo, hi) numeric key-value offsets; None = unbounded side;
+        CURRENT ROW = offset 0 (the frame then starts/ends at the peer
+        boundary, which the value search finds naturally)."""
+        lo = (None if self.lower == UNBOUNDED_PRECEDING
+              else 0 if self.lower == CURRENT_ROW else self.lower)
+        hi = (None if self.upper == UNBOUNDED_FOLLOWING
+              else 0 if self.upper == CURRENT_ROW else self.upper)
+        return lo, hi
+
 
 @dataclasses.dataclass(frozen=True)
 class WindowSpec:

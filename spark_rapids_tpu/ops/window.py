@@ -19,6 +19,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..expr.eval import ColV
@@ -209,6 +210,114 @@ def bounded_row_agg(
             r = jnp.where((n_nonnan == 0) & has, jnp.nan, r)
         return ColV(jnp.where(has, r, jnp.zeros((), r.dtype)), has)
     raise ValueError(f"unsupported bounded window aggregation {op!r}")
+
+
+def _search_sorted_in_partition(
+    keys: jax.Array, lo0: jax.Array, hi0: jax.Array, target: jax.Array,
+    side: str,
+) -> jax.Array:
+    """Vectorized per-row binary search over [lo0, hi0) of a key array
+    that is non-decreasing WITHIN each row's partition slice. side='left'
+    returns the first index with key >= target, 'right' the first with
+    key > target. log2(cap) gather passes — the TPU-shaped replacement
+    for cudf's per-row range-window bound search."""
+    cap = keys.shape[0]
+    iters = max(int(np.ceil(np.log2(max(cap, 2)))) + 1, 1)
+    lo, hi = lo0, hi0
+    for _ in range(iters):
+        mid = (lo + hi) // 2
+        v = jnp.take(keys, jnp.clip(mid, 0, cap - 1), mode="clip")
+        go_right = (v < target) if side == "left" else (v <= target)
+        valid = lo < hi
+        lo = jnp.where(valid & go_right, mid + 1, lo)
+        hi = jnp.where(valid & ~go_right, mid, hi)
+    return lo
+
+
+def _saturating_offset(kd: jax.Array, off) -> jax.Array:
+    """kd + off with integer saturation (offsets are host literals)."""
+    if jnp.issubdtype(kd.dtype, jnp.floating):
+        return kd + jnp.asarray(off, kd.dtype)
+    info = jnp.iinfo(kd.dtype)
+    o = int(off)
+    if o >= 0:
+        return jnp.where(kd > info.max - o, info.max, kd + o)
+    return jnp.where(kd < info.min - o, info.min, kd + o)
+
+
+def bounded_range_agg(
+    op: str,
+    col: Optional[ColV],
+    order_key: ColV,
+    part_start: jax.Array,
+    part_end: jax.Array,
+    peer_start: jax.Array,
+    peer_end: jax.Array,
+    live: jax.Array,
+    lower,  # numeric offset (preceding negative) or None = unbounded
+    upper,  # numeric offset or None = unbounded
+    nulls_first: bool,
+) -> ColV:
+    """sum/count over a literal RANGE frame: rows j of the same partition
+    with key[j] in [key[i]+lower, key[i]+upper]. ``order_key`` is the
+    single numeric ORDER BY key, ASC-normalized (callers negate data and
+    swap/negate bounds for DESC). Null-key rows take their peer group —
+    all nulls — as the frame (Spark's RangeFrame null semantics).
+    Reference: GpuWindowExpression.scala:88,168."""
+    cap = live.shape[0]
+    kd = order_key.data
+    kv = order_key.validity & live
+    # park null keys at the end they sort to, keeping the slice monotone;
+    # the offset search then naturally excludes them from non-null frames
+    if jnp.issubdtype(kd.dtype, jnp.floating):
+        park = jnp.array(-jnp.inf if nulls_first else jnp.inf, kd.dtype)
+    else:
+        info = jnp.iinfo(kd.dtype)
+        park = jnp.array(info.min if nulls_first else info.max, kd.dtype)
+    keys = jnp.where(kv, kd, park)
+
+    if lower is None:
+        lo = part_start
+    else:
+        lo = _search_sorted_in_partition(
+            keys, part_start, part_end + 1,
+            _saturating_offset(keys, lower), "left")
+    if upper is None:
+        hi = part_end
+    else:
+        hi = _search_sorted_in_partition(
+            keys, part_start, part_end + 1,
+            _saturating_offset(keys, upper), "right") - 1
+    # null current rows: a BOUNDED side lands on the null peer block
+    # (nulls are mutual peers); an unbounded side keeps the partition edge
+    if lower is not None:
+        lo = jnp.where(kv, lo, peer_start)
+    if upper is not None:
+        hi = jnp.where(kv, hi, peer_end)
+    empty = (hi < lo) | ~live
+    lo_c = jnp.clip(lo, 0, cap - 1)
+    hi_c = jnp.clip(hi, 0, cap - 1)
+
+    if op == "count_star":
+        cnt = jnp.where(empty, 0, hi_c - lo_c + 1)
+        return ColV(cnt.astype(jnp.int64), live)
+    valid = live & col.validity
+
+    def window_count():
+        pre = jnp.concatenate(
+            [jnp.zeros(1, jnp.int64), jnp.cumsum(valid.astype(jnp.int64))])
+        return jnp.where(empty, 0, pre[hi_c + 1] - pre[lo_c])
+
+    if op == "count":
+        return ColV(window_count(), live)
+    if op == "sum":
+        x = jnp.where(valid, col.data, jnp.zeros((), col.data.dtype))
+        pre = jnp.concatenate([jnp.zeros(1, x.dtype), jnp.cumsum(x)])
+        s = jnp.where(
+            empty, jnp.zeros((), x.dtype), pre[hi_c + 1] - pre[lo_c])
+        has = (window_count() > 0) & ~empty
+        return ColV(jnp.where(has, s, jnp.zeros((), s.dtype)), has)
+    raise ValueError(f"unsupported bounded-range window aggregation {op!r}")
 
 
 def running_agg(

@@ -194,6 +194,19 @@ for _cls, _name, _desc in [
 ]:
     _expr_rule(_cls, _name, _desc)
 
+# nondeterministic / metadata family (reference:
+# GpuRandomExpressions.scala:31, GpuMonotonicallyIncreasingID.scala,
+# GpuSparkPartitionID.scala, GpuInputFileBlock.scala, HashFunctions.scala:43)
+for _cls, _name, _desc in [
+    (E.Rand, "Rand", "uniform random in [0,1), deterministic per seed"),
+    (E.MonotonicallyIncreasingID, "MonotonicallyIncreasingID",
+     "unique id: (partition << 33) + row"),
+    (E.SparkPartitionID, "SparkPartitionID", "current partition index"),
+    (E.InputFileName, "InputFileName", "path of the file being scanned"),
+    (E.Murmur3Hash, "Murmur3Hash", "Spark murmur3_32 hash of columns"),
+]:
+    _expr_rule(_cls, _name, _desc)
+
 
 def _check_type(dt: T.DataType, conf: RapidsConf) -> Optional[str]:
     """Allowed-type matrix (reference: isSupportedType GpuOverrides.scala:531)."""
@@ -208,9 +221,15 @@ def _check_type(dt: T.DataType, conf: RapidsConf) -> Optional[str]:
 
 
 def check_expression(
-    expr: E.Expression, schema: StructType, conf: RapidsConf
+    expr: E.Expression, schema: StructType, conf: RapidsConf,
+    allow_context: bool = False,
 ) -> List[str]:
-    """All the reasons this expression can't lower; empty = supported."""
+    """All the reasons this expression can't lower; empty = supported.
+
+    ``allow_context``: True only where the exec evaluates partition-
+    context expressions at its boundary (the project; reference: Spark
+    pins nondeterministic expressions into their own Project) — anywhere
+    else rand()/ids/input_file_name must tag the plan off."""
     reasons: List[str] = []
 
     def visit(node: E.Expression):
@@ -224,9 +243,34 @@ def check_expression(
     visit(expr)
     if reasons:
         return reasons
-    # dtype-level probe: abstractly trace the real lowering
+    # dtype-level probe: abstractly trace the real lowering. Context
+    # expressions (rand / ids / input_file_name, and hash() over strings,
+    # which needs the exec's host-synced byte bound) evaluate at the
+    # project's boundary, not in eval.py — probe them as typed
+    # placeholders there, reject them everywhere else
+    probe_expr = expr
+    if E.has_context_expr(expr) or _has_string_hash(expr, schema):
+        if not allow_context:
+            return [
+                "nondeterministic/metadata expressions (rand, "
+                "monotonically_increasing_id, spark_partition_id, "
+                "input_file_name, hash over strings) only run on TPU "
+                "inside a projection"
+            ]
+
+        def _placeholder(node):
+            if isinstance(node, E.NONDETERMINISTIC_CONTEXT_EXPRS) or (
+                isinstance(node, E.Murmur3Hash)
+                and _has_string_hash(node, schema)
+            ):
+                zero = {T.DOUBLE: 0.0, T.LONG: 0, T.INT: 0,
+                        T.STRING: ""}.get(node.dtype, 0)
+                return E.Literal(zero, node.dtype)
+            return node
+
+        probe_expr = expr.transform(_placeholder)
     if not isinstance(expr, (A.AggregateExpression, A.AggregateFunction)):
-        ok, why = tpu_supports(expr, schema)
+        ok, why = tpu_supports(probe_expr, schema)
         if not ok:
             reasons.append(why or "lowering probe failed")
         else:
@@ -375,11 +419,24 @@ def _convert_file_scan(cpu: "C.CpuFileScanExec", conf, children):
     return TpuFileSourceScanExec(conf, cpu.scanner, cpu.fmt)
 
 
+def _has_string_hash(e: E.Expression, schema: StructType) -> bool:
+    """hash() with a string input (expr may be unbound: bind to type)."""
+    if isinstance(e, E.Murmur3Hash):
+        for c in e.exprs:
+            try:
+                b = E.bind_references(c, schema)
+            except (ValueError, KeyError):
+                return True  # unresolvable: treat as context, tag later
+            if T.is_string(b.dtype):
+                return True
+    return any(_has_string_hash(c, schema) for c in e.children)
+
+
 def _tag_project(meta: "PlanMeta") -> None:
     cpu: C.CpuProjectExec = meta.wrapped  # type: ignore[assignment]
     schema = cpu.children[0].output_schema
     for e in cpu.exprs:
-        for r in check_expression(e, schema, meta.conf):
+        for r in check_expression(e, schema, meta.conf, allow_context=True):
             meta.will_not_work(r)
     _tag_output_types(meta)
 
@@ -755,13 +812,38 @@ def _tag_window(meta: "PlanMeta") -> None:
         except (ValueError, KeyError) as ex:
             meta.will_not_work(str(ex))
     frame = spec.resolved_frame()
+    branged = False
     if not (frame.is_running or frame.is_whole_partition
             or frame.is_bounded_rows):
-        meta.will_not_work(
-            "only UNBOUNDED PRECEDING..CURRENT ROW, whole-partition, or "
-            "literal ROWS window frames run on TPU")
+        if frame.is_bounded_range:
+            # literal RANGE frames need ONE numeric/date/timestamp ORDER
+            # BY key for the value search (GpuWindowExpression.scala:168
+            # imposes the same single-orderable-key shape)
+            branged = True
+            if len(spec.order_by) != 1:
+                meta.will_not_work(
+                    "literal RANGE frames need exactly one ORDER BY key")
+            else:
+                try:
+                    b = E.bind_references(spec.order_by[0], schema)
+                    if not (b.dtype.is_numeric or isinstance(
+                            b.dtype, (T.DateType, T.TimestampType))):
+                        meta.will_not_work(
+                            f"RANGE frame order key type "
+                            f"{b.dtype.simpleString} not supported on TPU")
+                except (ValueError, KeyError) as ex:
+                    meta.will_not_work(str(ex))
+        else:
+            meta.will_not_work(
+                "only UNBOUNDED PRECEDING..CURRENT ROW, whole-partition, "
+                "literal ROWS, or literal RANGE window frames run on TPU")
     for we in cpu.window_exprs:
         f = we.func
+        if branged and isinstance(f, (A.Min, A.Max)):
+            # arbitrary-range min/max needs a log2(cap)-level sparse
+            # table (HBM-heavy); not lowered yet
+            meta.will_not_work(
+                "min/max over a literal RANGE frame not supported on TPU")
         if isinstance(f, (W.RowNumber, W.Rank, W.DenseRank)):
             continue
         if isinstance(f, (W.Lead, W.Lag)):

@@ -119,8 +119,20 @@ class ShuffleServer:
         self.store = _BlockStore()
         pool = BounceBuffers(window_count, window_bytes)
         store = self.store
+        live_conns: List[socket.socket] = []
+        conns_lock = threading.Lock()
+        self._live_conns, self._conns_lock = live_conns, conns_lock
 
         class Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                with conns_lock:
+                    live_conns.append(self.request)
+
+            def finish(self):
+                with conns_lock:
+                    if self.request in live_conns:
+                        live_conns.remove(self.request)
+
             def handle(self):
                 sock = self.request
                 try:
@@ -161,36 +173,83 @@ class ShuffleServer:
             name="srtpu-shuffle-server")
         self._thread.start()
 
-    def close(self) -> None:
+    def close(self, force: bool = False) -> None:
+        """Stop serving. ``force`` also severs in-flight handler
+        connections — the hard-kill the error-path tests need (clients
+        see a reset mid-stream, like a crashed executor)."""
         self._server.shutdown()
         self._server.server_close()
+        if force:
+            with self._conns_lock:
+                conns = list(self._live_conns)
+            for c in conns:
+                try:
+                    c.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+
+class FetchFailedError(ConnectionError):
+    """A reduce-side fetch exhausted its retries (reference analog:
+    Spark's FetchFailedException, which triggers map-stage recompute —
+    here the caller surfaces a clean failure instead of a hang)."""
 
 
 class ShuffleClient:
     """Fetches blocks from a remote ShuffleServer
     (reference: RapidsShuffleClient.scala:35-98 — metadata request then
-    transfer; here the response carries both)."""
+    transfer; here the response carries both). Transient connection
+    errors reconnect and retry the whole request (fetches are idempotent
+    reads); exhaustion raises FetchFailedError."""
 
-    def __init__(self, address: Tuple[str, int]):
+    def __init__(self, address: Tuple[str, int], retries: int = 3,
+                 retry_wait_s: float = 0.2):
         self._addr = tuple(address)
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        self._retries = retries
+        self._retry_wait_s = retry_wait_s
 
     def _conn(self) -> socket.socket:
         if self._sock is None:
             self._sock = socket.create_connection(self._addr, timeout=30)
         return self._sock
 
+    def _drop_conn(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
     def fetch_serialized(self, sid: int, rid: int) -> List[Tuple[int, bytes]]:
+        import time as _time
+
         with self._lock:
-            s = self._conn()
-            s.sendall(_U64x3.pack(OP_FETCH, sid, rid))
-            (n,) = _U64.unpack(_recv_exact(s, 8))
-            out = []
-            for _ in range(n):
-                mid, nbytes = struct.unpack("<QQ", _recv_exact(s, 16))
-                out.append((mid, _recv_exact(s, nbytes)))
-            return out
+            last: Optional[Exception] = None
+            for attempt in range(self._retries):
+                try:
+                    s = self._conn()
+                    s.sendall(_U64x3.pack(OP_FETCH, sid, rid))
+                    (n,) = _U64.unpack(_recv_exact(s, 8))
+                    out = []
+                    for _ in range(n):
+                        mid, nbytes = struct.unpack(
+                            "<QQ", _recv_exact(s, 16))
+                        out.append((mid, _recv_exact(s, nbytes)))
+                    return out
+                except (ConnectionError, OSError, socket.timeout) as e:
+                    last = e
+                    self._drop_conn()
+                    if attempt + 1 < self._retries:
+                        _time.sleep(self._retry_wait_s * (attempt + 1))
+            raise FetchFailedError(
+                f"fetch (shuffle={sid}, reduce={rid}) from {self._addr} "
+                f"failed after {self._retries} attempts: {last}")
 
     def push_serialized(self, sid: int, mid: int, rid: int,
                         data: bytes) -> None:
@@ -207,6 +266,21 @@ class ShuffleClient:
                 self._sock = None
 
 
+_LOCAL_SERVER: Optional["ShuffleServer"] = None
+_LOCAL_SERVER_LOCK = threading.Lock()
+
+
+def local_server(port: int = 0) -> "ShuffleServer":
+    """This process's shuffle block server, started on first use (the
+    executor-lifetime server of RapidsShuffleServer.scala:36). One server
+    serves every exchange in the process; conf picks the port."""
+    global _LOCAL_SERVER
+    with _LOCAL_SERVER_LOCK:
+        if _LOCAL_SERVER is None:
+            _LOCAL_SERVER = ShuffleServer(port=port)
+        return _LOCAL_SERVER
+
+
 class NetworkShuffleTransport(ShuffleTransport):
     """ShuffleTransport over a set of remote block servers.
 
@@ -220,12 +294,16 @@ class NetworkShuffleTransport(ShuffleTransport):
     def __init__(self, server: Optional[ShuffleServer] = None,
                  remotes: Tuple[Tuple[str, int], ...] = (),
                  codec: str = "none",
-                 push_to: Optional[Tuple[str, int]] = None):
+                 push_to: Optional[Tuple[str, int]] = None,
+                 owns_server: bool = True):
         self.server = server
         self.codec = codec
         self._clients = [ShuffleClient(a) for a in remotes]
         self._push = ShuffleClient(push_to) if push_to else None
         self._bytes = 0
+        # conf-built transports share the process-wide server; closing one
+        # exchange must not tear it down for the others
+        self._owns_server = owns_server
 
     def write(self, shuffle_id, map_id, reduce_id, piece, schema):
         from ..exec.base import batch_from_vals
@@ -274,5 +352,5 @@ class NetworkShuffleTransport(ShuffleTransport):
             c.close()
         if self._push is not None:
             self._push.close()
-        if self.server is not None:
+        if self.server is not None and self._owns_server:
             self.server.close()

@@ -192,3 +192,145 @@ def test_query_across_two_processes():
             assert got[kk] == (exp.loc[kk, "s"], exp.loc[kk, "c"])
     finally:
         srv.close()
+
+
+# ---------------------------------------------------------------------------
+# round 5: conf-selected network transport (VERDICT r4 item #4)
+# ---------------------------------------------------------------------------
+def _net_session(extra=None):
+    from spark_rapids_tpu.sql import TpuSession
+
+    conf = {
+        "spark.rapids.tpu.shuffle.mode": "host",  # exchanges, not SPMD
+        "spark.rapids.tpu.shuffle.transport.class": "network",
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1,
+        "spark.rapids.tpu.sql.test.enabled": True,
+    }
+    conf.update(extra or {})
+    return TpuSession(conf)
+
+
+def _mk_df(s, n=600, parts=4):
+    from harness import compare_rows  # noqa: F401
+
+    return s.create_dataframe(
+        {"k": [i % 9 if i % 13 else None for i in range(n)],
+         "v": [None if i % 17 == 0 else i * 3 - n for i in range(n)],
+         "s": [f"s{i % 5}-{'x' * (i % 3)}" for i in range(n)]},
+        T.StructType([
+            T.StructField("k", T.INT), T.StructField("v", T.LONG),
+            T.StructField("s", T.STRING)]),
+        num_partitions=parts)
+
+
+def test_conf_selected_network_aggregate_differential():
+    """spark.rapids.tpu.shuffle.transport.class=network routes the
+    exchange over real sockets; results match the CPU oracle
+    (reference: transport selection by conf, RapidsConf.scala:696)."""
+    from harness import assert_tpu_and_cpu_equal
+    from spark_rapids_tpu.expr import aggregates as A
+    from spark_rapids_tpu.expr.expressions import col
+
+    def build(s):
+        return _mk_df(s).group_by("k").agg(
+            A.agg(A.Sum(col("v")), "sv"), A.agg(A.Count(None), "n"))
+
+    assert_tpu_and_cpu_equal(
+        build,
+        conf={"spark.rapids.tpu.shuffle.mode": "host",
+              "spark.rapids.tpu.shuffle.transport.class": "network"})
+    s = _net_session()
+    _mk_df(s).group_by("k").agg(A.agg(A.Count(None), "n")).collect()
+    plan = s.last_executed_plan.tree_string()
+    assert "TpuShuffleExchangeExec" in plan
+    def find_transport(node):
+        tr = getattr(node, "transport", None)
+        if tr is not None:
+            return tr
+        kids = list(getattr(node, "children", ()))
+        tc = getattr(node, "tpu_child", None)  # ColumnarToRow boundary
+        if tc is not None:
+            kids.append(tc)
+        for c in kids:
+            r = find_transport(c)
+            if r is not None:
+                return r
+        return None
+
+    tr = find_transport(s.last_executed_plan)
+    assert tr is not None and type(tr).__name__ == "NetworkShuffleTransport"
+
+
+def test_conf_selected_network_join_and_aqe_differential():
+    """A join and an AQE-coalesced aggregate both run over the socket
+    transport (the map-stats path has now seen the network)."""
+    from harness import assert_tpu_and_cpu_equal
+    from spark_rapids_tpu.expr import aggregates as A
+    from spark_rapids_tpu.expr.expressions import col
+
+    def build_join(s):
+        left = _mk_df(s, n=300, parts=3)
+        right = s.create_dataframe(
+            {"k2": list(range(9)), "w": [i * 10 for i in range(9)]},
+            T.StructType([T.StructField("k2", T.INT),
+                          T.StructField("w", T.LONG)]), num_partitions=2)
+        return left.join(right, on=[("k", "k2")])
+
+    net = {"spark.rapids.tpu.shuffle.mode": "host",
+           "spark.rapids.tpu.shuffle.transport.class": "network",
+           "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1}
+    assert_tpu_and_cpu_equal(build_join, conf=net)
+
+    def build_agg(s):
+        return _mk_df(s, n=900, parts=6).group_by("s").agg(
+            A.agg(A.Sum(col("v")), "sv"))
+
+    assert_tpu_and_cpu_equal(
+        build_agg, conf={**net, "spark.rapids.tpu.sql.adaptive.enabled": True})
+
+
+def test_fetch_failure_is_clean_and_retries_recover():
+    """Kill the server mid-stream: the client must fail with
+    FetchFailedError after bounded retries, not hang; a live server after
+    transient drops must recover (reference: the mocked error-path state
+    machine tests, RapidsShuffleTestHelper.scala:56-131)."""
+    import threading
+    import time
+
+    from spark_rapids_tpu.shuffle.network import (
+        FetchFailedError,
+        ShuffleClient,
+        ShuffleServer,
+    )
+
+    srv = ShuffleServer(window_bytes=128, window_count=2)
+    payload = os.urandom(50_000)
+    cli = ShuffleClient(srv.address, retries=3, retry_wait_s=0.05)
+    cli.push_serialized(5, 0, 0, payload)
+
+    # hard-kill the server shortly after fetching starts: in-flight
+    # connections are severed AND the port stops accepting
+    killer = threading.Timer(0.01, lambda: srv.close(force=True))
+    killer.start()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(FetchFailedError):
+            for _ in range(2000):  # keep fetching until the kill lands
+                got = cli.fetch_serialized(5, 0)
+                assert got and got[0][1] == payload
+        assert time.monotonic() - t0 < 30  # bounded, no hang
+    finally:
+        killer.cancel()
+        cli.close()
+        srv.close(force=True)
+
+    # transient failure then recovery: new server at a fresh port
+    srv2 = ShuffleServer()
+    cli2 = ShuffleClient(srv2.address, retries=3, retry_wait_s=0.05)
+    cli2.push_serialized(6, 0, 0, payload)
+    # break the socket under the client; the retry path must reconnect
+    cli2._sock.close()
+    got = cli2.fetch_serialized(6, 0)
+    assert got[0][1] == payload
+    cli2.close()
+    srv2.close()

