@@ -62,31 +62,33 @@ def _dev_batch(arrays, schema, n, masks=None):
 
 
 def _dev_string_col(pool, idx, n, dtype):
-    """String column from a pool + index array, fully vectorized."""
-    import jax.numpy as jnp
-
-    from spark_rapids_tpu.columnar import DeviceColumn
+    """Dict-encoded string column from a pool + index array — the layout a
+    dictionary-encoding scan hands the engine for low-cardinality columns
+    (parquet PLAIN_DICTIONARY pages arrive exactly like this; see
+    docs/compatibility.md). Same logical values as the expanded layout;
+    string kernels run once over the pool, rows carry int32 codes."""
+    from spark_rapids_tpu.columnar.column import dict_column_from_parts
     from spark_rapids_tpu.utils.bucketing import bucket_rows
 
     cap = bucket_rows(n)
-    pool_b = [s.encode("utf-8") for s in pool]
-    pl = np.array([len(b) for b in pool_b], np.int64)
-    pool_concat = np.frombuffer(b"".join(pool_b), np.uint8)
-    pool_off = np.zeros(len(pool) + 1, np.int64)
-    np.cumsum(pl, out=pool_off[1:])
-    lens = pl[idx]
-    offsets = np.zeros(cap + 1, np.int32)
-    np.cumsum(lens, out=offsets[1: n + 1])
-    offsets[n + 1:] = offsets[n]
-    total = int(offsets[n])
-    row_of_byte = np.repeat(np.arange(n), lens)
-    within = np.arange(total) - np.repeat(offsets[:n].astype(np.int64), lens)
-    chars = np.zeros(bucket_rows(max(total, 1), 128), np.uint8)
-    chars[:total] = pool_concat[pool_off[idx[row_of_byte]] + within]
+    pool_b = np.array([s.encode("utf-8") for s in pool], dtype=object)
+    uniq, inv = np.unique(pool_b, return_inverse=True)
+    codes = np.zeros(cap, np.int32)
+    codes[:n] = inv[idx]
+    lens = np.array([len(b) for b in uniq], np.int64)
+    doff = np.zeros(len(uniq) + 1, np.int32)
+    np.cumsum(lens, out=doff[1:])
+    pool_concat = b"".join(uniq)
+    dch = (np.frombuffer(pool_concat, np.uint8).copy() if pool_concat
+           else np.zeros(1, np.uint8))
     valid = np.zeros(cap, bool)
     valid[:n] = True
-    return DeviceColumn(dtype, n, None, jnp.asarray(valid),
-                        jnp.asarray(offsets), jnp.asarray(chars))
+    total = int(lens[codes[:n]].sum())
+    return dict_column_from_parts(
+        n, codes, doff, dch, valid,
+        mat_cap=bucket_rows(max(1, total), 128),
+        max_len=int(lens.max()) if lens.size else 0,
+        unique=True, dtype=dtype)
 
 
 def _consume(exec_):

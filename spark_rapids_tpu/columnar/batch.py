@@ -121,10 +121,11 @@ class ColumnarBatch:
         if not any(c.is_string for c in self.columns):
             return self._host_columns_fixed()
 
-        # round trip 1 (tiny): row count + string byte counts
+        # round trip 1 (tiny): row count + string byte counts (dict
+        # columns need none — the dictionary pool is fetched whole)
         head: List[Any] = [self._num_rows]
         for c in self.columns:
-            if c.is_string:
+            if c.is_string and not c.is_dict:
                 head.append(c.offsets[self._num_rows if not isinstance(self._num_rows, int) else min(self._num_rows, c.offsets.shape[0] - 1)])
         hvals = self._parallel_get(head)
         n = int(hvals[0])
@@ -137,7 +138,12 @@ class ColumnarBatch:
         tree: List[Any] = []
         si = 0
         for c in self.columns:
-            if c.is_string:
+            if c.is_dict:
+                d = c.dictv
+                fetch_rows = min(int(d.codes.shape[0]), bucket_rows(n, 1))
+                tree.append((d.codes[:fetch_rows], c.validity[:fetch_rows],
+                             d.dictionary.offsets, d.dictionary.chars))
+            elif c.is_string:
                 fetch_rows = min(int(c.offsets.shape[0]) - 1, bucket_rows(n, 1))
                 nb = min(int(c.chars.shape[0]), bucket_rows(max(1, str_bytes[si]), 1))
                 si += 1
@@ -158,7 +164,17 @@ class ColumnarBatch:
         from ..types import BinaryType
 
         for c, parts in zip(self.columns, fetched):
-            if c.is_string:
+            if c.is_dict:
+                from .column import decode_dict_rows
+
+                codes, validity, doff, dch = parts
+                validity = np.asarray(validity)[:n]
+                data = decode_dict_rows(
+                    np.asarray(dch), np.asarray(doff),
+                    np.asarray(codes)[:n], validity,
+                    binary=isinstance(c.dtype, BinaryType))
+                out.append(HostColumn(c.dtype, data, validity))
+            elif c.is_string:
                 offsets, chars, validity = parts
                 offsets = np.asarray(offsets)
                 validity = np.asarray(validity)[:n]

@@ -114,9 +114,20 @@ class HostColumn:
 
 
 class DeviceColumn:
-    """A TPU-resident column (reference: GpuColumnVector.java facade role)."""
+    """A TPU-resident column (reference: GpuColumnVector.java facade role).
 
-    __slots__ = ("dtype", "length", "data", "validity", "offsets", "chars")
+    Three physical layouts share the facade:
+
+      * fixed-width: ``data`` + ``validity``
+      * string:      ``offsets`` + ``chars`` + ``validity`` (Arrow)
+      * dict-encoded string: ``dictv`` (a :class:`~..expr.values.DictV`:
+        int32 codes + a small dictionary StrV; reference analog: cudf's
+        dictionary32 column). ``validity`` aliases ``dictv.validity``.
+        :meth:`materialize` is the escape hatch back to the plain layout.
+    """
+
+    __slots__ = ("dtype", "length", "data", "validity", "offsets", "chars",
+                 "dictv")
 
     def __init__(
         self,
@@ -126,6 +137,7 @@ class DeviceColumn:
         validity: jax.Array,
         offsets: Optional[jax.Array] = None,
         chars: Optional[jax.Array] = None,
+        dictv=None,
     ):
         self.dtype = dtype
         self.length = length  # logical rows; python int at batch boundaries
@@ -133,17 +145,34 @@ class DeviceColumn:
         self.validity = validity
         self.offsets = offsets
         self.chars = chars
+        self.dictv = dictv
 
     # -- construction -----------------------------------------------------
     @property
     def capacity(self) -> int:
-        if self.is_string:
+        if self.is_string and not self.is_dict:
             return int(self.offsets.shape[0]) - 1
         return int(self.validity.shape[0])
 
     @property
     def is_string(self) -> bool:
         return isinstance(self.dtype, (StringType, BinaryType))
+
+    @property
+    def is_dict(self) -> bool:
+        return self.dictv is not None
+
+    @staticmethod
+    def dict_encoded(dtype: DataType, length, dictv) -> "DeviceColumn":
+        return DeviceColumn(dtype, length, None, dictv.validity, dictv=dictv)
+
+    def materialize(self) -> "DeviceColumn":
+        """Dict-encoded -> plain string column (one jitted gather)."""
+        if not self.is_dict:
+            return self
+        s = _jitted_materialize()(self.dictv)
+        return DeviceColumn(
+            self.dtype, self.length, None, s.validity, s.offsets, s.chars)
 
     @staticmethod
     def from_host(host: HostColumn, capacity: Optional[int] = None) -> "DeviceColumn":
@@ -186,6 +215,15 @@ class DeviceColumn:
     def to_host(self) -> HostColumn:
         n = int(self.length)
         validity = np.asarray(jax.device_get(self.validity))[:n]
+        if self.is_dict:
+            d = self.dictv
+            codes = np.asarray(jax.device_get(d.codes))[:n]
+            doff = np.asarray(jax.device_get(d.dictionary.offsets))
+            dch = np.asarray(jax.device_get(d.dictionary.chars))
+            data = decode_dict_rows(
+                dch, doff, codes, validity,
+                binary=isinstance(self.dtype, BinaryType))
+            return HostColumn(self.dtype, data, validity)
         if self.is_string:
             from .batch import decode_string_rows
 
@@ -208,7 +246,12 @@ class DeviceColumn:
 
     def device_memory_size(self) -> int:
         total = self.validity.size * self.validity.dtype.itemsize
-        if self.is_string:
+        if self.is_dict:
+            d = self.dictv
+            total += (d.codes.size * d.codes.dtype.itemsize
+                      + d.dictionary.offsets.size * 4
+                      + d.dictionary.chars.size + d.dict_size)
+        elif self.is_string:
             total += self.offsets.size * 4 + self.chars.size
         elif self.data is not None:
             total += self.data.size * self.data.dtype.itemsize
@@ -219,6 +262,107 @@ class DeviceColumn:
             f"DeviceColumn({self.dtype}, rows={self.length}, "
             f"cap={self.capacity})"
         )
+
+
+#: test hook (monkeypatch): when True, dict-encoded columns materialize to
+#: the plain string layout before entering any traced program, forcing the
+#: non-dict lowering path everywhere (the conf/monkeypatch toggle the dict
+#: fallback tests flip to diff the two paths)
+DICT_MATERIALIZE_EAGERLY = False
+
+_MATERIALIZE_JIT = None
+
+
+def _jitted_materialize():
+    global _MATERIALIZE_JIT
+    if _MATERIALIZE_JIT is None:
+        from ..expr.values import materialize_dict
+
+        _MATERIALIZE_JIT = jax.jit(materialize_dict)
+    return _MATERIALIZE_JIT
+
+
+def decode_dict_rows(dict_chars, dict_offsets, codes, validity,
+                     binary: bool = False):
+    """Host decode of a dict-encoded column: decode each dictionary entry
+    ONCE, then index — O(cardinality) python instead of O(rows)."""
+    D = len(dict_offsets) - 1
+    raw = dict_chars[: int(dict_offsets[D])].tobytes()
+    if binary:
+        entries = np.empty(D, dtype=object)
+        entries[:] = [raw[dict_offsets[k]: dict_offsets[k + 1]]
+                      for k in range(D)]
+    else:
+        entries = np.empty(D, dtype=object)
+        entries[:] = [
+            raw[dict_offsets[k]: dict_offsets[k + 1]].decode("utf-8")
+            for k in range(D)
+        ]
+    out = entries[np.clip(codes, 0, max(D - 1, 0))]
+    out[~validity] = None
+    return out
+
+
+def dict_column_from_parts(
+    length,
+    codes,
+    dict_offsets,
+    dict_chars,
+    validity,
+    mat_cap: int,
+    max_len: int,
+    unique: bool = False,
+    dtype: DataType = STRING,
+) -> DeviceColumn:
+    """Build a dict-encoded string column from device (or numpy) parts."""
+    import jax.numpy as jnp
+
+    from ..expr.values import DictV, StrV
+
+    D = int(dict_offsets.shape[0]) - 1
+    dictionary = StrV(
+        jnp.asarray(dict_offsets), jnp.asarray(dict_chars),
+        jnp.ones(max(D, 0), jnp.bool_))
+    dv = DictV(jnp.asarray(codes), dictionary, jnp.asarray(validity),
+               mat_cap, max_len, unique)
+    return DeviceColumn.dict_encoded(dtype, length, dv)
+
+
+def dict_column_from_pylist(
+    values: Sequence[Any], dtype: DataType = STRING,
+    capacity: Optional[int] = None,
+) -> DeviceColumn:
+    """Dictionary-encode a python string list into a dict-encoded column
+    (distinct values -> dictionary, rows -> int32 codes). Test/ingest
+    seam; the parquet device decoder builds the same layout from the
+    file's own dictionary pages."""
+    n = len(values)
+    cap = capacity or bucket_rows(n)
+    is_bin = isinstance(dtype, BinaryType)
+    encoded = [
+        (v if is_bin else str(v).encode("utf-8")) if v is not None else None
+        for v in values
+    ]
+    distinct = sorted({b for b in encoded if b is not None}) or [b""]
+    index = {b: k for k, b in enumerate(distinct)}
+    codes = np.zeros(cap, np.int32)
+    validity = np.zeros(cap, bool)
+    total_bytes = 0
+    for i, b in enumerate(encoded):
+        if b is not None:
+            codes[i] = index[b]
+            validity[i] = True
+            total_bytes += len(b)
+    doff = np.zeros(len(distinct) + 1, np.int32)
+    np.cumsum([len(b) for b in distinct], out=doff[1:])
+    pool = b"".join(distinct)
+    dch = (np.frombuffer(pool, np.uint8).copy() if pool
+           else np.zeros(1, np.uint8))
+    return dict_column_from_parts(
+        n, codes, doff, dch, validity,
+        mat_cap=bucket_rows(max(1, total_bytes), 128),
+        max_len=max((len(b) for b in distinct), default=0),
+        unique=True, dtype=dtype)
 
 
 def column_from_pylist(values: Sequence[Any], dtype: DataType) -> DeviceColumn:

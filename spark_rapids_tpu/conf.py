@@ -262,6 +262,25 @@ PARQUET_DEVICE_DECODE = conf(
     "the TPU analog of cudf's GPU decoder (GpuParquetScan.scala:1157 "
     "Table.readParquet). Columns with unsupported encodings fall back to "
     "the host arrow decoder per-column.")
+STAGE_FUSION = conf(
+    "spark.rapids.tpu.sql.stageFusion", "AUTO",
+    "Fuse parquet scan->aggregate stages into ONE XLA program. ON always "
+    "fuses, OFF never does, AUTO fuses except on the host/CPU backend: "
+    "the fusion exists to amortize the tunneled-TPU dispatch round trip, "
+    "but it re-decodes the pages inside the program on EVERY execution. "
+    "Where dispatch is free (CPU backend) the separate decode program + "
+    "HBM scan cache decode once and reuse, so AUTO prefers that.",
+    valid_values=("AUTO", "ON", "OFF"))
+PARQUET_DICT_STRINGS = conf(
+    "spark.rapids.tpu.sql.format.parquet.dictStrings.enabled", True,
+    "Keep dictionary-encoded BYTE_ARRAY columns ENCODED on the TPU "
+    "(int32 codes + the file's own dictionary page as a small string "
+    "pool) instead of expanding to full offsets+chars at decode — late "
+    "materialization, the TPU analog of cudf handing dictionary32 "
+    "columns to the plugin. String kernels then run once over the "
+    "dictionary (O(cardinality)) and per-row work collapses to integer "
+    "gathers; operators without a dictionary path materialize on entry, "
+    "so results are identical either way (see docs/compatibility.md).")
 SCAN_DEVICE_CACHE = conf(
     "spark.rapids.tpu.scan.deviceCache.enabled", True,
     "Keep decoded scan columns resident in HBM keyed by "

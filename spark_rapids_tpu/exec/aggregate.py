@@ -23,7 +23,7 @@ from ..columnar import ColumnarBatch
 from ..conf import RapidsConf
 from ..expr import aggregates as A
 from ..expr import expressions as E
-from ..expr.eval import ColV, StrV, Val, lower
+from ..expr.eval import ColV, DictV, StrV, Val, lower, materialize_dict
 from ..ops import concat as concat_ops
 from ..ops import groupby as groupby_ops
 from ..ops.sort import max_string_len
@@ -242,11 +242,18 @@ class TpuHashAggregateExec(TpuExec):
             if isinstance(b.dtype, (T.StringType, T.BinaryType)):
                 if direct and isinstance(b, E.BoundReference):
                     col = batch.columns[b.ordinal]
-                    m = int(max_string_len(StrV(col.offsets, col.chars, col.validity)))
+                    if col.is_dict:
+                        # dict columns carry a STATIC length bound — the
+                        # one case string keys need no host sync at all
+                        m = col.dictv.max_len
+                    else:
+                        m = int(max_string_len(StrV(col.offsets, col.chars, col.validity)))
                 else:
                     if source_max is None:
                         ms = [
-                            int(max_string_len(StrV(c.offsets, c.chars, c.validity)))
+                            (c.dictv.max_len if c.is_dict else
+                             int(max_string_len(
+                                 StrV(c.offsets, c.chars, c.validity))))
                             for c in batch.columns if c.is_string
                         ]
                         source_max = max(ms) if ms else 64
@@ -314,12 +321,26 @@ class TpuHashAggregateExec(TpuExec):
 
     def _merge(self, partials: List[ColumnarBatch]) -> ColumnarBatch:
         """Concat partial batches and re-aggregate with merge ops
-        (reference: concatenateBatches + merge pass, aggregate.scala:451-476)."""
+        (reference: concatenateBatches + merge pass, aggregate.scala:451-476).
+        A single partial passes through untouched (dict-encoded group keys
+        stay encoded); multi-partial merges materialize dict keys — the
+        concat kernels splice byte pools."""
+        if len(partials) > 1:
+            from .base import materialized_batch
+
+            partials = [materialized_batch(b) for b in partials]
         str_cols = [
             j for j, f in enumerate(self._buffer_schema.fields)
             if isinstance(f.dataType, (T.StringType, T.BinaryType))
         ]
+        import jax as _jx
+
+        # the sync-free merge stacks partials at CAPACITY to spare a host
+        # RTT per batch — the right trade only over a high-latency device
+        # link. On the CPU backend the pull is free and the synced path
+        # merges at the REAL row counts (~group-count rows, not millions)
         if (len(partials) > 1 and not str_cols
+                and _jx.default_backend() != "cpu"
                 and sum(max(1, b.capacity) for b in partials)
                 <= self._SYNC_FREE_MERGE_MAX_ROWS):
             return self._merge_fixed_width(partials)
@@ -415,6 +436,20 @@ class TpuHashAggregateExec(TpuExec):
             for f in self._buffer_schema.fields
         )
 
+    def _stage_fusion_on(self) -> bool:
+        """Conf-gated, backend-adaptive (see sql.stageFusion): fusion buys
+        fewer dispatches at the price of re-decoding pages every execution;
+        on the CPU backend dispatch is free and the scan cache makes the
+        separate decode a one-time cost, so AUTO skips fusion there."""
+        from ..conf import STAGE_FUSION
+
+        mode = self.conf.get(STAGE_FUSION)
+        if mode != "AUTO":
+            return mode == "ON"
+        import jax
+
+        return jax.default_backend() != "cpu"
+
     def _run_fused_stage(self, stage, chain) -> ColumnarBatch:
         """ONE jitted program for the whole stage: per-row-group parquet
         decode → fused child chain → update groupby → padded concat →
@@ -477,9 +512,12 @@ class TpuHashAggregateExec(TpuExec):
                     cols: List[Val] = []
                     for a, r in zip(rg_args, rg_runs):
                         out = r(a)
-                        cols.append(
-                            ColV(out[0], out[1]) if len(out) == 2
-                            else StrV(out[0], out[1], out[2]))
+                        if isinstance(out, DictV):
+                            cols.append(out)  # dict-retained string decode
+                        else:
+                            cols.append(
+                                ColV(out[0], out[1]) if len(out) == 2
+                                else StrV(out[0], out[1], out[2]))
                     live = live_of(n, cap)
                     for e, s in zip(chain_t, side_args):
                         cols, live = e.lower_batch(cols, live, cap, s)
@@ -490,7 +528,13 @@ class TpuHashAggregateExec(TpuExec):
                 if len(partial_sets) == 1:
                     merged_vals, nseg = partial_sets[0]
                 else:
-                    col_parts = [p[0] for p in partial_sets]
+                    # row groups may carry DIFFERENT dictionaries: dict
+                    # group keys expand before the cross-group concat
+                    col_parts = [
+                        [materialize_dict(c) if isinstance(c, DictV) else c
+                         for c in p[0]]
+                        for p in partial_sets
+                    ]
                     counts = [p[1] for p in partial_sets]
                     caps = [p[0][0].validity.shape[0] for p in partial_sets]
                     out_cap = bucket_rows(sum(caps), bucket_min)
@@ -525,7 +569,7 @@ class TpuHashAggregateExec(TpuExec):
         else:
             source, chain = child, ()
         fsp = getattr(source, "fused_stage_plans", None)
-        if fsp is not None and self._can_fuse_stage():
+        if fsp is not None and self._can_fuse_stage() and self._stage_fusion_on():
             stage = fsp(index)
             if stage:
                 with timed(self.metrics[TOTAL_TIME]):

@@ -17,7 +17,7 @@ import jax
 
 from ..columnar import ColumnarBatch, DeviceColumn
 from ..conf import RapidsConf
-from ..expr.eval import ColV, StrV, Val
+from ..expr.eval import ColV, DictV, StrV, Val
 from ..types import StructType
 
 # Standard metric names (reference: GpuMetricNames in GpuExec.scala:27-60)
@@ -223,13 +223,32 @@ class TpuExec:
 # ColumnarBatch <-> traced value plumbing
 # ---------------------------------------------------------------------------
 def vals_of_batch(batch: ColumnarBatch) -> List[Val]:
+    from ..columnar import column as _colmod
+
     out: List[Val] = []
     for c in batch.columns:
-        if c.is_string:
+        if c.is_dict:
+            if _colmod.DICT_MATERIALIZE_EAGERLY:
+                c = c.materialize()
+                out.append(StrV(c.offsets, c.chars, c.validity))
+            else:
+                out.append(c.dictv)
+        elif c.is_string:
             out.append(StrV(c.offsets, c.chars, c.validity))
         else:
             out.append(ColV(c.data, c.validity))
     return out
+
+
+def materialized_batch(batch: ColumnarBatch) -> ColumnarBatch:
+    """Batch with every dict-encoded column expanded to the plain string
+    layout — the boundary call for execs without a dict path (sort keys,
+    joins, window partitioning, exchange serialization)."""
+    if not any(c.is_dict for c in batch.columns):
+        return batch
+    return ColumnarBatch(
+        [c.materialize() for c in batch.columns], batch.schema,
+        batch.num_rows_lazy)
 
 
 def batch_from_vals(
@@ -237,7 +256,9 @@ def batch_from_vals(
 ) -> ColumnarBatch:
     cols = []
     for f, v in zip(schema.fields, vals):
-        if isinstance(v, StrV):
+        if isinstance(v, DictV):
+            cols.append(DeviceColumn.dict_encoded(f.dataType, num_rows, v))
+        elif isinstance(v, StrV):
             cols.append(
                 DeviceColumn(f.dataType, num_rows, None, v.validity, v.offsets, v.chars)
             )
@@ -312,9 +333,19 @@ def run_fused_chain(exec_self: TpuExec, index: int) -> Iterator[ColumnarBatch]:
 
 def batch_signature(batch: ColumnarBatch) -> tuple:
     """Structural cache key for compiled per-exec pipelines: dtype + shapes."""
+    from ..columnar import column as _colmod
+
     sig = []
     for f, c in zip(batch.schema.fields, batch.columns):
-        if c.is_string:
+        if c.is_dict and not _colmod.DICT_MATERIALIZE_EAGERLY:
+            d = c.dictv
+            sig.append((f.dataType, "dict", int(d.codes.shape[0]),
+                        d.dict_size, int(d.dictionary.chars.shape[0]),
+                        d.mat_cap, d.max_len, d.unique))
+        elif c.is_dict:  # eager-materialize hook: sign as the plain layout
+            d = c.dictv
+            sig.append((f.dataType, int(d.codes.shape[0]) + 1, d.mat_cap))
+        elif c.is_string:
             sig.append((f.dataType, int(c.offsets.shape[0]), int(c.chars.shape[0])))
         else:
             sig.append((f.dataType, int(c.data.shape[0])))

@@ -490,6 +490,12 @@ class TpuCoalesceBatchesExec(TpuExec):
             return None
         if len(pending) == 1:
             return pending[0]
+        from .base import materialized_batch
+
+        # dict-encoded columns materialize at the concat boundary: batches
+        # may carry DIFFERENT dictionaries (and plain/dict mixes), so the
+        # stitched column uses the universal layout
+        pending = [materialized_batch(b) for b in pending]
         lengths = [b.num_rows for b in pending]
         total = sum(lengths)
         out_cap = bucket_rows(total, self.conf.shape_bucket_min)

@@ -68,8 +68,18 @@ def make_transport(conf: RapidsConf) -> ShuffleTransport:
         for p in conf.get(SHUFFLE_NETWORK_PEERS).split(","):
             p = p.strip()
             if p:
-                host, _, port = p.rpartition(":")
-                remotes.append((host, int(port)))
+                host, sep, port = p.rpartition(":")
+                if not sep or not host or not port:
+                    raise ValueError(
+                        "spark.rapids.tpu.shuffle.network.peers: invalid "
+                        f"peer entry {p!r} (expected host:port)")
+                try:
+                    port_n = int(port)
+                except ValueError:
+                    raise ValueError(
+                        "spark.rapids.tpu.shuffle.network.peers: invalid "
+                        f"port in peer entry {p!r} (expected host:port)")
+                remotes.append((host, port_n))
         return NetworkShuffleTransport(
             server=local_server(conf.get(SHUFFLE_NETWORK_LISTEN_PORT)),
             remotes=tuple(remotes),
@@ -331,6 +341,12 @@ class TpuShuffleExchangeExec(TpuExec):
                 for map_id, batch in batch_iter:
                     if not batch.columns:
                         continue
+                    # dict-encoded columns materialize at the shuffle
+                    # boundary: pieces serialize/slice the plain Arrow
+                    # layout and peers don't share dictionaries
+                    from .base import materialized_batch
+
+                    batch = materialized_batch(batch)
                     cap = batch.capacity
                     fn = self._map_fn(
                         batch_signature(batch), cap, schema,

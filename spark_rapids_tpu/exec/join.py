@@ -88,6 +88,11 @@ def _concat_batches(
 ) -> Optional[ColumnarBatch]:
     if not batches:
         return None
+    # sort/window/join kernels want the plain Arrow string layout (byte
+    # chunk keys, row-repeating gathers): dict columns materialize here
+    from .base import materialized_batch
+
+    batches = [materialized_batch(b) for b in batches]
     if len(batches) == 1:
         return batches[0]
     lengths = [b.num_rows for b in batches]
@@ -405,9 +410,14 @@ class TpuShuffledHashJoinExec(TpuExec):
         return out
 
     def lower_batch(self, cols, live, cap, side=()):
+        from ..expr.values import DictV as _DictV, as_plain_str
+
         packed_tbl, kmin = side[0], side[1]
         tbl = packed_tbl.shape[0]
         keys = [lower(k, cols, cap) for k in self._probe_keys]
+        # dict-encoded probe keys expand to bytes for the radix words;
+        # non-key dict columns stream through encoded (mask-only path)
+        keys = [as_plain_str(v) if isinstance(v, _DictV) else v for v in keys]
         words, any_null = join_ops.radix_key_words(
             keys, [k.dtype for k in self._probe_keys], ())
         key64 = join_ops._pack_u64(words)
@@ -460,6 +470,11 @@ class TpuShuffledHashJoinExec(TpuExec):
         )
         for pi in probe_parts:
             for pbatch in self._probe.execute_partition(pi):
+                from .base import materialized_batch
+
+                # join expansion repeats rows: dict columns materialize
+                # up front (their byte bound only covers row subsets)
+                pbatch = materialized_batch(pbatch)
                 out = self._probe_batch(
                     pbatch, build_cols, build_words, build_count, build_cap)
                 if out is None:
@@ -663,6 +678,9 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
         nb = build.num_rows
         build_vals = vals_of_batch(build)
         for pbatch in self.children[0].execute_partition(index):
+            from .base import materialized_batch
+
+            pbatch = materialized_batch(pbatch)
             np_ = pbatch.num_rows
             if np_ == 0 or nb == 0:
                 continue

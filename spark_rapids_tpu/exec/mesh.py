@@ -104,10 +104,14 @@ class _MeshStage(TpuExec):
         fields = schema.fields
         ncols = len(fields)
         is_str = [T.is_string(f.dataType) for f in fields]
-        # gather host views once
+        # gather host views once (dict-encoded strings materialize: the
+        # mesh planes splice raw offset/chars byte pools across shards)
+        from .base import materialized_batch
+
         host: List[List[tuple]] = [[] for _ in range(self.n_shards)]
         for s, bs in enumerate(per_shard):
             for b in bs:
+                b = materialized_batch(b)
                 n = int(b.num_rows)
                 row = []
                 for c in b.columns:

@@ -100,6 +100,9 @@ class TpuSortExec(TpuExec):
         batch = self._gather_input(index)
         if batch is None:
             return
+        from .base import materialized_batch
+
+        batch = materialized_batch(batch)  # chunk keys want plain bytes
         cap = batch.capacity if batch.columns else 128
         sml = self._str_lens(batch)
 

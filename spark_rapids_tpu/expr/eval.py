@@ -29,7 +29,16 @@ from .. import types as T
 from ..columnar import ColumnarBatch, DeviceColumn
 from ..types import DataType
 from . import expressions as E
-from .values import ColV, StrV, Val, UnsupportedExpressionError  # noqa: F401
+from .values import (  # noqa: F401
+    ColV,
+    DictV,
+    StrV,
+    Val,
+    UnsupportedExpressionError,
+    as_plain_str,
+    dict_gather_col,
+    materialize_dict,
+)
 
 
 _INT_INFO = {
@@ -405,13 +414,24 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
     # ----- comparisons ----------------------------------------------------
     if isinstance(expr, E._BinaryComparison):
         l, r = ev(expr.left), ev(expr.right)
-        if isinstance(l, StrV) or isinstance(r, StrV):
-            if not (isinstance(l, StrV) and isinstance(r, StrV)):
+        if isinstance(l, (StrV, DictV)) or isinstance(r, (StrV, DictV)):
+            if not (isinstance(l, (StrV, DictV))
+                    and isinstance(r, (StrV, DictV))):
                 raise UnsupportedExpressionError(
                     "comparison between string and non-string")
-            from .eval_strings import compare_strings
+            from .eval_strings import compare_strings, dict_compare_literal
 
-            return compare_strings(expr, l, r, cap)
+            # dict vs literal: one compare over the dictionary, then an
+            # int32 gather — O(cardinality) instead of O(total chars)
+            if isinstance(l, DictV) and isinstance(expr.right, E.Literal) \
+                    and not isinstance(r, DictV):
+                return dict_compare_literal(expr, l, expr.right.value, cap)
+            if isinstance(r, DictV) and isinstance(expr.left, E.Literal) \
+                    and not isinstance(l, DictV):
+                return dict_compare_literal(
+                    expr, r, expr.left.value, cap, flipped=True)
+            return compare_strings(
+                expr, as_plain_str(l), as_plain_str(r), cap)
         tgt = (
             T.promote(expr.left.dtype, expr.right.dtype)
             if expr.left.dtype != expr.right.dtype
@@ -448,6 +468,11 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
 
     if isinstance(expr, E.In):
         c = ev(expr.child)
+        if isinstance(c, DictV):
+            from .eval_strings import string_in
+
+            return dict_gather_col(
+                c, string_in(c.dictionary, expr.values, c.dict_size))
         if isinstance(c, StrV):
             from .eval_strings import string_in
 
@@ -610,6 +635,16 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
     if isinstance(expr, E.Cast):
         frm, to = expr.child.dtype, expr.to
         c = ev(expr.child)
+        if isinstance(c, DictV):
+            if isinstance(to, (T.StringType, T.BinaryType)):
+                return c
+            from .eval_strings import lower_string_cast
+
+            # cast the dictionary once, gather the per-row result
+            out = lower_string_cast(c.dictionary, to, c.dict_size)
+            if isinstance(out, StrV):  # unreachable today; stay safe
+                return lower_string_cast(materialize_dict(c), to, cap)
+            return dict_gather_col(c, out)
         if isinstance(c, StrV):
             from .eval_strings import lower_string_cast
 
@@ -757,6 +792,17 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
     # ----- strings (minimal) ----------------------------------------------
     if isinstance(expr, E.Length):
         c = ev(expr.child)
+        if isinstance(c, DictV):
+            # char-count the dictionary entries, gather through the codes
+            d = c.dictionary
+            cont_d = ((d.chars & 0xC0) == 0x80).astype(jnp.int32)
+            cs_d = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32), jnp.cumsum(cont_d)])
+            bl = d.offsets[1:] - d.offsets[:-1]
+            cl = cs_d[d.offsets[1:]] - cs_d[d.offsets[:-1]]
+            return dict_gather_col(
+                c, ColV((bl - cl).astype(jnp.int32),
+                        jnp.ones(c.dict_size, jnp.bool_)))
         if not isinstance(c, StrV):
             raise UnsupportedExpressionError("length() on non-string")
         cont = ((c.chars & 0xC0) == 0x80).astype(jnp.int32)
@@ -784,6 +830,13 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
 # Compile cache + public entry points
 # ---------------------------------------------------------------------------
 def _col_to_vals(col: DeviceColumn) -> Val:
+    if col.is_dict:
+        from ..columnar import column as _colmod
+
+        if _colmod.DICT_MATERIALIZE_EAGERLY:
+            col = col.materialize()
+        else:
+            return col.dictv
     if col.is_string:
         return StrV(col.offsets, col.chars, col.validity)
     return ColV(col.data, col.validity)
@@ -855,15 +908,16 @@ def evaluate_projection(
     doing per-expression columnarEval; here it is a single executable.
     """
     cap = batch.columns[0].capacity if batch.columns else 128
-    schema_sig = tuple(
-        (f.dataType, c.capacity, None if not c.is_string else int(c.chars.shape[0]))
-        for f, c in zip(batch.schema.fields, batch.columns)
-    )
+    from ..exec.base import batch_signature
+
+    schema_sig = batch_signature(batch)
     fn = _compiled(tuple(bound_exprs), cap, schema_sig)
     vals = fn([_col_to_vals(c) for c in batch.columns])
     out = []
     for e, v in zip(bound_exprs, vals):
-        if isinstance(v, StrV):
+        if isinstance(v, DictV):
+            out.append(DeviceColumn.dict_encoded(e.dtype, batch.num_rows, v))
+        elif isinstance(v, StrV):
             out.append(
                 DeviceColumn(e.dtype, batch.num_rows, None, v.validity, v.offsets, v.chars)
             )

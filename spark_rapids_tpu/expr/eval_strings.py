@@ -24,7 +24,15 @@ from .. import types as T
 from ..ops import strings as S
 from ..utils.bucketing import bucket_rows
 from . import expressions as E
-from .values import ColV, StrV, UnsupportedExpressionError
+from .values import (
+    ColV,
+    DictV,
+    StrV,
+    UnsupportedExpressionError,
+    dict_gather_col,
+    dict_rewrap,
+    materialize_dict,
+)
 
 _BIG = S.BIG
 
@@ -35,7 +43,10 @@ def _char_cap(v: StrV) -> int:
 
 def as_strv(v, cap: int) -> StrV:
     """Coerce a NULL-typed ColV (null literal) into an all-null empty StrV
-    so string Coalesce/If/CaseWhen can mix real strings with NULL."""
+    so string Coalesce/If/CaseWhen can mix real strings with NULL; dict
+    values materialize (per-row selection needs the plain layout)."""
+    if isinstance(v, DictV):
+        return materialize_dict(v)
     if isinstance(v, StrV):
         return v
     return StrV(
@@ -43,6 +54,58 @@ def as_strv(v, cap: int) -> StrV:
         jnp.zeros(1, jnp.uint8),
         jnp.zeros(cap, jnp.bool_),
     )
+
+
+def _on_dict(c, cap: int, fn, growth: int = 1):
+    """Late-materialization pivot: when ``c`` is dict-encoded, run the
+    string kernel ``fn(strv, cap)`` ONCE over the small dictionary
+    (O(cardinality) work) and splice the result back through the codes —
+    a :class:`DictV` for string results, an int32 gather for column
+    results. Plain inputs run the kernel per-row as before.
+
+    ``growth``: the kernel's worst-case output-bytes growth factor,
+    scaling the static materialization capacity the result carries."""
+    if not isinstance(c, DictV):
+        return fn(c, cap)
+    out = fn(c.dictionary, c.dict_size)
+    if isinstance(out, StrV):
+        return dict_rewrap(c, out, growth)
+    return dict_gather_col(c, out)
+
+
+def dict_compare_literal(expr, c: DictV, value, cap: int,
+                         flipped: bool = False) -> ColV:
+    """Binary comparison of a dict column against a string literal:
+    compare the dictionary's dict_size entries, gather verdicts by code.
+    ``flipped``: the literal was the LEFT operand (order matters for <, >).
+    """
+    k = c.dict_size
+    lit_null = value is None
+    raw = b"" if lit_null else (
+        value if isinstance(value, bytes) else str(value).encode("utf-8"))
+    nb = np.frombuffer(raw, dtype=np.uint8)
+    lchars = (jnp.tile(jnp.asarray(nb), k) if len(nb)
+              else jnp.zeros(1, jnp.uint8))
+    loffs = (jnp.arange(k + 1, dtype=jnp.int32)) * len(nb)
+    lit = StrV(loffs, lchars, jnp.ones(k, jnp.bool_))
+    d = c.dictionary
+    a, b = (lit, d) if flipped else (d, lit)
+    lt, eq = S.compare(a, b)
+    gt = ~(lt | eq)
+    res_d = {
+        E.EqualTo: eq, E.EqualNullSafe: eq,
+        E.LessThan: lt, E.LessThanOrEqual: lt | eq,
+        E.GreaterThan: gt, E.GreaterThanOrEqual: gt | eq,
+    }[type(expr)]
+    from .values import clipped_codes
+
+    res = jnp.take(res_d, clipped_codes(c), mode="clip")
+    if isinstance(expr, E.EqualNullSafe):
+        if lit_null:
+            return ColV(~c.validity, jnp.ones(cap, jnp.bool_))
+        return ColV(c.validity & res, jnp.ones(cap, jnp.bool_))
+    valid = c.validity & (not lit_null)
+    return ColV(jnp.where(valid, res, False), valid)
 
 
 def lit_str(e: E.Expression, what: str) -> Optional[str]:
@@ -881,38 +944,84 @@ def cast_bool_to_string(c: ColV, cap: int) -> StrV:
 # ---------------------------------------------------------------------------
 # dispatcher
 # ---------------------------------------------------------------------------
+def _replace_growth(expr) -> int:
+    """Worst-case output-bytes growth factor of a (regexp_)replace with
+    literal operands (1 when the handler will null out / raise anyway)."""
+    try:
+        if isinstance(expr, E.RegExpReplace):
+            from ..ops import regex as RX
+
+            pat = lit_str(expr.pattern, "p")
+            search = RX.regex_as_literal(pat) if pat is not None else None
+        else:
+            search = lit_str(expr.search, "s")
+        repl = lit_str(expr.replacement, "r")
+    except UnsupportedExpressionError:
+        return 1
+    if not search or repl is None:
+        return 1
+    ms = len(search.encode("utf-8"))
+    mr = len(repl.encode("utf-8"))
+    return max(1, -(-mr // ms))
+
+
 def lower_strings(expr: E.Expression, ev: Callable, cap: int):
-    """Lower a string-family expression; None if ``expr`` isn't one."""
+    """Lower a string-family expression; None if ``expr`` isn't one.
+
+    Dict-encoded inputs route through :func:`_on_dict`: the kernel runs
+    once over the dictionary and per-row work collapses to int32 gathers.
+    Ops without a safe dictionary-level form (pads, per-row multi-input
+    selection/concat) materialize first — the universal fallback."""
     if isinstance(expr, (E.Upper, E.Lower)):
-        return _upper_lower(expr, ev(expr.child), isinstance(expr, E.Upper))
+        up = isinstance(expr, E.Upper)
+        return _on_dict(ev(expr.child), cap,
+                        lambda c, k: _upper_lower(expr, c, up))
     if isinstance(expr, E.InitCap):
-        return _initcap(ev(expr.child))
+        return _on_dict(ev(expr.child), cap, lambda c, k: _initcap(c))
     if isinstance(expr, E.Substring):
-        return _substring(expr, ev(expr.str), cap)
+        return _on_dict(ev(expr.str), cap,
+                        lambda c, k: _substring(expr, c, k))
     if isinstance(expr, E.Concat):
         return _concat([as_strv(ev(e), cap) for e in expr.children_])
     if isinstance(expr, (E.StringTrim, E.StringTrimLeft, E.StringTrimRight)):
-        return _trim(expr, ev(expr.column), cap)
+        return _on_dict(ev(expr.column), cap, lambda c, k: _trim(expr, c, k))
     if isinstance(expr, (E.StartsWith, E.EndsWith, E.Contains)):
-        return _string_predicate(expr, ev(expr.left), cap)
+        return _on_dict(ev(expr.left), cap,
+                        lambda c, k: _string_predicate(expr, c, k))
     if isinstance(expr, E.Like):
-        return _like(expr, ev(expr.left), cap)
+        return _on_dict(ev(expr.left), cap, lambda c, k: _like(expr, c, k))
     if isinstance(expr, E.RLike):
-        return _rlike(expr, ev(expr.left), cap)
+        return _on_dict(ev(expr.left), cap, lambda c, k: _rlike(expr, c, k))
     if isinstance(expr, E.RegExpReplace):
-        return _regexp_replace(expr, ev(expr.str), cap)
+        return _on_dict(ev(expr.str), cap,
+                        lambda c, k: _regexp_replace(expr, c, k),
+                        growth=_replace_growth(expr))
     if isinstance(expr, E.StringLocate):
-        return _locate(expr, ev(expr.str), cap)
+        c = ev(expr.str)
+        if isinstance(c, DictV) and isinstance(expr.start, E.Literal) \
+                and expr.start.value is None:
+            # null start -> 0 for EVERY row (even null inputs): validity
+            # is not input-derived, so it must not fold through the codes
+            return ColV(jnp.zeros(cap, jnp.int32), jnp.ones(cap, jnp.bool_))
+        return _on_dict(c, cap, lambda c_, k: _locate(expr, c_, k))
     if isinstance(expr, E.StringReplace):
-        return _replace(expr, ev(expr.str), cap)
-    if isinstance(expr, E.StringLPad):
-        return _pad(expr, ev(expr.str), cap, left=True)
-    if isinstance(expr, E.StringRPad):
-        return _pad(expr, ev(expr.str), cap, left=False)
+        return _on_dict(ev(expr.str), cap, lambda c, k: _replace(expr, c, k),
+                        growth=_replace_growth(expr))
+    if isinstance(expr, (E.StringLPad, E.StringRPad)):
+        # pads have no dictionary-level form (mat_cap can't bound the
+        # padded width) — materialize dict inputs, but ONLY dict inputs:
+        # as_strv would silently null out a non-string child that must
+        # keep failing the support probe instead
+        c = ev(expr.str)
+        if isinstance(c, DictV):
+            c = materialize_dict(c)
+        return _pad(expr, c, cap, left=isinstance(expr, E.StringLPad))
     if isinstance(expr, E.SubstringIndex):
-        return _substring_index(expr, ev(expr.str), cap)
+        return _on_dict(ev(expr.str), cap,
+                        lambda c, k: _substring_index(expr, c, k))
     if isinstance(expr, E.StringSplitPart):
-        return _split_part(expr, ev(expr.str), cap)
+        return _on_dict(ev(expr.str), cap,
+                        lambda c, k: _split_part(expr, c, k))
     return None
 
 

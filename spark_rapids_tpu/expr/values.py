@@ -21,7 +21,141 @@ class StrV(NamedTuple):
     validity: jax.Array
 
 
-Val = Union[ColV, StrV]
+@jax.tree_util.register_pytree_node_class
+class DictV:
+    """Dictionary-encoded string column piece (late materialization).
+
+    Reference analog: cudf's dictionary32 column type, which the reference
+    plugin receives from the GPU parquet decoder for low-cardinality string
+    columns. Here the encoding is first-class in the expression engine:
+    string kernels run once over the small ``dictionary`` (a StrV of
+    ``dict_size`` entries) and per-row work collapses to int32 gathers over
+    ``codes``.
+
+      codes       (cap,) int32 — per-row index into the dictionary
+      dictionary  StrV over dict_size entries (its validity marks entries
+                  nulled by dictionary-level kernels; consumers AND it in
+                  through ``codes``)
+      validity    (cap,) bool — per-ROW validity
+
+    Static (non-traced) metadata rides in the pytree aux data so jit cache
+    keys capture it:
+
+      mat_cap   char-pool capacity sufficient to materialize every row
+                (exact total bytes at scan time, bucketed; scaled by the
+                worst-case growth factor of each dictionary-level kernel).
+                Valid under row-SUBSET ops only — execs that repeat rows
+                (joins) materialize first.
+      max_len   static bound on one entry's byte length (drives the sort/
+                group radix chunk count without a host sync)
+      unique    True when distinct codes imply distinct string values
+                (parquet dictionaries); value-transforming kernels clear it
+                because e.g. upper() can merge entries. Grouping uses codes
+                directly only when set.
+    """
+
+    __slots__ = ("codes", "dictionary", "validity", "mat_cap", "max_len",
+                 "unique")
+
+    def __init__(self, codes, dictionary: StrV, validity,
+                 mat_cap: int, max_len: int, unique: bool = False):
+        self.codes = codes
+        self.dictionary = dictionary
+        self.validity = validity
+        self.mat_cap = int(mat_cap)
+        self.max_len = int(max_len)
+        self.unique = bool(unique)
+
+    @property
+    def dict_size(self) -> int:
+        """Static entry count of the dictionary."""
+        return int(self.dictionary.offsets.shape[0]) - 1
+
+    def tree_flatten(self):
+        return ((self.codes, self.dictionary, self.validity),
+                (self.mat_cap, self.max_len, self.unique))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        codes, dictionary, validity = children
+        return cls(codes, dictionary, validity, *aux)
+
+    def __repr__(self):
+        return (f"DictV(dict_size={self.dict_size}, mat_cap={self.mat_cap}, "
+                f"max_len={self.max_len}, unique={self.unique})")
+
+
+Val = Union[ColV, StrV, DictV]
+
+
+def val_capacity(v: Val) -> int:
+    """Static row capacity of any column value."""
+    if isinstance(v, StrV):
+        return int(v.offsets.shape[0]) - 1
+    return int(v.validity.shape[0])
+
+
+def clipped_codes(v: DictV):
+    """Codes clipped into the dictionary range (padding/null slots may
+    carry arbitrary values; validity masks them downstream)."""
+    import jax.numpy as jnp
+
+    return jnp.clip(v.codes, 0, max(v.dict_size - 1, 0))
+
+
+def dict_gather_col(v: DictV, dict_col: ColV) -> ColV:
+    """Expand a dictionary-level ColV (one row per dictionary entry) to a
+    per-row ColV through the codes: the O(cardinality) kernel result
+    becomes per-row data with one int32 gather."""
+    import jax.numpy as jnp
+
+    idx = clipped_codes(v)
+    data = jnp.take(dict_col.data, idx, mode="clip")
+    valid = v.validity & jnp.take(dict_col.validity, idx, mode="clip")
+    return ColV(jnp.where(valid, data, jnp.zeros((), data.dtype)), valid)
+
+
+def dict_rewrap(v: DictV, out_dict: StrV, mat_growth: int = 1,
+                unique: bool = False) -> DictV:
+    """Wrap a dictionary-level string kernel's output back into a DictV.
+
+    The kernel ran over ``v.dictionary`` (dict_size rows); entry-level
+    nulls fold into per-row validity here so ``DictV.validity`` stays the
+    authoritative row validity everywhere downstream. ``mat_growth`` is
+    the kernel's worst-case byte growth factor (1 for the non-growing
+    kernels: case mapping, substring, trim, split).
+    """
+    import jax.numpy as jnp
+
+    from ..utils.bucketing import bucket_rows
+
+    idx = clipped_codes(v)
+    validity = v.validity & jnp.take(out_dict.validity, idx, mode="clip")
+    dict_valid = jnp.ones(v.dict_size, jnp.bool_)
+    mat_cap = (v.mat_cap if mat_growth == 1
+               else bucket_rows(max(1, v.mat_cap * mat_growth), 128))
+    return DictV(
+        v.codes, StrV(out_dict.offsets, out_dict.chars, dict_valid),
+        validity, mat_cap, v.max_len * mat_growth, unique)
+
+
+def materialize_dict(v: DictV) -> StrV:
+    """Expand a DictV to a plain StrV (the escape hatch every consumer
+    without a dict path uses — correctness never depends on dict support).
+    Trace-safe: ``mat_cap`` is static pytree aux data."""
+    import jax.numpy as jnp
+
+    from ..ops.filter_gather import gather_string
+
+    d = v.dictionary
+    return gather_string(
+        StrV(d.offsets, d.chars, jnp.ones(v.dict_size, jnp.bool_)),
+        clipped_codes(v), v.validity, v.mat_cap)
+
+
+def as_plain_str(v) -> StrV:
+    """StrV of any string-typed value (identity for StrV)."""
+    return materialize_dict(v) if isinstance(v, DictV) else v
 
 
 class UnsupportedExpressionError(Exception):

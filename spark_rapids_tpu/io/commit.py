@@ -23,7 +23,8 @@ def committed_file(path: str):
         # frees the HBM promptly)
         from .scan_cache import DeviceScanCache
 
-        inst = DeviceScanCache._instance
+        with DeviceScanCache._instance_lock:
+            inst = DeviceScanCache._instance
         if inst is not None:
             inst.invalidate_path(path)
     finally:

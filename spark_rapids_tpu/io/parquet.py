@@ -254,11 +254,12 @@ class ParquetScanner:
         GpuParquetScan.scala:1157; see io/parquet_device.py."""
         import pyarrow.parquet as pq
 
-        from ..conf import PARQUET_DEVICE_DECODE
+        from ..conf import PARQUET_DEVICE_DECODE, PARQUET_DICT_STRINGS
         from .parquet_device import read_row_group_device
 
         if not self.conf.get(PARQUET_DEVICE_DECODE):
             return None, ()
+        dict_strings = bool(self.conf.get(PARQUET_DICT_STRINGS))
         s = self.splits()[i]
         if not s.row_groups:
             return None, s.partition_values
@@ -271,7 +272,10 @@ class ParquetScanner:
         ]
         # probe the cache BEFORE opening the file: a fully-hot file must
         # not re-pay the footer parse / mmap it is cached to avoid
-        keys = ([file_key(s.path, rg, file_cols, "batch")
+        # (the dict-strings flag is part of the key: the two layouts must
+        # never serve each other's cached batches)
+        keys = ([file_key(s.path, rg, file_cols,
+                          "batch-dict" if dict_strings else "batch")
                  for rg in s.row_groups] if cache is not None else None)
         batches = [cache.get(k) for k in keys] if cache is not None else [
             None] * len(s.row_groups)
@@ -293,7 +297,8 @@ class ParquetScanner:
             if batches[i] is not None:
                 continue
             b = read_row_group_device(
-                s.path, pf, rg, file_cols, nfields, file_bytes)
+                s.path, pf, rg, file_cols, nfields, file_bytes,
+                dict_strings=dict_strings)
             if b is None:
                 return None, s.partition_values
             if cache is not None:
@@ -313,11 +318,12 @@ class ParquetScanner:
         column needs the host decoder (caller uses execute_partition)."""
         import pyarrow.parquet as pq
 
-        from ..conf import PARQUET_DEVICE_DECODE
+        from ..conf import PARQUET_DEVICE_DECODE, PARQUET_DICT_STRINGS
         from .parquet_device import row_group_device_plans
 
         if not self.conf.get(PARQUET_DEVICE_DECODE):
             return None
+        dict_strings = bool(self.conf.get(PARQUET_DICT_STRINGS))
         s = self.splits()[i]
         if not s.row_groups or self.partition_cols:
             return None
@@ -327,7 +333,8 @@ class ParquetScanner:
         file_cols = [c for c in self.columns if c not in split_pcols(s)]
         nfields = [f for f in self.schema.fields if f.name in file_cols]
         # probe the cache BEFORE opening the file (see read_split_device)
-        keys = ([file_key(s.path, rg, file_cols, "stage")
+        keys = ([file_key(s.path, rg, file_cols,
+                          "stage-dict" if dict_strings else "stage")
                  for rg in s.row_groups] if cache is not None else None)
         out = [cache.get(k) for k in keys] if cache is not None else [
             None] * len(s.row_groups)
@@ -347,7 +354,8 @@ class ParquetScanner:
             if out[i] is not None:
                 continue
             stage = row_group_device_plans(
-                s.path, pf, rg, file_cols, nfields, file_bytes)
+                s.path, pf, rg, file_cols, nfields, file_bytes,
+                dict_strings=dict_strings)
             if stage is None:
                 return None
             if cache is not None:

@@ -568,13 +568,19 @@ def _np_plain_words(plan: ChunkPlan) -> np.ndarray:
     )
 
 
-def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int):
+def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int,
+                dict_strings: bool = False):
     """Build the device half of one chunk decode WITHOUT dispatching:
     returns ``(args, key, run)`` where ``args`` are the host arrays to
     upload, ``key`` is the structural cache key, and ``run(arglist)`` is a
-    PURE traced function producing ``(data, validity)`` for fixed-width or
-    ``(offsets, chars, validity)`` for strings. Callers either jit one
-    column (chunk_to_device_column) or splice many columns — and whole
+    PURE traced function producing ``(data, validity)`` for fixed-width,
+    ``(offsets, chars, validity)`` for strings, or — with
+    ``dict_strings`` — a :class:`~..expr.values.DictV` for dictionary-
+    encoded BYTE_ARRAY chunks: the codes and the file's own dictionary
+    upload AS-IS and no chars expansion ever happens (late
+    materialization; the reference's cudf decoder likewise hands back
+    dictionary32 columns). Callers either jit one column
+    (chunk_to_device_column) or splice many columns — and whole
     exec chains — into a single fused stage program (exec/aggregate's
     scan→agg stage; reference contrast: cudf decodes a whole table in one
     kernel launch batch, GpuParquetScan.scala:1157)."""
@@ -601,9 +607,10 @@ def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int):
             return jnp.zeros(cap, dt), jnp.zeros(cap, jnp.bool_)
         return [], ("pqdec0", str(dt), cap), run_empty
 
+    keep_dict = bool(dict_strings) and is_str and is_dict
     args: List[Any] = []
     key: List[Any] = ["pqdec", plan.phys, str(dtype_tpu), cap, n, has_def,
-                      is_dict]
+                      is_dict, keep_dict]
 
     if has_def:
         vwords = _pack_validity_words(plan.validity)
@@ -633,9 +640,10 @@ def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int):
                 ) @ lens
             ) if plan.codes.shape[0] else 0
             ccap = bucket_rows(max(1, total_bytes), 128)
+            max_len = int(lens.max()) if D > 0 and lens.size else 0
             args += [jnp.asarray(plan.dict_offsets.astype(np.int32)),
                      jnp.asarray(plan.dict_chars)]
-            key += [D, int(plan.dict_chars.shape[0]), ccap]
+            key += [D, int(plan.dict_chars.shape[0]), ccap, max_len]
         else:
             args.append(jnp.asarray(plan.dict_values))
             key += [int(plan.dict_values.shape[0])]
@@ -677,6 +685,12 @@ def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int):
 
                     D_ = doff_.shape[0] - 1
                     dsv = StrV(doff_, dch_, jnp.ones(D_, jnp.bool_))
+                    if keep_dict:
+                        from ..expr.values import DictV
+
+                        return DictV(
+                            jnp.clip(codes_, 0, D_ - 1), dsv, validity,
+                            mat_cap=ccap, max_len=max_len, unique=True)
                     out = gather_string(
                         dsv, jnp.clip(codes_, 0, D_ - 1), validity, ccap)
                     return out.offsets, out.chars, validity
@@ -710,12 +724,13 @@ def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int):
     return args, tuple(key), run
 
 
-def chunk_to_device_column(plan: ChunkPlan, dtype_tpu, cap: int):
+def chunk_to_device_column(plan: ChunkPlan, dtype_tpu, cap: int,
+                           dict_strings: bool = False):
     """Upload a ChunkPlan's payloads and expand to a DeviceColumn in ONE
     jitted program (per structural cache key)."""
     import jax
 
-    args, key_t, run = plan_decode(plan, dtype_tpu, cap)
+    args, key_t, run = plan_decode(plan, dtype_tpu, cap, dict_strings)
     fn = _DECODE_CACHE.get(key_t)
     if fn is None:
         if len(_DECODE_CACHE) > 512:
@@ -723,8 +738,11 @@ def chunk_to_device_column(plan: ChunkPlan, dtype_tpu, cap: int):
         fn = _DECODE_CACHE[key_t] = jax.jit(run)
     out = fn(args)
     from ..columnar.column import DeviceColumn
+    from ..expr.values import DictV
 
     n = plan.num_values
+    if isinstance(out, DictV):
+        return DeviceColumn.dict_encoded(dtype_tpu, n, out)
     if plan.phys == "BYTE_ARRAY":
         offsets, chars, validity = out
         return DeviceColumn(dtype_tpu, n, None, validity, offsets, chars)
@@ -785,7 +803,7 @@ def _plan_columns(path, pf, rgmd, pqschema, name_to_ci, columns, file_bytes):
 
 def row_group_device_plans(
     path: str, pf, rg: int, columns: Sequence[str], tpu_fields,
-    file_bytes: Optional[bytes] = None,
+    file_bytes: Optional[bytes] = None, dict_strings: bool = False,
 ):
     """Stage-fusion variant of read_row_group_device: host-plan ALL
     columns and return ``(num_rows, cap, entries)`` with entries =
@@ -809,14 +827,15 @@ def row_group_device_plans(
         return None
     entries = []
     for name, f in zip(columns, tpu_fields):
-        args, key, run = plan_decode(plans[name], f.dataType, cap)
+        args, key, run = plan_decode(plans[name], f.dataType, cap,
+                                     dict_strings)
         entries.append((args, key, run, f))
     return n, cap, entries
 
 
 def read_row_group_device(
     path: str, pf, rg: int, columns: Sequence[str], tpu_fields,
-    file_bytes: Optional[bytes] = None,
+    file_bytes: Optional[bytes] = None, dict_strings: bool = False,
 ) -> Optional[Any]:
     """Decode one row group into a ColumnarBatch, device-decoding every
     supported column and host-decoding (pyarrow) the rest. Returns None
@@ -849,7 +868,8 @@ def read_row_group_device(
     fields = []
     for name, f in zip(columns, tpu_fields):
         if name in plans:
-            cols.append(chunk_to_device_column(plans[name], f.dataType, cap))
+            cols.append(chunk_to_device_column(
+                plans[name], f.dataType, cap, dict_strings))
             fields.append(f)
         else:
             sub = host_table.select([name])

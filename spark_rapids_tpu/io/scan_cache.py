@@ -39,11 +39,23 @@ class DeviceScanCache:
 
         if not conf.get(SCAN_DEVICE_CACHE):
             return None
+        budget = int(conf.get(SCAN_DEVICE_CACHE_MAX_BYTES))
         with cls._instance_lock:
             if cls._instance is None:
-                cls._instance = DeviceScanCache(
-                    conf.get(SCAN_DEVICE_CACHE_MAX_BYTES))
+                cls._instance = DeviceScanCache(budget)
+            elif cls._instance.max_bytes != budget:
+                # a later session's budget governs: the singleton resizes
+                # instead of silently pinning the first session's value
+                cls._instance.resize(budget)
             return cls._instance
+
+    def resize(self, max_bytes: int) -> None:
+        """Adopt a new byte budget, evicting LRU entries if it shrank."""
+        with self._lock:
+            self.max_bytes = int(max_bytes)
+            while self._bytes > self.max_bytes and self._entries:
+                _, (_, sz) = self._entries.popitem(last=False)
+                self._bytes -= sz
 
     @classmethod
     def reset(cls) -> None:
@@ -77,7 +89,10 @@ class DeviceScanCache:
     def invalidate_path(self, path: str) -> None:
         """Drop every entry of one file (the writers' commit protocol
         calls this, io/commit.py — reads stay correct either way via the
-        mtime/size key; this just frees the HBM promptly)."""
+        mtime/size key; this just frees the HBM promptly). Paths are
+        realpath-normalized to match ``file_key``, so a writer committing
+        through a symlink still hits the scanner's entries."""
+        path = _real(path)
         with self._lock:
             dead = [k for k in self._entries if k and k[0] == path]
             for k in dead:
@@ -85,10 +100,36 @@ class DeviceScanCache:
                 self._bytes -= sz
 
 
+_REALPATH_CACHE: dict = {}
+
+
+def _real(path: str) -> str:
+    """``os.path.realpath`` with a process-lifetime memo: symlink
+    resolution lstat()s every path component, which is pathologically slow
+    on some overlay/FUSE filesystems (measured multiple SECONDS per call in
+    sandboxed containers), and scan keys hit this once per row group. A
+    symlink retargeted mid-process misses the memo, but the mtime/size in
+    the key already guarantees no stale reads either way."""
+    r = _REALPATH_CACHE.get(path)
+    if r is None:
+        import os
+
+        if len(_REALPATH_CACHE) > 65536:
+            _REALPATH_CACHE.clear()
+        r = _REALPATH_CACHE[path] = os.path.realpath(path)
+    return r
+
+
 def file_key(path: str, rg: int, columns, cap_hint=None) -> tuple:
-    """Cache key pinned to file identity (mtime+size catch rewrites)."""
+    """Cache key pinned to file identity (mtime+size catch rewrites).
+    realpath-normalized so the same file reached via symlink / relative
+    path shares one entry (and invalidate_path finds it). The stat runs
+    on the LIVE path, not the memoized resolution: a symlink retargeted
+    after the memo was taken then sees the new target's mtime/size — a
+    different key — so the memo can never serve stale data (and never
+    turns a valid symlink read into a stat of a deleted old target)."""
     import os
 
     st = os.stat(path)
-    return (path, int(st.st_mtime_ns), st.st_size, rg, tuple(columns),
-            cap_hint)
+    return (_real(path), int(st.st_mtime_ns), st.st_size, rg,
+            tuple(columns), cap_hint)

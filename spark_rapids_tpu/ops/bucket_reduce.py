@@ -32,6 +32,59 @@ N_LIMBS = 8  # 8-bit limbs per int64
 
 _HI = jax.lax.Precision.HIGHEST
 
+#: test hook: force the MXU limb-matmul lowering even on the CPU backend
+#: (differential tests diff it against the scatter lowering)
+FORCE_MATMUL = False
+
+
+def _use_scatter() -> bool:
+    """Backend-adaptive lowering choice (trace-time static, so each jit
+    cache entry is per-backend). The MXU tradeoff inverts on XLA CPU:
+    the one-hot never fuses there — it materializes (n, B) compare-selects
+    at ~7ns/element (measured: 1.7-2.3 s for 2M rows x 128 buckets) while
+    scatter runs a tight serial loop (~0.2 s for the same shape, 4-10x
+    faster). On TPU scatter is the near-serial one (~10ns/row) and the
+    matmul is free — keep the limb path there."""
+    return jax.default_backend() == "cpu" and not FORCE_MATMUL
+
+
+def _bucket_reduce_scatter(
+    seg: jax.Array,
+    B: int,
+    int_cols: Sequence[Tuple[jax.Array, jax.Array]],
+    count_cols: Sequence[jax.Array],
+    float_cols: Sequence[Tuple[jax.Array, jax.Array]],
+) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
+    """CPU lowering of :func:`bucket_reduce`: native-dtype segment sums,
+    one batched scatter per dtype family. No limb splitting — int64 adds
+    are native here and wrap mod 2^64 exactly like the limb accumulate;
+    float sums run in f64 (at least as accurate as the hi/lo split).
+    Counts ride the f64 scatter (exact below 2^53, and row capacities are
+    far below that) so the common sum+count aggregate is ONE scatter pass.
+    Out-of-range ids drop, matching the one-hot zero row."""
+    ints = [
+        jnp.where(valid, data.astype(jnp.int64), jnp.int64(0))
+        for data, valid in int_cols
+    ]
+    out_int: List[jax.Array] = []
+    if ints:
+        s = jax.ops.segment_sum(
+            jnp.stack(ints, axis=-1), seg, num_segments=B)
+        out_int = [s[:, i] for i in range(len(ints))]
+    out_cnt: List[jax.Array] = []
+    out_flt: List[jax.Array] = []
+    fcols = [valid.astype(jnp.float64) for valid in count_cols] + [
+        jnp.where(valid, data, 0.0).astype(jnp.float64)
+        for data, valid in float_cols
+    ]
+    if fcols:
+        f = jax.ops.segment_sum(jnp.stack(fcols, axis=-1), seg,
+                                num_segments=B)
+        out_cnt = [f[:, i].astype(jnp.int64) for i in range(len(count_cols))]
+        out_flt = [f[:, len(count_cols) + i]
+                   for i in range(len(float_cols))]
+    return out_int, out_cnt, out_flt
+
 
 def bucket_reduce(
     seg: jax.Array,
@@ -47,6 +100,8 @@ def bucket_reduce(
     count_cols: [valid bool] -> int64 counts (B,)
     float_cols: [(data f64/f32, valid bool)] -> f64 sums (B,) (hi/lo split)
     """
+    if _use_scatter():
+        return _bucket_reduce_scatter(seg, B, int_cols, count_cols, float_cols)
     n = seg.shape[0]
     limbs: List[jax.Array] = []
     for data, valid in int_cols:
@@ -128,6 +183,15 @@ def bucket_lookup_u32(
     """Per-row lookup of a u32 table value by bucket id, exactly, via two
     16-bit-limb one-hot matmuls. Returns (lo, hi) f32 per row (each < 2^16,
     exact). Rows with seg >= B read 0."""
+    if _use_scatter():
+        # CPU: a plain clipped gather is exact and ~B x cheaper than the
+        # materialized one-hot
+        t = jnp.where(
+            (seg >= 0) & (seg < B),
+            jnp.take(table, jnp.clip(seg, 0, B - 1), mode="clip"),
+            jnp.uint32(0))
+        return ((t & jnp.uint32(0xFFFF)).astype(jnp.float32),
+                (t >> 16).astype(jnp.float32))
     n = seg.shape[0]
     lo = (table & jnp.uint32(0xFFFF)).astype(jnp.float32)
     hi = (table >> 16).astype(jnp.float32)

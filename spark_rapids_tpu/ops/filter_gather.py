@@ -17,7 +17,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from ..expr.eval import ColV, StrV, Val
+from ..expr.eval import ColV, DictV, StrV, Val
 
 
 def live_of(num_rows_or_mask, cap: int) -> jax.Array:
@@ -234,7 +234,17 @@ def gather(
     expansion), where output bytes can exceed the input pool.
 
     Fixed-width columns gather as ONE packed (cap, W) int32 row gather
-    (see :func:`pack_fixed_cols`); strings keep the two-pass byte path."""
+    (see :func:`pack_fixed_cols`); strings keep the two-pass byte path.
+    Dict-encoded strings gather as their int32 CODES (riding the packed
+    fixed gather — the late-materialization payoff: no byte movement);
+    the dictionary passes through untouched. Callers whose indices repeat
+    rows must materialize dict columns first (a row-repeat can exceed the
+    static ``mat_cap`` byte bound) — row-subset/permute callers are safe."""
+    orig_cols = list(cols)
+    cols = [
+        ColV(c.codes, c.validity) if isinstance(c, DictV) else c
+        for c in orig_cols
+    ]
     fixed = [
         c for c in cols
         if isinstance(c, ColV) and packable_dtype(c.data.dtype)
@@ -249,7 +259,7 @@ def gather(
     out: List[Val] = []
     si = 0
     fi = 0
-    for c in cols:
+    for c, oc in zip(cols, orig_cols):
         if isinstance(c, StrV):
             cc = (
                 char_caps[si]
@@ -258,11 +268,16 @@ def gather(
             )
             si += 1
             out.append(gather_string(c, indices, valid_slot, cc))
-        elif not packable_dtype(c.data.dtype):
-            out.append(gather_fixed(c, indices, valid_slot))
+            continue
+        if not packable_dtype(c.data.dtype):
+            g = gather_fixed(c, indices, valid_slot)
         else:
-            out.append(packed[fi])
+            g = packed[fi]
             fi += 1
+        if isinstance(oc, DictV):
+            g = DictV(g.data, oc.dictionary, g.validity,
+                      oc.mat_cap, oc.max_len, oc.unique)
+        out.append(g)
     return out
 
 

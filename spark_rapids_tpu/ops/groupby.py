@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .. import types as T
-from ..expr.eval import ColV, StrV, Val
+from ..expr.eval import ColV, DictV, StrV, Val
+from ..expr.values import materialize_dict
 from .filter_gather import gather
 from .sort import SortOrder, sort_with_radix_keys
 
@@ -536,14 +537,57 @@ def groupby_agg(
     unsupported cases (aggregate.scala:806). Here the choice is a runtime
     ``lax.cond`` on the collision-free check, so low-cardinality aggregates
     (the TPC-DS common case) never pay the bitonic sort.
-    String keys currently always take the sort path.
+    Plain string keys always take the sort path; DICT-ENCODED string keys
+    whose dictionary is unique group directly on their int32 codes (no
+    byte-wise hashing or chunk-key sort at all — the cudf-dictionary32
+    trick) and rewrap the output codes, so the group keys stay encoded.
+    Non-unique dictionaries (post-transform, where distinct codes may
+    hold equal strings) materialize and sort like plain strings.
     """
+    key_cols = list(key_cols)
+    key_dtypes = list(key_dtypes)
+    code_keys = {}  # key index -> DictV template to rewrap from codes
+    eff_sml: List[int] = []
+    si = 0
+    for i, c in enumerate(key_cols):
+        if isinstance(c, DictV):
+            if si < len(str_max_lens):
+                si += 1  # consume this string key's slot either way
+            if c.unique:
+                key_cols[i] = ColV(c.codes.astype(jnp.int32), c.validity)
+                key_dtypes[i] = T.INT
+                code_keys[i] = c
+            else:
+                from ..utils.bucketing import bucket_rows
+
+                key_cols[i] = materialize_dict(c)
+                eff_sml.append(max(4, bucket_rows(max(1, c.max_len), 4)))
+        elif isinstance(c, StrV):
+            eff_sml.append(str_max_lens[si] if si < len(str_max_lens) else 64)
+            si += 1
+    str_max_lens = tuple(eff_sml)
+
+    def _rewrap(keys, aggs, n):
+        if code_keys:
+            from ..utils.bucketing import bucket_rows
+
+            keys = list(keys)
+            for i, t in code_keys.items():
+                k = keys[i]
+                keys[i] = DictV(
+                    k.data, t.dictionary, k.validity,
+                    bucket_rows(
+                        max(1, int(t.dictionary.chars.shape[0])), 128),
+                    t.max_len, True)
+        return keys, aggs, n
+
     if not key_cols:
         return sort_groupby(
             key_cols, key_dtypes, value_cols, agg_ops, num_rows, str_max_lens)
     if any(isinstance(c, StrV) for c in key_cols):
-        return sort_groupby(
-            key_cols, key_dtypes, value_cols, agg_ops, num_rows, str_max_lens)
+        return _rewrap(*sort_groupby(
+            key_cols, key_dtypes, value_cols, agg_ops, num_rows,
+            str_max_lens))
     cap = key_cols[0].validity.shape[0]
 
     def pow2_floor(x: int) -> int:
@@ -592,4 +636,4 @@ def groupby_agg(
     keys_t, aggs_t, n = tier(B0, chain)(None)
     out_keys = [ColV(d, v) for d, v in keys_t]
     out_aggs = [ColV(d, v) for d, v in aggs_t]
-    return out_keys, out_aggs, n
+    return _rewrap(out_keys, out_aggs, n)

@@ -276,17 +276,30 @@ def bounded_range_agg(
         park = jnp.array(info.min if nulls_first else info.max, kd.dtype)
     keys = jnp.where(kv, kd, park)
 
+    # clamp the searched frame to the partition's NON-NULL span: parked
+    # null keys collide with saturating range bounds near the dtype edge
+    # (key=int64.min+1 with 5 PRECEDING saturates to int64.min == the
+    # nulls-first park value, pulling the null peer block into the frame).
+    # Nulls sort to one contiguous end of the partition, so the span is a
+    # per-partition null count away from the partition edge.
+    nulls = live & ~order_key.validity
+    pre_nulls = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(nulls.astype(jnp.int32))])
+    n_nulls = pre_nulls[part_end + 1] - pre_nulls[part_start]
+    nn_start = part_start + n_nulls if nulls_first else part_start
+    nn_end = part_end if nulls_first else part_end - n_nulls
+
     if lower is None:
         lo = part_start
     else:
         lo = _search_sorted_in_partition(
-            keys, part_start, part_end + 1,
+            keys, nn_start, nn_end + 1,
             _saturating_offset(keys, lower), "left")
     if upper is None:
         hi = part_end
     else:
         hi = _search_sorted_in_partition(
-            keys, part_start, part_end + 1,
+            keys, nn_start, nn_end + 1,
             _saturating_offset(keys, upper), "right") - 1
     # null current rows: a BOUNDED side lands on the null peer block
     # (nulls are mutual peers); an unbounded side keeps the partition edge

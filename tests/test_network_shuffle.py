@@ -334,3 +334,22 @@ def test_fetch_failure_is_clean_and_retries_recover():
     assert got[0][1] == payload
     cli2.close()
     srv2.close()
+
+
+@pytest.mark.parametrize("bad", ["hostonly", "host:", ":9000", "host:port"])
+def test_invalid_peer_entry_raises_conf_error(bad):
+    """A malformed peers entry must fail with an error naming the conf key
+    and the offending entry, not a bare int() ValueError at transport
+    construction."""
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.exec.exchange import make_transport
+
+    conf = RapidsConf({
+        "spark.rapids.tpu.shuffle.transport.class": "network",
+        "spark.rapids.tpu.shuffle.network.peers": f"ok-host:9000,{bad}",
+    })
+    with pytest.raises(ValueError) as ei:
+        make_transport(conf)
+    msg = str(ei.value)
+    assert "spark.rapids.tpu.shuffle.network.peers" in msg
+    assert repr(bad) in msg

@@ -348,3 +348,70 @@ class TestMurmur3:
         h = hashing.murmur3([v], [T.INT])
         p = np.asarray(hashing.partition_ids(h, 7))
         assert p.min() >= 0 and p.max() < 7
+
+
+class TestBucketReduceLowerings:
+    """The bucket reduction has two lowerings — MXU limb matmuls (TPU)
+    and native-dtype segment sums (CPU, where the one-hot can't fuse).
+    They must agree exactly on integers/counts and to f64 rounding on
+    floats, including int64 wraparound and dropped out-of-range ids."""
+
+    def _inputs(self):
+        import numpy as np
+
+        rng = np.random.default_rng(7)
+        n, B = 4096, 64
+        seg = rng.integers(0, B, n).astype(np.int32)
+        seg[:17] = B  # dead rows: must drop from every reduction
+        ival = rng.integers(-(2 ** 62), 2 ** 62, n)  # wraparound territory
+        fval = rng.uniform(-1e6, 1e6, n)
+        valid = rng.random(n) > 0.1
+        return seg, B, ival, fval, valid
+
+    def test_scatter_vs_matmul_paths(self):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.ops import bucket_reduce as BR
+
+        seg, B, ival, fval, valid = self._inputs()
+        args = (jnp.asarray(seg), B,
+                [(jnp.asarray(ival), jnp.asarray(valid))],
+                [jnp.asarray(valid)],
+                [(jnp.asarray(fval), jnp.asarray(valid))])
+        fast = BR.bucket_reduce(*args)
+        old = BR.FORCE_MATMUL
+        BR.FORCE_MATMUL = True
+        try:
+            exact = BR.bucket_reduce(*args)
+        finally:
+            BR.FORCE_MATMUL = old
+        assert (fast[0][0] == exact[0][0]).all()  # int64, incl. wraparound
+        assert (fast[1][0] == exact[1][0]).all()  # counts
+        import numpy as np
+
+        # the scatter path is a straight f64 sum (exact vs a numpy oracle);
+        # the matmul's f32 hi/lo split loses bits under cancellation —
+        # that's the approx-float-agg contract, so compare at its tolerance
+        f1, f2 = np.asarray(fast[2][0]), np.asarray(exact[2][0])
+        assert np.allclose(f1, f2, rtol=1e-4, atol=1e-6)
+
+    def test_lookup_vs_matmul_paths(self):
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.ops import bucket_reduce as BR
+
+        rng = np.random.default_rng(9)
+        n, B = 512, 32
+        seg = rng.integers(0, B + 1, n).astype(np.int32)  # incl. dead id B
+        table = rng.integers(0, 2 ** 32, B, dtype=np.uint64).astype(np.uint32)
+        a = BR.bucket_lookup_u32(jnp.asarray(seg), B, jnp.asarray(table))
+        old = BR.FORCE_MATMUL
+        BR.FORCE_MATMUL = True
+        try:
+            b = BR.bucket_lookup_u32(jnp.asarray(seg), B, jnp.asarray(table))
+        finally:
+            BR.FORCE_MATMUL = old
+        assert (np.asarray(a[0]) == np.asarray(b[0])).all()
+        assert (np.asarray(a[1]) == np.asarray(b[1])).all()

@@ -81,3 +81,51 @@ def test_cache_lru_eviction():
     # oversized entries never enter the pool
     c.put(("c", 0, 0, 0, (), None), "C", 1000)
     assert c.get(("c", 0, 0, 0, (), None)) is None
+
+
+def test_budget_resize_on_get_instance():
+    # a later session's maxBytes governs: the singleton resizes (evicting
+    # LRU if shrunk) instead of silently pinning the first session's value
+    key = "spark.rapids.tpu.scan.deviceCache.maxBytes"
+    inst = DeviceScanCache.get_instance(RapidsConf({key: 200}))
+    inst.put(("a", 0, 0, 0, (), None), "A", 80)
+    inst.put(("b", 0, 0, 0, (), None), "B", 80)
+    grown = DeviceScanCache.get_instance(RapidsConf({key: 500}))
+    assert grown is inst and inst.max_bytes == 500
+    assert inst.get(("a", 0, 0, 0, (), None)) == "A"
+    shrunk = DeviceScanCache.get_instance(RapidsConf({key: 100}))
+    assert shrunk is inst and inst.max_bytes == 100
+    # LRU eviction down to the new budget: only the most recent survives
+    assert inst.get(("b", 0, 0, 0, (), None)) is None
+    assert inst.get(("a", 0, 0, 0, (), None)) == "A"
+
+
+def test_file_key_and_invalidate_normalize_symlinks(tmp_path):
+    from spark_rapids_tpu.io.scan_cache import file_key
+
+    real = tmp_path / "real.parquet"
+    _write(str(real), list(range(8)))
+    link = tmp_path / "link.parquet"
+    os.symlink(str(real), str(link))
+    k_real = file_key(str(real), 0, ("k",), "batch")
+    k_link = file_key(str(link), 0, ("k",), "batch")
+    assert k_real == k_link  # one entry per physical file
+    c = DeviceScanCache(1000)
+    c.put(k_real, "V", 10)
+    c.invalidate_path(str(link))  # commit through the symlink still hits
+    assert c.get(k_real) is None
+
+
+@pytest.mark.parametrize("fusion", ["ON", "OFF"])
+def test_stage_fusion_modes_agree(tmp_path, fusion):
+    # AUTO skips scan->agg fusion on the CPU backend; force both lowerings
+    # through the same session query and diff them
+    d = str(tmp_path)
+    _write(os.path.join(d, "t.parquet"), list(range(256)))
+    sess = TpuSession({
+        "spark.rapids.tpu.sql.stageFusion": fusion,
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+    })
+    rows = _query(sess, d)
+    assert rows == sorted(
+        (k, sum(v for v in range(256) if v % 8 == k)) for k in range(8))

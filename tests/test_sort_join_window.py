@@ -316,3 +316,51 @@ class TestBoundedRangeFrames:
         rows = df.collect()
         assert "TpuWindowExec" in s.last_executed_plan.tree_string()
         assert len(rows) == 180
+
+
+class TestRangeFrameNullKeyCollision:
+    """Null order keys park at the dtype extreme for the frame search; a
+    saturating range bound near the dtype edge used to collide with the
+    park value and pull the null-key peer block into non-null frames
+    (confirmed repro: key=int64.min+1, RANGE 5 PRECEDING, nulls_first).
+    The searched frame is now clamped to the partition's non-null span."""
+
+    def _build(self, data, nulls_first, frame):
+        sch = schema_of(g=T.INT, o=T.LONG, v=T.INT)
+
+        def build(s):
+            spec = W.WindowSpec(
+                (col("g"),), (col("o"),), ((True, nulls_first),),
+                frame=frame)
+            return s.create_dataframe(data, sch).with_windows(
+                W.WindowExpression(A.Sum(col("v")), spec, "rs"))
+
+        return build
+
+    def test_nulls_first_min_edge(self):
+        imin = -(2 ** 63)
+        data = {"g": [1, 1, 1, 1],
+                "o": [None, imin + 1, imin + 3, 10],
+                "v": [100, 1, 2, 4]}
+        frame = W.WindowFrame(W.RANGE, -5, W.CURRENT_ROW)
+        rows = assert_tpu_and_cpu_equal(
+            self._build(data, True, frame))
+        by_o = {r[1]: r[-1] for r in rows}
+        assert by_o[imin + 1] == 1  # NOT 101: the null row stays out
+        assert by_o[imin + 3] == 3  # {imin+1, imin+3}
+        assert by_o[10] == 4
+        assert by_o[None] == 100  # null peer block only
+
+    def test_nulls_last_max_edge(self):
+        imax = 2 ** 63 - 1
+        data = {"g": [1, 1, 1, 1],
+                "o": [5, imax - 3, imax - 1, None],
+                "v": [8, 2, 1, 100]}
+        frame = W.WindowFrame(W.RANGE, W.CURRENT_ROW, 5)
+        rows = assert_tpu_and_cpu_equal(
+            self._build(data, False, frame))
+        by_o = {r[1]: r[-1] for r in rows}
+        assert by_o[imax - 1] == 1  # NOT 101: saturated upper, nulls out
+        assert by_o[imax - 3] == 3  # {imax-3, imax-1}
+        assert by_o[5] == 8
+        assert by_o[None] == 100
