@@ -158,6 +158,26 @@ ENABLE_TRACE = conf(
     "spark.rapids.tpu.sql.trace.enabled", False,
     "Wrap operator hot sections in jax.profiler TraceAnnotations "
     "(reference: NvtxWithMetrics.scala).")
+METRICS_DEVICE_SYNC = conf(
+    "spark.rapids.tpu.metrics.deviceSync.enabled", False,
+    "Device-accurate operator timing: every operator blocks until its "
+    "output batch's device buffers are ready and records the wait in its "
+    "opTimeDevice metric (reference: the GpuMetric op-time/CUDA-event "
+    "pairs in NvtxWithMetrics.scala). With the conf on for the whole "
+    "plan, upstream outputs are already fenced when an operator "
+    "dispatches, so each wait isolates that operator's own device work. "
+    "Costs one host sync per batch per operator — profiling runs only; "
+    "read the result with TpuSession.explain_metrics().")
+AGG_FUSED_PLAN = conf(
+    "spark.rapids.tpu.sql.agg.fusedPlan", "AUTO",
+    "Compile the aggregate's whole update+merge(+result projection) over "
+    "all same-shaped input batches into ONE XLA program per plan. ON "
+    "always fuses (fixed-width buffer schemas only), OFF runs one update "
+    "program per batch plus a separate merge program, AUTO fuses except "
+    "multi-batch runs on the host/CPU backend (the fused merge stacks "
+    "partials at capacity to stay sync-free, the right trade only over a "
+    "high-latency device link; the CPU backend merges at real row counts "
+    "instead).", valid_values=("AUTO", "ON", "OFF"))
 
 # ---------------------------------------------------------------------------
 # Memory (reference: RapidsConf.scala:200-340, GpuDeviceManager.scala:160-271)

@@ -30,12 +30,10 @@ from ..ops.sort import max_string_len
 from ..types import StructField, StructType
 from ..utils.bucketing import bucket_rows
 from .base import (
-    TOTAL_TIME,
     TpuExec,
     batch_from_vals,
     batch_signature,
     count_scalar,
-    timed,
     vals_of_batch,
 )
 
@@ -90,8 +88,68 @@ def _agg_pipeline(
 
     if len(_AGG_CACHE) > 512:
         _AGG_CACHE.clear()
+    from .base import note_compile_miss
+
+    note_compile_miss("agg_update")
     fn = _AGG_CACHE[key] = jax.jit(run)
     return fn
+
+
+def _fused_agg_trace(key_exprs, key_dts, value_exprs, update_ops, merge_ops,
+                     eval_exprs, approx, bucket_min, chain_t):
+    """The shared in-trace core of BOTH fused aggregate programs (the
+    scan→agg stage fusion and the whole-plan fusion): returns
+    ``(update_batch, finish)`` closures. ``update_batch`` lowers one
+    batch's fused child chain + key/value projection + update groupby;
+    ``finish`` concat-pads the partials, runs the merge groupby, and
+    applies the result projection (non-PARTIAL). One definition so the
+    two paths can never drift semantically — only their ingest differs
+    (decoded row groups vs direct batch columns)."""
+    nkeys = len(key_exprs)
+
+    def agg_once(keys, vals, ops_, live):
+        if key_exprs:
+            k_, a_, nseg = groupby_ops.groupby_agg(
+                keys, list(key_dts), vals, list(ops_), live,
+                (), approx_float_sum=approx)
+            return list(k_) + list(a_), nseg
+        a_ = groupby_ops.reduce_no_keys(vals, list(ops_), live)
+        return list(a_), jnp.int32(1)
+
+    def update_batch(cols, live, cap, side_args):
+        for e, s in zip(chain_t, side_args):
+            cols, live = e.lower_batch(cols, live, cap, s)
+        keys = [lower(e, cols, cap) for e in key_exprs]
+        vals = [None if e is None else lower(e, cols, cap)
+                for e in value_exprs]
+        return agg_once(keys, vals, update_ops, live)
+
+    def finish(partial_sets):
+        if len(partial_sets) == 1:
+            merged_vals, nseg = partial_sets[0]
+        else:
+            # batches/row groups may carry DIFFERENT dictionaries: dict
+            # group keys expand before the cross-partial concat
+            col_parts = [
+                [materialize_dict(c) if isinstance(c, DictV) else c
+                 for c in p[0]]
+                for p in partial_sets
+            ]
+            counts = [p[1] for p in partial_sets]
+            pcaps = [p[0][0].validity.shape[0] for p in partial_sets]
+            out_cap = bucket_rows(sum(pcaps), bucket_min)
+            cols2, mask, _ = concat_ops.concat_padded_cols(
+                col_parts, counts, out_cap)
+            merged_vals, nseg = agg_once(
+                cols2[:nkeys], cols2[nkeys:], merge_ops, mask)
+        if eval_exprs is not None:
+            ocap = (merged_vals[0].validity.shape[0]
+                    if merged_vals else 1)
+            return [lower(e, merged_vals, ocap)
+                    for e in eval_exprs], nseg
+        return merged_vals, nseg
+
+    return update_batch, finish
 
 
 class TpuHashAggregateExec(TpuExec):
@@ -472,39 +530,28 @@ class TpuHashAggregateExec(TpuExec):
             rg_meta.append((n, cap, tuple(k for (_, k, _, _) in entries)))
             all_args.append([list(a) for (a, _, _, _) in entries])
             all_runs.append([r for (_, _, r, _) in entries])
+        eval_exprs = (tuple(self._eval_exprs())
+                      if self.mode != A.PARTIAL else None)
         key = (
             "stage", tuple(rg_meta),
             tuple(e.fusion_key() for e in chain_t),
             tuple(self._bound_keys), self._key_dtypes(),
             tuple(self._update_exprs), tuple(self._update_ops),
-            tuple(self._merge_ops), self.mode, approx,
+            tuple(self._merge_ops), eval_exprs, self.mode, approx,
             side_signature(sides), self.conf.shape_bucket_min,
         )
         fn = _AGG_CACHE.get(key)
         if fn is None:
-            key_exprs = tuple(self._bound_keys)
-            key_dts = self._key_dtypes()
-            value_exprs = tuple(self._update_exprs)
-            update_ops = tuple(self._update_ops)
-            merge_ops = tuple(self._merge_ops)
-            nkeys = len(key_exprs)
-            eval_exprs = (tuple(self._eval_exprs())
-                          if self.mode != A.PARTIAL else None)
-            bucket_min = self.conf.shape_bucket_min
+            update_batch, finish = _fused_agg_trace(
+                tuple(self._bound_keys), self._key_dtypes(),
+                tuple(self._update_exprs), tuple(self._update_ops),
+                tuple(self._merge_ops), eval_exprs, approx,
+                self.conf.shape_bucket_min, chain_t)
             metas = tuple(rg_meta)
             runs_t = tuple(tuple(r) for r in all_runs)
 
             def run(args_nested, side_args):
                 from ..ops.filter_gather import live_of
-
-                def agg_once(keys, vals, ops_, live):
-                    if key_exprs:
-                        k_, a_, nseg = groupby_ops.groupby_agg(
-                            keys, list(key_dts), vals, list(ops_), live,
-                            (), approx_float_sum=approx)
-                        return list(k_) + list(a_), nseg
-                    a_ = groupby_ops.reduce_no_keys(vals, list(ops_), live)
-                    return list(a_), jnp.int32(1)
 
                 partial_sets = []
                 for (n, cap, _), rg_args, rg_runs in zip(
@@ -518,44 +565,125 @@ class TpuHashAggregateExec(TpuExec):
                             cols.append(
                                 ColV(out[0], out[1]) if len(out) == 2
                                 else StrV(out[0], out[1], out[2]))
-                    live = live_of(n, cap)
-                    for e, s in zip(chain_t, side_args):
-                        cols, live = e.lower_batch(cols, live, cap, s)
-                    keys = [lower(e, cols, cap) for e in key_exprs]
-                    vals = [None if e is None else lower(e, cols, cap)
-                            for e in value_exprs]
-                    partial_sets.append(agg_once(keys, vals, update_ops, live))
-                if len(partial_sets) == 1:
-                    merged_vals, nseg = partial_sets[0]
-                else:
-                    # row groups may carry DIFFERENT dictionaries: dict
-                    # group keys expand before the cross-group concat
-                    col_parts = [
-                        [materialize_dict(c) if isinstance(c, DictV) else c
-                         for c in p[0]]
-                        for p in partial_sets
-                    ]
-                    counts = [p[1] for p in partial_sets]
-                    caps = [p[0][0].validity.shape[0] for p in partial_sets]
-                    out_cap = bucket_rows(sum(caps), bucket_min)
-                    cols2, mask, _ = concat_ops.concat_padded_cols(
-                        col_parts, counts, out_cap)
-                    merged_vals, nseg = agg_once(
-                        cols2[:nkeys], cols2[nkeys:], merge_ops, mask)
-                if eval_exprs is not None:
-                    ocap = (merged_vals[0].validity.shape[0]
-                            if merged_vals else 1)
-                    return [lower(e, merged_vals, ocap)
-                            for e in eval_exprs], nseg
-                return merged_vals, nseg
+                    partial_sets.append(
+                        update_batch(cols, live_of(n, cap), cap, side_args))
+                return finish(partial_sets)
 
             if len(_AGG_CACHE) > 512:
                 _AGG_CACHE.clear()
+            from .base import note_compile_miss
+
+            note_compile_miss("agg_stage")
             fn = _AGG_CACHE[key] = jax.jit(run)
         vals, nseg = fn(all_args, sides)
         schema = (self._buffer_schema if self.mode == A.PARTIAL
                   else self._schema)
         return batch_from_vals(vals, schema, nseg)
+
+    # -- whole-plan fusion: update+merge+eval as ONE program ---------------
+    def _can_fuse_plan(self) -> bool:
+        """The fused plan program covers fixed-width keys/buffers (string
+        keys need a host max-length sync and the in-trace padded concat
+        has no byte-pool splice). Unlike stage fusion it covers FINAL mode
+        too — exchanged partials are just fixed-width batches here."""
+        return not any(
+            isinstance(f.dataType, (T.StringType, T.BinaryType))
+            for f in self._buffer_schema.fields
+        )
+
+    def _fused_plan_on(self, nbatches: int) -> bool:
+        """AGG_FUSED_PLAN gate. AUTO declines only multi-batch runs on the
+        CPU backend: the in-trace merge stacks partials at CAPACITY to
+        stay sync-free (the right trade over a high-latency device link),
+        while the CPU backend's synced merge works at real row counts."""
+        from ..conf import AGG_FUSED_PLAN
+
+        mode = self.conf.get(AGG_FUSED_PLAN)
+        if mode != "AUTO":
+            return mode == "ON"
+        import jax as _jx
+
+        return nbatches == 1 or _jx.default_backend() != "cpu"
+
+    def _run_fused_plan(self, batches: List[ColumnarBatch],
+                        chain) -> ColumnarBatch:
+        """ONE jitted program for the whole aggregate over its input
+        batches: per-batch fused child chain -> key/value projection ->
+        update groupby, a padded concat of the partials, the merge
+        groupby, and (non-PARTIAL) the result projection. The update and
+        merge passes of the round-5 engine were separate executables with
+        the partial batches crossing a program boundary between them;
+        collapsing them removes every intermediate dispatch/queue round
+        trip AND the intermediate partials' extra HBM round trips, and
+        batches dispatch as ONE async program — no host sync anywhere
+        (group counts stay device scalars). Profiler evidence for why:
+        see docs/tuning.md (the agg shape's device time was dominated by
+        per-program dispatch gaps, not kernel time)."""
+        from ..conf import IMPROVED_FLOAT_OPS
+        from .base import note_compile_miss, side_signature
+
+        approx = self.conf.get(IMPROVED_FLOAT_OPS)
+        sides = [e.side_vals() for e in chain]
+        chain_t = tuple(chain)
+        sigs = tuple(batch_signature(b) for b in batches)
+        caps = tuple(
+            b.capacity if b.columns else bucket_rows(
+                b.num_rows, self.conf.shape_bucket_min)
+            for b in batches
+        )
+        eval_exprs = (tuple(self._eval_exprs())
+                      if self.mode != A.PARTIAL else None)
+        key = (
+            "plan", sigs, caps, tuple(e.fusion_key() for e in chain_t),
+            tuple(self._bound_keys), self._key_dtypes(),
+            tuple(self._update_exprs), tuple(self._update_ops),
+            tuple(self._merge_ops), eval_exprs, self.mode, approx,
+            side_signature(sides), self.conf.shape_bucket_min,
+        )
+        fn = _AGG_CACHE.get(key)
+        if fn is None:
+            update_batch, finish = _fused_agg_trace(
+                tuple(self._bound_keys), self._key_dtypes(),
+                tuple(self._update_exprs), tuple(self._update_ops),
+                tuple(self._merge_ops), eval_exprs, approx,
+                self.conf.shape_bucket_min, chain_t)
+            caps_t = caps
+
+            def run(all_cols, all_nr, side_args):
+                from ..ops.filter_gather import live_of
+
+                partial_sets = [
+                    update_batch(cols, live_of(nr, cap), cap, side_args)
+                    for cols, nr, cap in zip(all_cols, all_nr, caps_t)
+                ]
+                return finish(partial_sets)
+
+            if len(_AGG_CACHE) > 512:
+                _AGG_CACHE.clear()
+            note_compile_miss("agg_plan")
+            fn = _AGG_CACHE[key] = jax.jit(run)
+        vals, nseg = fn(
+            [vals_of_batch(b) for b in batches],
+            [count_scalar(b.num_rows_lazy) for b in batches], sides)
+        schema = (self._buffer_schema if self.mode == A.PARTIAL
+                  else self._schema)
+        return batch_from_vals(vals, schema, nseg)
+
+    #: fused-plan guard: above this many stacked capacity rows the
+    #: in-trace padded merge's dead-row blowup outweighs the saved
+    #: dispatches, so the per-batch path (and its synced/sync-free merge
+    #: choice) takes over
+    _FUSED_PLAN_MAX_ROWS = 1 << 24
+    #: fused-plan guard: the trace unrolls one update pass per batch and
+    #: the cache key carries every batch's signature — past this many
+    #: batches the compile blowup and near-zero cache reuse beat the
+    #: saved dispatches
+    _FUSED_PLAN_MAX_BATCHES = 16
+    #: fused-plan guard: buffered INPUT batches (which may carry wide
+    #: string columns even when the buffer schema is fixed-width) may pin
+    #: at most this many bytes of device memory before the streaming
+    #: per-batch path takes over
+    _FUSED_PLAN_MAX_BYTES = 2 << 30
 
     # -- execution ---------------------------------------------------------
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
@@ -572,16 +700,57 @@ class TpuHashAggregateExec(TpuExec):
         if fsp is not None and self._can_fuse_stage() and self._stage_fusion_on():
             stage = fsp(index)
             if stage:
-                with timed(self.metrics[TOTAL_TIME]):
+                with self.op_timed("stage"):
                     out = self._run_fused_stage(stage, tuple(chain))
                 yield self.record_batch(out)
                 return
+        # fused-plan buffering is INCREMENTAL: ineligible plans (OFF mode,
+        # string keys/buffers) never buffer raw batches at all, and an
+        # eligible run that outgrows the guards (rows, batch count,
+        # AUTO-on-CPU multi-batch) flushes its buffer into streaming
+        # per-batch updates — peak memory stays one input batch + partials
+        # exactly as round 5, except for the bounded window the fused
+        # program needs.
+        from ..conf import AGG_FUSED_PLAN
+
+        from .base import batch_bytes
+
+        fp_mode = self.conf.get(AGG_FUSED_PLAN)
+        use_fused = fp_mode != "OFF" and self._can_fuse_plan()
+        batches: List[ColumnarBatch] = []
+        cap_sum = 0
+        byte_sum = 0
+
+        def flush_buffered():
+            for b in batches:
+                with self.op_timed("update"):
+                    partials.append(
+                        self._run_batch(b, ops, exprs, tuple(chain)))
+            batches.clear()
+
         for batch in source.execute_partition(index):
             nr = batch.num_rows_lazy
             if isinstance(nr, int) and nr == 0 and self.group_exprs and not chain:
                 continue
-            with timed(self.metrics[TOTAL_TIME]):
-                partials.append(self._run_batch(batch, ops, exprs, tuple(chain)))
+            if not use_fused:
+                with self.op_timed("update"):
+                    partials.append(
+                        self._run_batch(batch, ops, exprs, tuple(chain)))
+                continue
+            batches.append(batch)
+            cap_sum += max(1, batch.capacity if batch.columns else 1)
+            byte_sum += batch_bytes(batch)
+            if (cap_sum > self._FUSED_PLAN_MAX_ROWS
+                    or byte_sum > self._FUSED_PLAN_MAX_BYTES
+                    or len(batches) > self._FUSED_PLAN_MAX_BATCHES
+                    or not self._fused_plan_on(len(batches))):
+                use_fused = False
+                flush_buffered()
+        if use_fused and batches:
+            with self.op_timed("plan"):
+                out = self._run_fused_plan(batches, tuple(chain))
+            yield self.record_batch(out)
+            return
         if not partials:
             if self.group_exprs:
                 return  # grouped aggregate over empty input -> no rows
@@ -591,9 +760,9 @@ class TpuHashAggregateExec(TpuExec):
             zb = ColumnarBatch.from_pydict(
                 {f.name: [] for f in child_schema.fields}, child_schema
             )
-            with timed(self.metrics[TOTAL_TIME]):
+            with self.op_timed("update"):
                 partials = [self._run_batch(zb, ops, exprs)]
-        with timed(self.metrics[TOTAL_TIME]):
+        with self.op_timed("merge"):
             merged = self._merge(partials)
             if self.mode == A.PARTIAL:
                 out = merged

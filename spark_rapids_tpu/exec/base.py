@@ -27,6 +27,43 @@ TOTAL_TIME = "totalTime"
 PEAK_DEVICE_MEMORY = "peakDevMemory"
 NUM_INPUT_ROWS = "numInputRows"
 NUM_INPUT_BATCHES = "numInputBatches"
+#: device-accurate per-op time under metrics.deviceSync.enabled (the
+#: block-until-ready wait for the op's own output; see RapidsConf doc)
+OP_TIME_DEVICE = "opTimeDevice"
+#: output bytes per op: rows x row-bytes from the batch layout
+BYTES_TOUCHED = "bytesTouched"
+
+
+# ---------------------------------------------------------------------------
+# Compile cache-miss accounting (profiler): every pipeline cache in the
+# engine notes its misses here, so a recompile storm (ragged shapes, a
+# fusion key that churns) is visible in explain_metrics() instead of only
+# as mysterious wall-clock (reference contrast: the JVM plugin surfaces
+# cudf JIT compiles in its buildTime metric).
+# ---------------------------------------------------------------------------
+class CompileCounter:
+    __slots__ = ("total", "by_site")
+
+    def __init__(self):
+        self.total = 0
+        self.by_site: Dict[str, int] = {}
+
+    def note(self, site: str) -> None:
+        self.total += 1
+        self.by_site[site] = self.by_site.get(site, 0) + 1
+
+
+COMPILE_COUNTER = CompileCounter()
+
+
+def note_compile_miss(site: str) -> None:
+    COMPILE_COUNTER.note(site)
+
+
+def compile_miss_count() -> int:
+    """Total pipeline-cache misses so far (tests snapshot/diff this to
+    guard against recompile regressions)."""
+    return COMPILE_COUNTER.total
 
 
 _PLANNING = threading.local()
@@ -51,17 +88,36 @@ def in_planning() -> bool:
 
 
 class Metric:
-    __slots__ = ("name", "value")
+    """One named counter. ``kind`` drives explain_metrics() formatting:
+    'ns' (rendered as ms), 'bytes', or 'count'; inferred from the name so
+    lazily-created metrics format like registered ones."""
 
-    def __init__(self, name: str):
+    __slots__ = ("name", "value", "kind")
+
+    def __init__(self, name: str, kind: Optional[str] = None):
         self.name = name
         self.value = 0
+        if kind is None:
+            if "Time" in name or name == TOTAL_TIME:
+                kind = "ns"
+            elif name.startswith("bytes") or name.endswith("Bytes"):
+                kind = "bytes"
+            else:
+                kind = "count"
+        self.kind = kind
 
     def add(self, v: int) -> None:
         self.value += v
 
     def set(self, v: int) -> None:
         self.value = v
+
+    def pretty(self) -> str:
+        if self.kind == "ns":
+            return f"{self.value / 1e6:.1f}ms"
+        if self.kind == "bytes":
+            return f"{self.value / 1e6:.1f}MB"
+        return str(self.value)
 
     def __repr__(self):
         return f"{self.name}={self.value}"
@@ -99,11 +155,15 @@ class TpuExec:
     fusable = False
 
     def __init__(self, conf: RapidsConf, children: Sequence["TpuExec"] = ()):
+        from ..conf import ENABLE_TRACE, METRICS_DEVICE_SYNC
+
         self.conf = conf
         self.children: List[TpuExec] = list(children)
         self.metrics: Dict[str, Metric] = {}
+        self._trace = conf.get(ENABLE_TRACE)
+        self._device_sync = conf.get(METRICS_DEVICE_SYNC)
         for name in (NUM_OUTPUT_ROWS, NUM_OUTPUT_BATCHES, TOTAL_TIME):
-            self.metrics[name] = Metric(name)
+            self._register_metric(name)
 
     # -- contracts ---------------------------------------------------------
     @property
@@ -182,16 +242,46 @@ class TpuExec:
         return node, list(reversed(chain))
 
     # -- conveniences ------------------------------------------------------
-    def metric(self, name: str) -> Metric:
+    def _register_metric(self, name: str, kind: Optional[str] = None) -> Metric:
+        """THE metric construction path — constructor-declared and
+        lazily-created metrics both land here, so every metric carries a
+        kind and shows up in explain_metrics()."""
+        m = Metric(name, kind)
+        self.metrics[name] = m
+        return m
+
+    def metric(self, name: str, kind: Optional[str] = None) -> Metric:
         if name not in self.metrics:
-            self.metrics[name] = Metric(name)
+            return self._register_metric(name, kind)
         return self.metrics[name]
+
+    def op_timed(self, section: str = "", metric_name: str = TOTAL_TIME):
+        """Shared hot-section timer: host wall-clock into ``metric_name``
+        plus a profiler TraceAnnotation named after the exec when
+        sql.trace.enabled is on — EVERY exec wraps its per-batch device
+        work in this (reference: NvtxWithMetrics.scala pairing each hot
+        section with a GpuMetric + NVTX range)."""
+        name = self.node_name + ("." + section if section else "")
+        return timed(self.metric(metric_name), name, self._trace)
 
     def record_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
         nr = batch.num_rows_lazy
+        if self._device_sync:
+            # device-accurate op timing: the wait-for-output fence. With
+            # the conf on plan-wide, inputs were already fenced by the
+            # child's record_batch, so this wait is THIS op's device time
+            # (+ one dispatch) — the CUDA-event-timing analog.
+            t0 = time.perf_counter_ns()
+            jax.block_until_ready(batch_arrays(batch))
+            self.metric(OP_TIME_DEVICE, "ns").add(
+                time.perf_counter_ns() - t0)
+            if not isinstance(nr, int):
+                nr = int(jax.device_get(nr))  # free: buffers are ready
         if isinstance(nr, int):
             self.metrics[NUM_OUTPUT_ROWS].add(nr)
         self.metrics[NUM_OUTPUT_BATCHES].add(1)
+        self.metric(BYTES_TOUCHED, "bytes").add(
+            batch_bytes(batch, nr if isinstance(nr, int) else None))
         return batch
 
     def collect(self) -> List[tuple]:
@@ -217,6 +307,104 @@ class TpuExec:
 
     def __repr__(self):
         return self.tree_string()
+
+
+# ---------------------------------------------------------------------------
+# Profiler plumbing: batch introspection + the explain_metrics report
+# ---------------------------------------------------------------------------
+def batch_arrays(batch: ColumnarBatch) -> List:
+    """Every device buffer a batch owns (the block_until_ready fence set)."""
+    out: List = []
+    for c in batch.columns:
+        if c.is_dict:
+            d = c.dictv
+            out.extend((d.codes, d.dictionary.offsets, d.dictionary.chars,
+                        d.validity))
+        elif c.is_string:
+            out.extend((c.offsets, c.chars, c.validity))
+        else:
+            out.extend((c.data, c.validity))
+    nr = batch.num_rows_lazy
+    if not isinstance(nr, int):
+        out.append(nr)
+    return out
+
+
+def batch_bytes(batch: ColumnarBatch, rows: Optional[int] = None) -> int:
+    """rows x row-bytes from the batch layout: fixed-width columns count
+    their storage width + 1 validity byte per row; strings add 4 offset
+    bytes plus their chars pool; dict columns count 4 code bytes plus the
+    dictionary. ``rows`` falls back to the padded capacity when the row
+    count is still a device scalar (no sync just for accounting)."""
+    import numpy as np
+
+    total = 0
+    for c in batch.columns:
+        r = rows if rows is not None else c.capacity
+        if c.is_dict:
+            d = c.dictv
+            total += r * 5 + int(d.dictionary.chars.shape[0])
+            total += 4 * int(d.dictionary.offsets.shape[0])
+        elif c.is_string:
+            total += r * 5 + int(c.chars.shape[0])
+        else:
+            total += r * (np.dtype(c.data.dtype).itemsize + 1)
+    return total
+
+
+def compile_snapshot() -> tuple:
+    """(total, by_site) snapshot for delta reporting (sessions snapshot
+    before executing a plan so explain_metrics attributes misses to THAT
+    plan, not to everything compiled since process start)."""
+    return COMPILE_COUNTER.total, dict(COMPILE_COUNTER.by_site)
+
+
+def format_metrics(plan: TpuExec, since: Optional[tuple] = None) -> str:
+    """Per-operator metrics report — the profiler's user-facing output
+    (reference: the SQL-UI metric table GpuExec publishes per node). One
+    line per exec with its metrics prettied by kind; opTimeDevice rows add
+    a derived HBM GB/s (bytesTouched / opTimeDevice) so bandwidth-bound
+    ops are visible at a glance; a footer reports pipeline-cache compile
+    misses by site (relative to the ``since`` compile_snapshot when
+    given)."""
+    lines: List[str] = []
+
+    def walk(node: TpuExec, depth: int) -> None:
+        parts = []
+        for m in node.metrics.values():
+            if m.value:
+                parts.append(f"{m.name}={m.pretty()}")
+        dev = node.metrics.get(OP_TIME_DEVICE)
+        byt = node.metrics.get(BYTES_TOUCHED)
+        if dev is not None and dev.value and byt is not None:
+            # bandwidth the op actually demanded: its INPUT stream (the
+            # children's output bytes) plus its own output — output alone
+            # would misdiagnose a reducing op (an aggregate streaming GBs
+            # into 100 group rows) as latency-bound
+            in_bytes = sum(
+                c.metrics[BYTES_TOUCHED].value
+                for c in node.children if BYTES_TOUCHED in c.metrics
+            )
+            io_bytes = byt.value + in_bytes
+            if io_bytes:
+                parts.append(f"hbm_gbps={io_bytes / dev.value:.2f}")
+        lines.append("  " * depth + node.describe()
+                     + (": " + ", ".join(parts) if parts else ""))
+        for c in node.children:
+            walk(c, depth + 1)
+
+    walk(plan, 0)
+    base_total, base_sites = (0, {}) if since is None else since
+    total = COMPILE_COUNTER.total - base_total
+    deltas = {
+        k: v - base_sites.get(k, 0)
+        for k, v in COMPILE_COUNTER.by_site.items()
+        if v - base_sites.get(k, 0)
+    }
+    sites = ", ".join(f"{k}={v}" for k, v in sorted(deltas.items()))
+    lines.append(f"compile cache misses: {total}"
+                 + (f" ({sites})" if sites else ""))
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------
@@ -312,6 +500,7 @@ def fused_pipeline(chain: Sequence[TpuExec], sig: tuple, cap: int,
 
         if len(_FUSED_CACHE) > 1024:
             _FUSED_CACHE.clear()
+        note_compile_miss("fused_chain")
         fn = _FUSED_CACHE[key] = jax.jit(run)
     return fn
 
@@ -324,11 +513,14 @@ def run_fused_chain(exec_self: TpuExec, index: int) -> Iterator[ColumnarBatch]:
     out_schema = exec_self.output_schema
     sides = [e.side_vals() for e in chain]
     for batch in source.execute_partition(index):
-        cap = batch.capacity if batch.columns else 128
-        fn = fused_pipeline(chain, batch_signature(batch), cap, sides)
-        vals, nr = fn(
-            vals_of_batch(batch), count_scalar(batch.num_rows_lazy), sides)
-        yield exec_self.record_batch(batch_from_vals(vals, out_schema, nr))
+        with exec_self.op_timed():
+            cap = batch.capacity if batch.columns else 128
+            fn = fused_pipeline(chain, batch_signature(batch), cap, sides)
+            vals, nr = fn(
+                vals_of_batch(batch), count_scalar(batch.num_rows_lazy),
+                sides)
+            out = batch_from_vals(vals, out_schema, nr)
+        yield exec_self.record_batch(out)
 
 
 def batch_signature(batch: ColumnarBatch) -> tuple:

@@ -14,7 +14,6 @@ TPU re-design notes:
 """
 from __future__ import annotations
 
-import functools
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -23,7 +22,7 @@ import jax.numpy as jnp
 from .. import types as T
 from ..columnar import ColumnarBatch, DeviceColumn
 from ..columnar.column import column_from_pylist
-from ..conf import ENABLE_TRACE, MAX_READER_BATCH_SIZE_ROWS, RapidsConf
+from ..conf import MAX_READER_BATCH_SIZE_ROWS, RapidsConf
 from ..expr import expressions as E
 from ..expr.eval import ColV, StrV, lower
 from ..ops import concat as concat_ops
@@ -33,11 +32,9 @@ from ..utils.bucketing import bucket_rows
 from .base import (
     NUM_OUTPUT_BATCHES,
     NUM_OUTPUT_ROWS,
-    TOTAL_TIME,
     TpuExec,
     batch_from_vals,
     batch_signature,
-    timed,
     vals_of_batch,
 )
 
@@ -92,12 +89,24 @@ class InMemoryScanExec(TpuExec):
         return InMemoryScanExec(conf, chunks, schema)
 
 
-@functools.lru_cache(maxsize=512)
-def _project_pipeline(exprs: Tuple[E.Expression, ...], sig: tuple, cap: int):
-    def run(cols):
-        return [lower(e, cols, cap) for e in exprs]
+_PROJECT_CACHE: dict = {}
 
-    return jax.jit(run)
+
+def _project_pipeline(exprs: Tuple[E.Expression, ...], sig: tuple, cap: int):
+    key = (exprs, sig, cap)
+    fn = _PROJECT_CACHE.get(key)
+    if fn is None:
+        if len(_PROJECT_CACHE) > 512:
+            _PROJECT_CACHE.clear()
+        from .base import note_compile_miss
+
+        note_compile_miss("project")
+
+        def run(cols):
+            return [lower(e, cols, cap) for e in exprs]
+
+        fn = _PROJECT_CACHE[key] = jax.jit(run)
+    return fn
 
 
 class TpuProjectExec(TpuExec):
@@ -159,13 +168,15 @@ class TpuProjectExec(TpuExec):
         return [lower(e, cols, cap) for e in self._bound], live
 
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        # per-batch timing/tracing happens inside run_fused_chain /
+        # _execute_with_context (an outer wrapper here would also bill the
+        # CONSUMER's time between yields to this exec)
         from .base import run_fused_chain
 
-        with timed(self.metrics[TOTAL_TIME], "TpuProject", self.conf.get(ENABLE_TRACE)):
-            if self._ctx_exprs:
-                yield from self._execute_with_context(index)
-            else:
-                yield from run_fused_chain(self, index)
+        if self._ctx_exprs:
+            yield from self._execute_with_context(index)
+        else:
+            yield from run_fused_chain(self, index)
 
     # -- partition-context evaluation --------------------------------------
     def _source_file(self, index: int) -> str:
@@ -247,18 +258,19 @@ class TpuProjectExec(TpuExec):
         fpath = self._source_file(index)
         row_base = 0
         for batch in child.execute_partition(index):
-            cap = batch.capacity if batch.columns else 128
-            extra_cols, extra_fields = self._ctx_columns(
-                batch, index, row_base, cap, fpath)
-            ext = ColumnarBatch(
-                list(batch.columns) + extra_cols,
-                StructType(tuple(child_schema.fields) + tuple(extra_fields)),
-                batch.num_rows_lazy)
-            fn = _project_pipeline(
-                rewritten, batch_signature(ext), cap)
-            vals = fn(vals_of_batch(ext))
-            yield self.record_batch(
-                batch_from_vals(vals, self._schema, batch.num_rows_lazy))
+            with self.op_timed("ctx"):
+                cap = batch.capacity if batch.columns else 128
+                extra_cols, extra_fields = self._ctx_columns(
+                    batch, index, row_base, cap, fpath)
+                ext = ColumnarBatch(
+                    list(batch.columns) + extra_cols,
+                    StructType(tuple(child_schema.fields) + tuple(extra_fields)),
+                    batch.num_rows_lazy)
+                fn = _project_pipeline(
+                    rewritten, batch_signature(ext), cap)
+                vals = fn(vals_of_batch(ext))
+                out = batch_from_vals(vals, self._schema, batch.num_rows_lazy)
+            yield self.record_batch(out)
             nr = batch.num_rows_lazy
             row_base = (row_base + nr if isinstance(nr, int)
                         and isinstance(row_base, int)
@@ -296,8 +308,7 @@ class TpuFilterExec(TpuExec):
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
         from .base import run_fused_chain
 
-        with timed(self.metrics[TOTAL_TIME]):
-            yield from run_fused_chain(self, index)
+        yield from run_fused_chain(self, index)
 
 
 class TpuRangeExec(TpuExec):
@@ -465,7 +476,7 @@ class TpuExpandExec(TpuExec):
             sig = batch_signature(batch)
             vals_in = vals_of_batch(batch)
             for bound in self._bound:
-                with timed(self.metrics[TOTAL_TIME]):
+                with self.op_timed():
                     fn = _project_pipeline(bound, sig, cap)
                     vals = fn(vals_in)
                     out = batch_from_vals(vals, self._schema, batch.num_rows)
@@ -526,12 +537,12 @@ class TpuCoalesceBatchesExec(TpuExec):
             pending.append(batch)
             rows += batch.num_rows
             if rows >= self.target_rows:
-                with timed(self.metrics[TOTAL_TIME]):
+                with self.op_timed():
                     out = self._flush(pending)
                 pending, rows = [], 0
                 if out is not None:
                     yield self.record_batch(out)
-        with timed(self.metrics[TOTAL_TIME]):
+        with self.op_timed():
             out = self._flush(pending)
         if out is not None:
             yield self.record_batch(out)

@@ -337,7 +337,7 @@ class TpuShuffleExchangeExec(TpuExec):
                 )
             P = self.num_partitions
             self.partition_rows = [0] * P
-            with timed(self.metrics[TOTAL_TIME]):
+            with self.op_timed():
                 for map_id, batch in batch_iter:
                     if not batch.columns:
                         continue

@@ -474,9 +474,11 @@ class TpuShuffledHashJoinExec(TpuExec):
 
                 # join expansion repeats rows: dict columns materialize
                 # up front (their byte bound only covers row subsets)
-                pbatch = materialized_batch(pbatch)
-                out = self._probe_batch(
-                    pbatch, build_cols, build_words, build_count, build_cap)
+                with self.op_timed("probe"):
+                    pbatch = materialized_batch(pbatch)
+                    out = self._probe_batch(
+                        pbatch, build_cols, build_words, build_count,
+                        build_cap)
                 if out is None:
                     continue
                 batch, matched = out
@@ -610,6 +612,9 @@ class TpuShuffledHashJoinExec(TpuExec):
         if cache is None:
             cache = self._jits = {}
         if key not in cache:
+            from .base import note_compile_miss
+
+            note_compile_miss("join")
             cache[key] = jax.jit(fn)
         return cache[key]
 
@@ -715,9 +720,13 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                 cache = self._jits = {}
             key = (batch_signature(pbatch), out_cap, np_, nb)
             if key not in cache:
+                from .base import note_compile_miss
+
+                note_compile_miss("join")
                 cache[key] = jax.jit(expand)
-            vals, count = cache[key](vals_of_batch(pbatch), build_vals)
-            n = int(count)
+            with self.op_timed():
+                vals, count = cache[key](vals_of_batch(pbatch), build_vals)
+                n = int(count)
             if n:
                 yield self.record_batch(batch_from_vals(vals, self._schema, n))
 

@@ -54,7 +54,7 @@ from ..parallel.mesh import AXIS, get_mesh, row_sharding
 from ..types import StructField, StructType
 from ..utils.bucketing import bucket_rows
 from . import aggregate as XA
-from .base import TOTAL_TIME, TpuExec, timed
+from .base import TpuExec
 
 P = jax.sharding.PartitionSpec
 
@@ -256,7 +256,7 @@ class _MeshStage(TpuExec):
 
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
         if self._outputs is None:
-            with timed(self.metrics[TOTAL_TIME]):
+            with self.op_timed():
                 self._materialize()
         b = self._outputs[index]
         if b is not None and b.num_rows > 0:

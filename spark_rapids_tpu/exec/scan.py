@@ -18,7 +18,7 @@ from ..columnar.column import DeviceColumn
 from ..conf import RapidsConf
 from ..types import StructType
 from ..utils.bucketing import bucket_rows
-from .base import TOTAL_TIME, TpuExec, timed
+from .base import TpuExec
 
 SCAN_TIME = "scanTime"  # reference metric name (GpuMetricNames)
 DECODE_TIME = "tpuDecodeTime"
@@ -119,7 +119,7 @@ class TpuFileSourceScanExec(TpuExec):
         fn = getattr(self.scanner, "device_stage_plans", None)
         if fn is None:
             return None
-        with timed(self.metrics[SCAN_TIME]):
+        with self.op_timed("plan", SCAN_TIME):
             return fn(index)
 
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
@@ -131,16 +131,16 @@ class TpuFileSourceScanExec(TpuExec):
         # GpuParquetScan.scala:1157): host uploads encoded bytes, XLA
         # kernels expand dictionary/RLE pages on-device
         if hasattr(self.scanner, "read_split_device"):
-            with timed(self.metrics[DECODE_TIME]):
+            with self.op_timed("decode", DECODE_TIME):
                 dev, pvals = self.scanner.read_split_device(index)
             if dev is not None:
                 for b in dev:
                     yield self.record_batch(
                         self._attach_partition_cols(b, pvals))
                 return
-        with timed(self.metrics[SCAN_TIME]):
+        with self.op_timed("read", SCAN_TIME):
             table, pvals = self._read_split(index)
-        with timed(self.metrics[DECODE_TIME]):
+        with self.op_timed("decode", DECODE_TIME):
             schema = self.output_schema
             # the schema only carries the partition keys common to every
             # file (scanner.partition_cols); a split may report extra keys

@@ -236,8 +236,11 @@ class TpuWindowExec(TpuExec):
 
         key = (batch_signature(batch), cap, sml)
         if key not in self._jits:
+            from .base import note_compile_miss
+
+            note_compile_miss("window")
             self._jits[key] = jax.jit(run)
-        with timed(self.metrics[TOTAL_TIME]):
+        with self.op_timed():
             vals = self._jits[key](
                 vals_of_batch(batch), count_scalar(batch.num_rows_lazy))
         yield self.record_batch(

@@ -119,7 +119,7 @@ def _decimal_to_int64(arr, dt: T.DecimalType) -> np.ndarray:
 _UNPACK_CACHE: dict = {}
 
 
-def _packed_upload(host_arrays: List[np.ndarray]):
+def packed_upload(host_arrays: List[np.ndarray]):
     """Stage every buffer into ONE host byte buffer, upload in ONE
     transfer, and split/bitcast device-side in ONE jitted program.
 
@@ -145,8 +145,16 @@ def _packed_upload(host_arrays: List[np.ndarray]):
     key = tuple(layout)
     fn = _UNPACK_CACHE.get(key)
     if fn is None:
+        # NOTE: one unpack program per distinct (offset, length, dtype)
+        # layout — ragged row-group layouts (e.g. per-group dictionary
+        # sizes) each compile once, the same churn rate as the decode
+        # programs keyed on the same lengths; the miss counter makes it
+        # visible in explain_metrics() instead of silent
         if len(_UNPACK_CACHE) > 512:
             _UNPACK_CACHE.clear()
+        from ..exec.base import note_compile_miss
+
+        note_compile_miss("upload_unpack")
 
         def unpack(b):
             outs = []
@@ -213,7 +221,7 @@ def arrow_to_batch(table_or_rb, schema: Optional[T.StructType] = None,
             v[:n] = validity
             staged.extend([d, v])
             plans.append(("f", dt))
-    devs = _packed_upload(staged)
+    devs = packed_upload(staged)
     cols: List[DeviceColumn] = []
     i = 0
     for kind, dt in plans:

@@ -614,7 +614,7 @@ def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int,
 
     if has_def:
         vwords = _pack_validity_words(plan.validity)
-        args.append(jnp.asarray(vwords))
+        args.append(np.ascontiguousarray(vwords))
         key.append(int(vwords.shape[0]))
     if is_dict:
         # all-null chunks can carry an EMPTY dictionary: pad one zero slot
@@ -628,7 +628,7 @@ def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int,
         if codes.shape[0] < pcap:
             codes = np.concatenate(
                 [codes, np.zeros(pcap - codes.shape[0], codes.dtype)])
-        args.append(jnp.asarray(codes))
+        args.append(np.ascontiguousarray(codes))
         key += [str(codes.dtype), pcap]
         if is_str:
             D = plan.dict_offsets.shape[0] - 1
@@ -641,15 +641,15 @@ def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int,
             ) if plan.codes.shape[0] else 0
             ccap = bucket_rows(max(1, total_bytes), 128)
             max_len = int(lens.max()) if D > 0 and lens.size else 0
-            args += [jnp.asarray(plan.dict_offsets.astype(np.int32)),
-                     jnp.asarray(plan.dict_chars)]
+            args += [np.ascontiguousarray(plan.dict_offsets.astype(np.int32)),
+                     np.ascontiguousarray(plan.dict_chars)]
             key += [D, int(plan.dict_chars.shape[0]), ccap, max_len]
         else:
-            args.append(jnp.asarray(plan.dict_values))
+            args.append(np.ascontiguousarray(plan.dict_values))
             key += [int(plan.dict_values.shape[0])]
     else:
         words = _np_plain_words(plan)
-        args.append(jnp.asarray(words))
+        args.append(np.ascontiguousarray(words))
         key.append(int(words.shape[0]))
 
     phys = plan.phys
@@ -724,19 +724,44 @@ def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int,
     return args, tuple(key), run
 
 
-def chunk_to_device_column(plan: ChunkPlan, dtype_tpu, cap: int,
-                           dict_strings: bool = False):
-    """Upload a ChunkPlan's payloads and expand to a DeviceColumn in ONE
-    jitted program (per structural cache key)."""
+def stage_decode_args(per_col_args: Sequence[Sequence[np.ndarray]]):
+    """Coalesce EVERY column's decode payloads (codes, validity words,
+    dictionaries, plain words) into ONE host staging buffer and cross the
+    host link in ONE transfer per row group, split/bitcast device-side by
+    one jitted program — instead of one upload per buffer per column.
+    Profiler-motivated (see docs/tuning.md): the parquet shape's scan time
+    was dominated by per-buffer dispatch latency, ~3 buffers x N columns
+    transfers per row group. Reference analog: the single
+    HostMemoryBuffer the coalescing reader stitches before one cudf
+    upload (GpuParquetScan.scala:880-900)."""
+    from .arrow_convert import packed_upload
+
+    flat = [a for args in per_col_args for a in args]
+    if not flat:
+        return [list(args) for args in per_col_args]
+    devs = packed_upload(flat)
+    out = []
+    i = 0
+    for args in per_col_args:
+        out.append(list(devs[i: i + len(args)]))
+        i += len(args)
+    return out
+
+
+def _run_decode(plan: ChunkPlan, dtype_tpu, key_t, run, dev_args):
+    """Dispatch one column's cached decode program over its (already
+    uploaded) args and wrap the result as a DeviceColumn."""
     import jax
 
-    args, key_t, run = plan_decode(plan, dtype_tpu, cap, dict_strings)
     fn = _DECODE_CACHE.get(key_t)
     if fn is None:
         if len(_DECODE_CACHE) > 512:
             _DECODE_CACHE.clear()
+        from ..exec.base import note_compile_miss
+
+        note_compile_miss("pq_decode")
         fn = _DECODE_CACHE[key_t] = jax.jit(run)
-    out = fn(args)
+    out = fn(dev_args)
     from ..columnar.column import DeviceColumn
     from ..expr.values import DictV
 
@@ -748,6 +773,15 @@ def chunk_to_device_column(plan: ChunkPlan, dtype_tpu, cap: int,
         return DeviceColumn(dtype_tpu, n, None, validity, offsets, chars)
     data, validity = out
     return DeviceColumn(dtype_tpu, n, data, validity)
+
+
+def chunk_to_device_column(plan: ChunkPlan, dtype_tpu, cap: int,
+                           dict_strings: bool = False):
+    """Upload a ChunkPlan's payloads (one staged transfer) and expand to a
+    DeviceColumn in ONE jitted program (per structural cache key)."""
+    args, key_t, run = plan_decode(plan, dtype_tpu, cap, dict_strings)
+    dev_args = stage_decode_args([args])[0]
+    return _run_decode(plan, dtype_tpu, key_t, run, dev_args)
 
 
 # ---------------------------------------------------------------------------
@@ -825,11 +859,16 @@ def row_group_device_plans(
         path, pf, rgmd, pqschema, name_to_ci, columns, file_bytes)
     if fallback_cols or len(plans) != len(columns):
         return None
-    entries = []
+    staged = []
     for name, f in zip(columns, tpu_fields):
         args, key, run = plan_decode(plans[name], f.dataType, cap,
                                      dict_strings)
-        entries.append((args, key, run, f))
+        staged.append((args, key, run, f))
+    # ONE host->device transfer for the whole row group's payloads
+    dev_args = stage_decode_args([s[0] for s in staged])
+    entries = [
+        (da, key, run, f) for da, (_, key, run, f) in zip(dev_args, staged)
+    ]
     return n, cap, entries
 
 
@@ -864,12 +903,23 @@ def read_row_group_device(
 
     from .arrow_convert import arrow_to_batch
 
-    cols = []
-    fields = []
+    # decode-plan every device column first, then cross the host link in
+    # ONE staged transfer for the whole row group (stage_decode_args)
+    decoded: Dict[str, tuple] = {}
     for name, f in zip(columns, tpu_fields):
         if name in plans:
-            cols.append(chunk_to_device_column(
-                plans[name], f.dataType, cap, dict_strings))
+            args, key_t, run = plan_decode(
+                plans[name], f.dataType, cap, dict_strings)
+            decoded[name] = (args, key_t, run, f)
+    dev_args = stage_decode_args([v[0] for v in decoded.values()])
+
+    cols = []
+    fields = []
+    dev_iter = iter(zip(decoded.values(), dev_args))
+    for name, f in zip(columns, tpu_fields):
+        if name in plans:
+            (_, key_t, run, _), da = next(dev_iter)
+            cols.append(_run_decode(plans[name], f.dataType, key_t, run, da))
             fields.append(f)
         else:
             sub = host_table.select([name])

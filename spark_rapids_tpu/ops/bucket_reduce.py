@@ -36,6 +36,12 @@ _HI = jax.lax.Precision.HIGHEST
 #: (differential tests diff it against the scatter lowering)
 FORCE_MATMUL = False
 
+#: test hook: run every reduction ONE COLUMN AT A TIME instead of fusing
+#: all columns into a single limb-matmul / scatter family — the
+#: differential baseline the fused path is diffed against (same spirit as
+#: FORCE_MATMUL: a lowering switch, never a semantics switch)
+FORCE_PER_COLUMN = False
+
 
 def _use_scatter() -> bool:
     """Backend-adaptive lowering choice (trace-time static, so each jit
@@ -93,13 +99,43 @@ def bucket_reduce(
     count_cols: Sequence[jax.Array] = (),
     float_cols: Sequence[Tuple[jax.Array, jax.Array]] = (),
 ) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
-    """All requested reductions in one fused matmul pass.
+    """ALL requested reductions across ALL columns in one fused pass.
+
+    Multi-column fusion is the point: every column's limbs stack into one
+    ``(n, L_total)`` operand (8 int limbs + 1 count limb + 2 float limbs
+    per column) so a single one-hot matmul per row-block serves the whole
+    aggregate plan — the contraction over ``n`` is shared and the MXU sees
+    one wide matmul instead of C narrow ones. The CPU lowering fuses the
+    same way: one batched scatter per dtype family, not one per column.
+    ``FORCE_PER_COLUMN`` is the differential baseline (one pass per
+    column) that tests diff this fusion against.
 
     seg: (n,) int32 bucket ids; ids >= B are dropped.
     int_cols:   [(data int64/int32, valid bool)] -> exact int64 sums (B,)
     count_cols: [valid bool] -> int64 counts (B,)
     float_cols: [(data f64/f32, valid bool)] -> f64 sums (B,) (hi/lo split)
     """
+    if FORCE_PER_COLUMN:
+        out_int: List[jax.Array] = []
+        out_cnt: List[jax.Array] = []
+        out_flt: List[jax.Array] = []
+        for spec in int_cols:
+            out_int += _bucket_reduce_pass(seg, B, [spec], (), ())[0]
+        for valid in count_cols:
+            out_cnt += _bucket_reduce_pass(seg, B, (), [valid], ())[1]
+        for spec in float_cols:
+            out_flt += _bucket_reduce_pass(seg, B, (), (), [spec])[2]
+        return out_int, out_cnt, out_flt
+    return _bucket_reduce_pass(seg, B, int_cols, count_cols, float_cols)
+
+
+def _bucket_reduce_pass(
+    seg: jax.Array,
+    B: int,
+    int_cols: Sequence[Tuple[jax.Array, jax.Array]] = (),
+    count_cols: Sequence[jax.Array] = (),
+    float_cols: Sequence[Tuple[jax.Array, jax.Array]] = (),
+) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
     if _use_scatter():
         return _bucket_reduce_scatter(seg, B, int_cols, count_cols, float_cols)
     n = seg.shape[0]
@@ -175,6 +211,25 @@ def bucket_reduce(
         out_flt.append(acc_f[k] + acc_f[k + 1] + corr)
         k += 2
     return out_int, out_cnt, out_flt
+
+
+def bucket_min_max(
+    seg: jax.Array, B: int, op: str, cols: Sequence[jax.Array]
+) -> List[jax.Array]:
+    """Per-bucket min/max for ALL columns of one (op, dtype) family in ONE
+    segment scatter — the scatter-side analog of the fused limb matmul:
+    the near-serial walk over ``seg`` (the expensive part on TPU) happens
+    once per family instead of once per column. ``cols`` are (n,) arrays
+    of one dtype, already masked to the op's identity fill by the caller
+    (invalid/dead rows hold +/-inf, dtype extremes, etc. so they never
+    win); callers overwrite empty buckets via their count mask. Returns
+    (B,) arrays aligned with ``cols``."""
+    fn = jax.ops.segment_max if op == "max" else jax.ops.segment_min
+    if FORCE_PER_COLUMN or len(cols) == 1:
+        return [fn(d, seg, num_segments=B) for d in cols]
+    stacked = jnp.stack(cols, axis=-1)  # (n, C)
+    r = fn(stacked, seg, num_segments=B)  # (B, C)
+    return [r[:, i] for i in range(len(cols))]
 
 
 def bucket_lookup_u32(

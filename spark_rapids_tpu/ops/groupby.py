@@ -382,10 +382,13 @@ def hash_groupby(
         bucket = _hash_buckets(None)
     seg = jnp.where(live, bucket, B)  # out-of-range ids drop out everywhere
 
-    # --- reductions (all sums/counts in ONE matmul pass) ----------------
+    # --- reductions (all sums/counts of EVERY column in ONE matmul pass;
+    # min/max batched into one scatter family per (op, dtype), their
+    # nullability counts riding the same matmul) -------------------------
     int_specs, cnt_specs, flt_specs = [], [], []
     plan = []  # per agg: (path, payload)
     cnt_index: dict = {}
+    mm_fam: dict = {}  # (op, dtype) -> [filled (n,) columns]
 
     def _want_count(valid_arr, key):
         if key not in cnt_index:
@@ -411,11 +414,47 @@ def hash_groupby(
             # exact float sum: one scatter op; nullability via matmul count
             ci = _want_count(v.validity & live, ("c", ai))
             plan.append(("fsum_exact", (v, ci)))
+        elif op in ("min", "max"):
+            # fill dead/invalid rows with the op's identity so they never
+            # win, then batch all columns of one (op, dtype) family into a
+            # single segment scatter (ops/bucket_reduce.bucket_min_max);
+            # semantics mirror segment_reduce exactly, incl. Spark's
+            # NaN-is-largest max and NaN-skipping min
+            valid = v.validity & live
+            data = v.data
+            ci = _want_count(valid, ("c", ai))
+            nn_ci = None
+            if jnp.issubdtype(data.dtype, jnp.floating):
+                if op == "max":
+                    d = jnp.where(valid, data,
+                                  jnp.array(-jnp.inf, data.dtype))
+                else:
+                    nn_ci = _want_count(valid & ~jnp.isnan(data), ("nn", ai))
+                    nan_as_inf = jnp.where(jnp.isnan(data), jnp.inf, data)
+                    d = jnp.where(valid, nan_as_inf,
+                                  jnp.inf).astype(data.dtype)
+            elif data.dtype == jnp.bool_:
+                d = jnp.where(valid, data, jnp.array(op == "min", jnp.bool_))
+            else:
+                lo, hi = _INT_MIN_MAX.get(jnp.dtype(data.dtype), (0, 1))
+                d = jnp.where(valid, data,
+                              jnp.array(lo if op == "max" else hi,
+                                        data.dtype))
+            fam = mm_fam.setdefault((op, jnp.dtype(d.dtype)), [])
+            plan.append(("minmax", (op, jnp.dtype(d.dtype), len(fam),
+                                    ci, nn_ci)))
+            fam.append(d)
         else:
-            plan.append(("scatter", (op, v)))
+            plan.append(("scatter", (op, v)))  # first/last
+
+    from .bucket_reduce import bucket_min_max
 
     isums, counts, fsums = bucket_reduce(
         seg, B, int_specs, cnt_specs, flt_specs)
+    mm_results = {
+        k: bucket_min_max(seg, B, k[0], cols_)
+        for k, cols_ in mm_fam.items()
+    }
     occupied = counts[live_count_i] > 0
     ngroups = jnp.sum(occupied.astype(jnp.int32)).astype(jnp.int32)
 
@@ -513,6 +552,15 @@ def hash_groupby(
             sm = jax.ops.segment_sum(
                 jnp.where(sv.validity & live, sv.data, z), seg, num_segments=B)
             out_aggs.append(to_slots(sm, counts[ci] > 0))
+        elif kind == "minmax":
+            mop, mdt, fi, ci, nn_ci = payload
+            r = mm_results[(mop, mdt)][fi]
+            has = counts[ci] > 0
+            if nn_ci is not None:
+                # all-NaN group: min skips NaN unless nothing else exists
+                r = jnp.where((counts[nn_ci] == 0) & has, jnp.nan, r)
+            r = jnp.where(has, r, jnp.zeros((), r.dtype))
+            out_aggs.append(to_slots(r, has))
         else:
             sop, sv = payload
             r = segment_reduce(sop, sv, seg, B, live)

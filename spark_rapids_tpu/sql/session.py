@@ -246,13 +246,37 @@ class TpuSession:
 
     # -- execution ---------------------------------------------------------
     def _execute(self, node: LNode) -> C.CpuExec:
+        from ..exec.base import compile_snapshot
+
         cpu = _lower(node, self.conf)
         self.last_cpu_plan = cpu
         final, is_tpu = self.overrides.apply(cpu)
         if is_tpu:
             final = ColumnarToRowExec(self.conf, final)
         self.last_executed_plan = final
+        # snapshot BEFORE execution so explain_metrics reports only the
+        # misses THIS plan's run compiled (the counter is process-global)
+        self._compile_baseline = compile_snapshot()
         return final
+
+    def explain_metrics(self) -> str:
+        """Per-operator metrics report for the LAST executed plan — the
+        profiler's user-facing output (reference analog: the SQL-UI metric
+        table each GpuExec publishes). Every exec line shows wall-clock
+        totalTime, output rows/batches, and bytesTouched; runs under
+        spark.rapids.tpu.metrics.deviceSync.enabled add device-accurate
+        opTimeDevice and a derived per-op HBM GB/s. The footer counts XLA
+        pipeline compile-cache misses by site for THIS plan's run (a
+        recompile-storm detector). How to read it: docs/tuning.md."""
+        from ..exec.base import TpuExec, format_metrics
+
+        plan = self.last_executed_plan
+        if plan is None:
+            return "<no plan executed yet>"
+        node = plan.tpu_child if isinstance(plan, ColumnarToRowExec) else plan
+        if not isinstance(node, TpuExec):
+            return "<last plan ran on CPU; no device metrics>"
+        return format_metrics(node, getattr(self, "_compile_baseline", None))
 
 
 class GroupedData:
