@@ -323,6 +323,22 @@ ORC_ENABLED = conf(
     "spark.rapids.tpu.sql.format.orc.enabled", True,
     "Enable TPU ORC scan (per-stripe splits via the host arrow reader).")
 
+MATRIX_PROBE_CROSS_CHECK = conf(
+    "spark.rapids.tpu.sql.matrix.probeCrossCheck.enabled", False,
+    "Debug: run the legacy abstract-trace lowering probe alongside the "
+    "static type-support matrix (plugin/typechecks.py) during plan "
+    "tagging and record every verdict disagreement. The matrix is the "
+    "primary tagging mechanism; when this is on, a probe-only failure is "
+    "conservatively added to the fallback reasons and the disagreement "
+    "is kept in typechecks.cross_check_log() for inspection.")
+LINT_ALLOWLIST_PATH = conf(
+    "spark.rapids.tpu.tools.lint.allowlistPath", "tools/tpu_lint_allow.txt",
+    "Path (relative to the repo root) of the tracing-hazard lint's "
+    "allowlist file — the documented legitimate host-sync sites "
+    "tools/tpu_lint.py accepts (one 'path::qualname::RULE  # why' per "
+    "line). Read by the lint TOOL at startup (override per run with "
+    "--allowlist=); not a per-session runtime setting.")
+
 # ---------------------------------------------------------------------------
 # Test hooks (reference: RapidsConf 'test' keys)
 # ---------------------------------------------------------------------------

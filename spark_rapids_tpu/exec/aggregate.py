@@ -52,16 +52,18 @@ def _agg_pipeline(
     str_max_lens: Tuple[int, ...],
     approx_float_sum: bool = False,
     sides: Sequence[tuple] = (),
+    str_val_max_lens: Tuple[int, ...] = (),
 ):
     """ONE fused program: child chain (filter/project/join probe...),
     key+input projection, groupby reduce — a whole query stage per
-    dispatch."""
+    dispatch. ``str_val_max_lens``: static byte bound per string-typed
+    min/max input, in order (drives the rank sort's chunk count)."""
     from .base import side_signature
 
     key = (
         tuple(e.fusion_key() for e in chain), key_exprs, key_dtypes,
         value_exprs, ops, sig, cap, str_max_lens, approx_float_sum,
-        side_signature(sides),
+        side_signature(sides), str_val_max_lens,
     )
     fn = _AGG_CACHE.get(key)
     if fn is not None:
@@ -82,8 +84,10 @@ def _agg_pipeline(
             return groupby_ops.groupby_agg(
                 keys, list(key_dtypes), vals, list(ops), live, str_max_lens,
                 approx_float_sum=approx_float_sum,
+                str_val_max_lens=str_val_max_lens,
             )
-        outs = groupby_ops.reduce_no_keys(vals, list(ops), live)
+        outs = groupby_ops.reduce_no_keys(
+            vals, list(ops), live, str_val_max_lens=str_val_max_lens)
         return [], outs, jnp.int32(1)
 
     if len(_AGG_CACHE) > 512:
@@ -288,15 +292,17 @@ class TpuHashAggregateExec(TpuExec):
     def _key_dtypes(self) -> Tuple[T.DataType, ...]:
         return tuple(f.dataType for f in self._key_fields)
 
-    def _str_max_lens(self, batch: ColumnarBatch, direct: bool) -> Tuple[int, ...]:
-        """Static byte-length buckets for string group keys (host sync only
-        when string keys exist). ``direct``: batch columns match the bound
-        key ordinals; otherwise (a fused chain below) any string key passed
-        through from a source string column, so the max over all source
-        string columns is a safe bound."""
+    def _exprs_str_max_lens(self, exprs, batch: ColumnarBatch,
+                            direct: bool) -> Tuple[int, ...]:
+        """Static byte-length buckets for the string-typed expressions in
+        ``exprs`` (host sync only when plain string columns exist).
+        ``direct``: batch columns match the bound ordinals; otherwise (a
+        fused chain below) any string passed through from a source string
+        column, so the max over all source string columns is a safe
+        bound."""
         lens = []
         source_max = None
-        for b in self._bound_keys:
+        for b in exprs:
             if isinstance(b.dtype, (T.StringType, T.BinaryType)):
                 if direct and isinstance(b, E.BoundReference):
                     col = batch.columns[b.ordinal]
@@ -319,6 +325,10 @@ class TpuHashAggregateExec(TpuExec):
                 lens.append(max(4, bucket_rows(max(1, m), 4)))
         return tuple(lens)
 
+    def _str_max_lens(self, batch: ColumnarBatch, direct: bool) -> Tuple[int, ...]:
+        """Static byte-length buckets for string group keys."""
+        return self._exprs_str_max_lens(self._bound_keys, batch, direct)
+
     def _run_batch(self, batch: ColumnarBatch, ops: Sequence[str],
                    value_exprs: Sequence[Optional[E.Expression]],
                    chain=(), live=None) -> ColumnarBatch:
@@ -330,6 +340,15 @@ class TpuHashAggregateExec(TpuExec):
         cap = batch.capacity if batch.columns else bucket_rows(
             batch.num_rows, self.conf.shape_bucket_min)
         sml = self._str_max_lens(batch, direct=not chain)
+        # string-typed min/max inputs need a static byte bound for the
+        # rank sort (one per such input, in op order)
+        minmax_strs = [
+            e for op, e in zip(ops, value_exprs)
+            if op in ("min", "max") and e is not None
+            and isinstance(e.dtype, (T.StringType, T.BinaryType))
+        ]
+        svml = self._exprs_str_max_lens(minmax_strs, batch,
+                                        direct=not chain)
         from ..conf import IMPROVED_FLOAT_OPS
 
         sides = [e.side_vals() for e in chain]
@@ -337,7 +356,7 @@ class TpuHashAggregateExec(TpuExec):
             chain, tuple(self._bound_keys), self._key_dtypes(),
             tuple(value_exprs), tuple(ops), batch_signature(batch), cap, sml,
             approx_float_sum=self.conf.get(IMPROVED_FLOAT_OPS),
-            sides=sides,
+            sides=sides, str_val_max_lens=svml,
         )
         keys, aggs, nseg = fn(
             vals_of_batch(batch),
@@ -405,7 +424,7 @@ class TpuHashAggregateExec(TpuExec):
         while len(partials) > 1:
             # ONE batched host pull for every row count and string byte
             # length (each separate pull pays a tunnel RTT)
-            import jax as _jax
+            from .base import host_pull
 
             head = [count_scalar(b.num_rows_lazy) for b in partials]
             nb = len(partials)
@@ -416,7 +435,7 @@ class TpuHashAggregateExec(TpuExec):
                     idx = (min(nr, c.offsets.shape[0] - 1)
                            if isinstance(nr, int) else nr)
                     head.append(c.offsets[idx])
-            pulled = [int(x) for x in _jax.device_get(head)]
+            pulled = [int(x) for x in host_pull(head)]
             lengths = pulled[:nb]
             for b, n in zip(partials, lengths):
                 if not isinstance(b.num_rows_lazy, int):
@@ -695,6 +714,20 @@ class TpuHashAggregateExec(TpuExec):
         if child.fusable:
             source, chain = child.fused_source_chain()
         else:
+            source, chain = child, ()
+        if chain and any(
+            op in ("min", "max") and e is not None
+            and isinstance(e.dtype, (T.StringType, T.BinaryType))
+            for op, e in zip(ops, exprs)
+        ):
+            # string min/max needs an EXACT byte bound for its rank sort.
+            # Under a fused chain the bound is measured on the SOURCE
+            # batch, which under-bounds a string computed by a projection
+            # below us (concat/pad can grow past every source column and
+            # the rank would compare only a prefix — silently wrong
+            # winners). Run the chain as real execs instead: the value is
+            # then a direct column of OUR input batch and its measured
+            # max length is exact.
             source, chain = child, ()
         fsp = getattr(source, "fused_stage_plans", None)
         if fsp is not None and self._can_fuse_stage() and self._stage_fusion_on():

@@ -66,6 +66,29 @@ def compile_miss_count() -> int:
     return COMPILE_COUNTER.total
 
 
+# ---------------------------------------------------------------------------
+# Sanctioned device→host sync points. EVERY host pull in exec/ops/expr
+# goes through these two helpers (tools/tpu_lint.py enforces it): a sync
+# costs a full tunnel RTT, so funneling them here keeps the hot path
+# auditable — grep for host_pull and you have the complete sync story.
+# ---------------------------------------------------------------------------
+def host_pull(tree):
+    """ONE batched device→host transfer of a pytree of arrays.
+
+    Callers batch every scalar they need into a single call (a list) —
+    each separate pull pays a tunnel round trip. This is the only
+    sanctioned way to read device values on the host outside this
+    module; tools/tpu_lint.py flags raw jax.device_get/.item() sites."""
+    return jax.device_get(tree)
+
+
+def host_fence(arrays):
+    """Block until the given device buffers are computed (the profiling /
+    ordering fence; the device-sync metric path uses it). Returns the
+    arrays so call sites can chain."""
+    return jax.block_until_ready(arrays)
+
+
 _PLANNING = threading.local()
 
 

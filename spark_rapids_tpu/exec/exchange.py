@@ -357,7 +357,9 @@ class TpuShuffleExchangeExec(TpuExec):
                         jnp.int32(map_id),
                     )
                     # ONE host sync for the (P+1,) offsets (+ string bytes)
-                    off_h, *boffs_h = jax.device_get([offsets, *byte_offs])
+                    from .base import host_pull
+
+                    off_h, *boffs_h = host_pull([offsets, *byte_offs])
                     for j in range(P):
                         a, b = int(off_h[j]), int(off_h[j + 1])
                         if a == b:

@@ -356,7 +356,9 @@ class TpuShuffledHashJoinExec(TpuExec):
             ("fastbuild", batch_signature(batch), bcap, need_mat), prep)
         res = fn(vals_of_batch(batch), count_scalar(batch.num_rows_lazy))
         packed_tbl, kmin, fits, unique = res[:4]
-        fits_h, unique_h = (bool(x) for x in jax.device_get((fits, unique)))
+        from .base import host_pull
+
+        fits_h, unique_h = (bool(x) for x in host_pull((fits, unique)))
         if not fits_h or (not unique_h and self._jt in ("inner", "left")):
             self._fast_built = False
             return False

@@ -60,7 +60,9 @@ P = jax.sharding.PartitionSpec
 
 
 def _np_of(arr) -> np.ndarray:
-    return np.asarray(jax.device_get(arr))
+    from .base import host_pull
+
+    return np.asarray(host_pull(arr))
 
 
 class _MeshStage(TpuExec):

@@ -486,6 +486,18 @@ def lower(expr: E.Expression, cols: Sequence[Val], cap: int) -> Val:
         if child_dt.is_floating or any(isinstance(v, float) for v in non_null):
             cmp_dt = T.DOUBLE if child_dt != T.FLOAT or any(
                 isinstance(v, float) for v in non_null) else T.FLOAT
+        elif isinstance(child_dt, T.DecimalType):
+            # the column holds UNSCALED int64 values: scale each literal
+            # to match; literals with more fractional digits than the
+            # scale can never equal a column value and drop out
+            import decimal as _dec
+
+            conv = []
+            for v in non_null:
+                d = _dec.Decimal(str(v)).scaleb(child_dt.scale)
+                if d == d.to_integral_value() and abs(int(d)) < 10 ** 18:
+                    conv.append(int(d))
+            non_null = conv
         elif child_dt.name in _INT_INFO:
             _, lo, hi = _INT_INFO[child_dt.name]
             if any(not (lo <= v <= hi) for v in non_null):

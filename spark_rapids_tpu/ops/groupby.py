@@ -13,6 +13,13 @@ inputs skip nulls; NaN groups as equal to NaN.
 Reductions provided: count_star, count, sum, min, max, first/last (+
 ignore-null variants). Average is decomposed by the exec layer into
 sum+count partials, mirroring Spark's update/merge model.
+
+String min/max (lexicographic, Spark UTF8String byte order) reduce via
+RANKS so every numeric fast path applies unchanged: a dictionary-encoded
+column ranks its (small) dictionary once in sorted-code order — the cudf
+dictionary32 trick, O(cardinality) — while a plain string column ranks
+rows with one radix-chunk sort; the winning rank then maps back to a
+code (dict) or row (plain) and the string is gathered out.
 """
 from __future__ import annotations
 
@@ -26,7 +33,7 @@ from .. import types as T
 from ..expr.eval import ColV, DictV, StrV, Val
 from ..expr.values import materialize_dict
 from .filter_gather import gather
-from .sort import SortOrder, sort_with_radix_keys
+from .sort import SortOrder, sort_with_radix_keys, string_chunk_keys
 
 
 def segment_ids_from_radix_keys(
@@ -150,6 +157,88 @@ def segment_reduce(
     raise ValueError(f"unknown aggregation op {op!r}")
 
 
+def _dict_rank(v: DictV) -> Tuple[jax.Array, ColV]:
+    """(order, per-row rank) of a dictionary-encoded column: ``order[p]``
+    is the dictionary index of the p-th smallest entry (lexicographic
+    UTF8 byte order), and the per-row rank rides the codes through one
+    int32 gather. ``max_len`` is static metadata — no host sync."""
+    d = v.dictionary
+    keys = string_chunk_keys(
+        StrV(d.offsets, d.chars, jnp.ones(v.dict_size, jnp.bool_)),
+        SortOrder(True, True), max(1, v.max_len))
+    iota = jnp.arange(v.dict_size, dtype=jnp.int32)
+    sorted_ops = lax.sort(list(keys) + [iota], num_keys=len(keys),
+                          is_stable=True)
+    order = sorted_ops[-1]
+    rank = jnp.zeros(v.dict_size, jnp.int32).at[order].set(
+        iota, mode="drop")
+    from ..expr.values import dict_gather_col
+
+    return order, dict_gather_col(v, ColV(rank, jnp.ones(
+        v.dict_size, jnp.bool_)))
+
+
+def _plain_rank(v: StrV, num_rows, max_len: int) -> Tuple[jax.Array, ColV]:
+    """(perm, per-row rank) of a plain string column via one radix-chunk
+    sort: ``perm[p]`` is the row holding the p-th smallest string."""
+    cap = v.offsets.shape[0] - 1
+    perm, _ = sort_with_radix_keys(
+        [v], [T.STRING], [SortOrder(True, True)], num_rows, [max_len])
+    rank = jnp.zeros(cap, jnp.int32).at[perm].set(
+        jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    return perm, ColV(rank, v.validity)
+
+
+def string_minmax_ranks(
+    value_cols: List[Optional[ColV]],
+    agg_ops: Sequence[str],
+    num_rows: Union[int, jax.Array],
+    str_val_max_lens: Sequence[int] = (),
+):
+    """Replace string-typed min/max inputs with their rank columns.
+
+    Returns ``recover``: agg index -> callable mapping the reduced rank
+    column back to the winning strings (a DictV rewrap for dictionary
+    columns, a row gather for plain ones). ``str_val_max_lens`` supplies
+    the static byte-length bound per string-typed min/max input, in
+    order of appearance (dictionary columns ignore theirs — their bound
+    is static metadata)."""
+    from .filter_gather import gather_string
+
+    recover = {}
+    rank_cache = {}  # id(value) -> (order/perm, rank rows): min(s)+max(s)
+    si = 0           # over one column share ONE rank sort
+    for ai, (op, v) in enumerate(zip(agg_ops, value_cols)):
+        if op not in ("min", "max") or not isinstance(v, (StrV, DictV)):
+            continue
+        ml = str_val_max_lens[si] if si < len(str_val_max_lens) else 64
+        si += 1
+        cached = rank_cache.get(id(v))
+        if cached is None:
+            cached = rank_cache[id(v)] = (
+                _dict_rank(v) if isinstance(v, DictV)
+                else _plain_rank(v, num_rows, ml))
+        order_or_perm, rank_rows = cached
+        if isinstance(v, DictV):
+            def rec(r: ColV, order=order_or_perm, t=v) -> DictV:
+                hi = max(t.dict_size - 1, 0)
+                codes = jnp.take(order, jnp.clip(r.data, 0, hi), mode="clip")
+                return DictV(codes.astype(jnp.int32), t.dictionary,
+                             r.validity, t.mat_cap, t.max_len, t.unique)
+        else:
+            def rec(r: ColV, perm=order_or_perm, src=v) -> StrV:
+                cap = src.offsets.shape[0] - 1
+                rows = jnp.take(perm, jnp.clip(r.data, 0, cap - 1),
+                                mode="clip")
+                # winners are distinct source rows, so the source byte
+                # pool bounds the output
+                return gather_string(src, rows, r.validity,
+                                     int(src.chars.shape[0]))
+        value_cols[ai] = rank_rows
+        recover[ai] = rec
+    return recover
+
+
 def sort_groupby(
     key_cols: Sequence[Val],
     key_dtypes: Sequence[T.DataType],
@@ -217,13 +306,19 @@ def reduce_no_keys(
     value_cols: Sequence[Optional[ColV]],
     agg_ops: Sequence[str],
     num_rows: Union[int, jax.Array],
-) -> List[ColV]:
+    str_val_max_lens: Sequence[int] = (),
+) -> List[Val]:
     """Grand aggregate (no grouping keys): one output row.
 
     Reference analog: cudf reduce path in aggregate.scala:806.
+    String min/max inputs reduce through their lexicographic ranks (see
+    :func:`string_minmax_ranks`).
     """
     if not value_cols:
         return []
+    value_cols = list(value_cols)
+    recover = string_minmax_ranks(
+        value_cols, agg_ops, num_rows, str_val_max_lens)
     cap = next(
         v.validity.shape[0] for v in value_cols if v is not None
     ) if any(v is not None for v in value_cols) else 0
@@ -237,7 +332,7 @@ def reduce_no_keys(
     from .filter_gather import live_of
 
     live = live_of(num_rows, cap)
-    outs = []
+    outs: List[Val] = []
     seg = None  # built lazily for the first/last path only
     for op, v in zip(agg_ops, value_cols):
         outs.append(_reduce_one(op, v, live))
@@ -245,6 +340,8 @@ def reduce_no_keys(
             if seg is None:
                 seg = jnp.where(live, 0, 1)
             outs[-1] = segment_reduce(op, v, seg, 1, live)
+    for ai, rec in recover.items():
+        outs[ai] = rec(outs[ai])
     return outs
 
 
@@ -577,7 +674,8 @@ def groupby_agg(
     str_max_lens: Sequence[int] = (),
     approx_float_sum: bool = False,
     num_buckets: int = 8192,
-) -> Tuple[List[Val], List[ColV], jax.Array]:
+    str_val_max_lens: Sequence[int] = (),
+) -> Tuple[List[Val], List[Val], jax.Array]:
     """Adaptive groupby: MXU hash-bucket fast path with a traced sort
     fallback.
 
@@ -594,6 +692,11 @@ def groupby_agg(
     """
     key_cols = list(key_cols)
     key_dtypes = list(key_dtypes)
+    value_cols = list(value_cols)
+    # string min/max reduce over lexicographic RANK columns; winners map
+    # back to strings after the (tiered) reduction picked its path
+    recover = string_minmax_ranks(
+        value_cols, agg_ops, num_rows, str_val_max_lens)
     code_keys = {}  # key index -> DictV template to rewrap from codes
     eff_sml: List[int] = []
     si = 0
@@ -627,11 +730,16 @@ def groupby_agg(
                     bucket_rows(
                         max(1, int(t.dictionary.chars.shape[0])), 128),
                     t.max_len, True)
+        if recover:
+            aggs = list(aggs)
+            for ai, rec in recover.items():
+                aggs[ai] = rec(aggs[ai])
         return keys, aggs, n
 
     if not key_cols:
-        return sort_groupby(
-            key_cols, key_dtypes, value_cols, agg_ops, num_rows, str_max_lens)
+        return _rewrap(*sort_groupby(
+            key_cols, key_dtypes, value_cols, agg_ops, num_rows,
+            str_max_lens))
     if any(isinstance(c, StrV) for c in key_cols):
         return _rewrap(*sort_groupby(
             key_cols, key_dtypes, value_cols, agg_ops, num_rows,

@@ -4,16 +4,37 @@ Reference analog: RapidsConf.help (RapidsConf.scala:838) -> docs/configs.md
 and TypeChecks.help (TypeChecks.scala:1005) -> docs/supported_ops.md — both
 documentation artifacts generated from the live registries so they can
 never drift from the code.
+
+docs/supported_ops.md is generated ENTIRELY from the static type matrices
+in plugin/typechecks.py — the same tables that drive plan tagging — so a
+cell in the doc IS the tagging behavior. ``python -m
+spark_rapids_tpu.plugin.docgen`` regenerates; ``--check`` (wired into CI)
+fails when a matrix cell was edited without regenerating.
 """
 from __future__ import annotations
 
-from typing import List
+import sys
+from typing import Dict, List, Tuple
 
-from .. import types as T
-from ..conf import RapidsConf, _REGISTRY
+from ..conf import _REGISTRY
+
+
+def _import_conf_modules() -> None:
+    """Some conf entries register on first import of their module
+    (memory/catalog.py, ml/columnar_rdd.py). The generated doc must not
+    depend on what happens to be imported, so pull them all in first."""
+    import importlib
+
+    for mod in ("spark_rapids_tpu.memory.catalog",
+                "spark_rapids_tpu.ml.columnar_rdd"):
+        try:
+            importlib.import_module(mod)
+        except ImportError:
+            pass
 
 
 def configs_md() -> str:
+    _import_conf_modules()
     lines = [
         "# Configuration",
         "",
@@ -31,118 +52,125 @@ def configs_md() -> str:
     return "\n".join(lines) + "\n"
 
 
-_PROBE_TYPES = [
-    T.BOOLEAN, T.BYTE, T.SHORT, T.INT, T.LONG, T.FLOAT, T.DOUBLE,
-    T.STRING, T.DATE, T.TIMESTAMP, T.DecimalType(10, 2),
-]
+def _param_rows(name: str, desc: str, ctx_label: str, cc) -> List[str]:
+    """One table row per parameter plus the result row of one context.
+    Cells are conf-INDEPENDENT by design: a conf-gated tag renders as PS
+    with the gate named in Notes, never as a flipped cell."""
+    from . import typechecks as TC
+
+    rows = []
+    entries: List[Tuple[str, object]] = [
+        (pc.name, pc) for pc in cc.params
+    ]
+    if cc.repeat is not None:
+        entries.append((f"{cc.repeat.name}...", cc.repeat))
+    entries.append(("result", None))
+    first = True
+    for pname, pc in entries:
+        sig = cc.output if pc is None else pc.sig
+        cells = []
+        notes = []
+        for tag in TC.TYPE_TAGS:
+            cells.append(sig.cell(tag))
+            n = sig.cell_note(tag)
+            if n:
+                notes.append(f"{tag}: {n}")
+        if pc is not None and pc.lit_required:
+            notes.insert(0, "must be a literal")
+        rows.append(
+            "| " + " | ".join(
+                [name if first else "", desc if first else "", ctx_label,
+                 pname] + cells + ["; ".join(notes)]
+            ) + " |"
+        )
+        first = False
+    return rows
 
 
-def _expr_probe_row(cls, name: str, desc: str, conf: RapidsConf) -> str:
-    """Which input types lower for this expression (the TypeChecks matrix,
-    derived by probing the REAL lowering per type, not a hand-kept table)."""
-    from ..expr import aggregates as A
-    from ..expr import expressions as E
-    from .overrides import check_aggregate, check_expression
-
-    import dataclasses
-
-    cells = []
-    for dt in _PROBE_TYPES:
-        schema = T.StructType((T.StructField("c", dt, True),))
-        try:
-            node = _probe_instance(cls, E.col("c"))
-            if node is None:
-                cells.append("·")
-                continue
-            if isinstance(node, A.AggregateFunction):
-                ae = A.agg(node, "x")
-                reasons = check_aggregate(ae, schema, conf)
-            else:
-                reasons = check_expression(node, schema, conf)
-            cells.append("S" if not reasons else " ")
-        except Exception:
-            cells.append(" ")
-    return f"| {name} | {desc} | " + " | ".join(cells) + " |"
-
-
-def _probe_instance(cls, c):
-    """Best-effort single-column instance of an expression class."""
-    import dataclasses
-
-    from ..expr import aggregates as A
-    from ..expr import expressions as E
-    from ..expr import windows as W
-
-    lit1 = E.Literal(1, T.INT)
-    lits = E.Literal("a", T.STRING)
-    try:
-        if cls in (E.Literal, E.BoundReference, E.UnresolvedAttribute,
-                   E.Alias, A.AggregateExpression, W.WindowExpression,
-                   E.PythonUDF):
-            return None
-        if issubclass(cls, A.AggregateFunction):
-            return cls(c) if cls is not A.Count else A.Count(c)
-        if issubclass(cls, W.WindowFunction):
-            return None
-        fields = dataclasses.fields(cls)
-        args = []
-        for f in fields:
-            if f.name in ("child", "left", "right", "column", "str",
-                          "start_date", "end_date", "sec", "start", "date",
-                          "predicate", "true_value", "false_value"):
-                args.append(c)
-            elif f.name in ("pattern", "substr", "search", "replacement",
-                            "pad", "delim", "format", "fmt"):
-                args.append(lits)
-            elif f.name in ("pos", "len", "days", "count", "index"):
-                args.append(lit1)
-            elif f.name == "exprs" or f.name == "children_":
-                args.append((c,))
-            elif f.name == "values":
-                args.append((1, 2))
-            elif f.name == "branches":
-                args.append(((c, c),))
-            elif f.name == "to":
-                args.append(T.LONG)
-            elif f.default is not dataclasses.MISSING or \
-                    f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
-                break
-            else:
-                args.append(c)
-        else:
-            return cls(*args)
-        return cls(*args)
-    except Exception:
-        return None
-
-
-def supported_ops_md(conf: RapidsConf = None) -> str:
-    """The supported_ops matrix: expressions x input types + exec rules."""
+def supported_ops_md() -> str:
+    """The supported-ops matrix doc: every expression rule's per-context,
+    per-parameter type cells, the cast grid, and the exec rules — all
+    read straight from typechecks.CHECKS / CAST_CHECKS."""
+    from . import typechecks as TC
     from .overrides import EXEC_RULES, EXPRESSION_RULES
 
-    conf = conf or RapidsConf({})
-    head = " | ".join(
-        t.simpleString if not isinstance(t, T.DecimalType) else "decimal"
-        for t in _PROBE_TYPES)
+    head = " | ".join(TC.TYPE_TAGS)
     lines: List[str] = [
         "# Supported operators and expressions",
         "",
-        "Generated by probing the live lowerings (reference: the "
-        "TypeChecks-generated docs/supported_ops.md). `S` = the expression "
-        "lowers to TPU for a column of that type under default configs; "
-        "blank = CPU fallback; `·` = not probeable as a unary column op.",
+        "Generated ENTIRELY from the static type matrices in "
+        "`plugin/typechecks.py` — the same tables the plan tagger uses — "
+        "so this document cannot drift from behavior (reference: the "
+        "TypeChecks-generated docs/supported_ops.md). Regenerate with "
+        "`python -m spark_rapids_tpu.plugin.docgen`; CI runs `--check`.",
+        "",
+        "Cells: `S` = supported; `PS` = partial support (see the Notes "
+        "column: a conf gate, a literal-only parameter, or a documented "
+        "restriction); blank = the plan falls back to CPU with a reason "
+        "naming the rule, parameter, and type (read it from "
+        "`TpuSession.explain()`, see docs/compatibility.md).",
         "",
         "## Expressions",
         "",
-        f"| Expression | Description | {head} |",
-        "|---" * (2 + len(_PROBE_TYPES)) + "|",
+        f"| Expression | Description | Context | Param | {head} | Notes |",
+        "|---" * (4 + len(TC.TYPE_TAGS) + 1) + "|",
     ]
     for cls in sorted(EXPRESSION_RULES, key=lambda c: EXPRESSION_RULES[c].name):
         r = EXPRESSION_RULES[cls]
-        lines.append(_expr_probe_row(cls, r.name, r.description, conf))
+        checks = TC.CHECKS.get(cls)
+        if checks is None:
+            lines.append(
+                "| " + " | ".join(
+                    [r.name, r.description, "-", "-"]
+                    + [""] * len(TC.TYPE_TAGS)
+                    + ["no matrix declared"]) + " |")
+            continue
+        # collapse contexts that share one ContextCheck (structural nodes)
+        by_cc: Dict[int, List[str]] = {}
+        cc_of: Dict[int, object] = {}
+        for ctx in TC.CONTEXTS:
+            cc = checks.contexts.get(ctx)
+            if cc is None:
+                continue
+            by_cc.setdefault(id(cc), []).append(ctx)
+            cc_of[id(cc)] = cc
+        first = True
+        for cid, ctxs in by_cc.items():
+            label = "all" if len(ctxs) == len(checks.contexts) > 1 \
+                else "/".join(ctxs)
+            lines.extend(_param_rows(
+                r.name if first else "", r.description if first else "",
+                label, cc_of[cid]))
+            first = False
+    lines += [
+        "",
+        "## Casts",
+        "",
+        "The `Cast` from-type x to-type grid (`CastChecks`). `PS` cells "
+        "are conf-gated or noted below.",
+        "",
+        f"| From \\ To | {head} |",
+        "|---" * (1 + len(TC.TYPE_TAGS)) + "|",
+    ]
+    cast_notes: List[str] = []
+    for frm in TC.TYPE_TAGS:
+        sig = TC.CAST_CHECKS.matrix.get(frm, TC.none)
+        cells = []
+        for to in TC.TYPE_TAGS:
+            cells.append(sig.cell(to))
+            n = sig.cell_note(to)
+            if n:
+                cast_notes.append(f"* {frm} -> {to}: {n}")
+        lines.append(f"| {frm} | " + " | ".join(cells) + " |")
+    if cast_notes:
+        lines += [""] + cast_notes
     lines += [
         "",
         "## Execs",
+        "",
+        "Exec rules tag their output schemas against the same engine type "
+        "set (array/struct columns always fall back; decimal obeys "
+        "spark.rapids.tpu.sql.decimalType.enabled and the DECIMAL64 cap).",
         "",
         "| Exec | Description |",
         "|---|---|",
@@ -153,15 +181,60 @@ def supported_ops_md(conf: RapidsConf = None) -> str:
     return "\n".join(lines) + "\n"
 
 
+_DOCS = {
+    "configs.md": configs_md,
+    "supported_ops.md": supported_ops_md,
+}
+
+
 def write_docs(outdir: str = "docs") -> None:
     import os
 
     os.makedirs(outdir, exist_ok=True)
-    with open(os.path.join(outdir, "configs.md"), "w") as f:
-        f.write(configs_md())
-    with open(os.path.join(outdir, "supported_ops.md"), "w") as f:
-        f.write(supported_ops_md())
+    for fname, gen in _DOCS.items():
+        with open(os.path.join(outdir, fname), "w") as f:
+            f.write(gen())
+
+
+def check_docs(outdir: str = "docs") -> List[str]:
+    """Names of generated docs that are out of sync with the registries
+    (empty = clean). The CI `docgen --check` gate."""
+    import os
+
+    stale = []
+    for fname, gen in _DOCS.items():
+        path = os.path.join(outdir, fname)
+        try:
+            with open(path) as f:
+                on_disk = f.read()
+        except OSError:
+            stale.append(fname)
+            continue
+        if on_disk != gen():
+            stale.append(fname)
+    return stale
+
+
+def main(argv: List[str]) -> int:
+    outdir = "docs"
+    if "--outdir" in argv:
+        outdir = argv[argv.index("--outdir") + 1]
+    if "--check" in argv:
+        stale = check_docs(outdir)
+        if stale:
+            print(
+                "docs out of sync with the type matrix / conf registry: "
+                + ", ".join(stale)
+                + "\nregenerate with: python -m spark_rapids_tpu.plugin.docgen",
+                file=sys.stderr,
+            )
+            return 1
+        print("generated docs are in sync")
+        return 0
+    write_docs(outdir)
+    print(f"wrote {', '.join(_DOCS)} to {outdir}/")
+    return 0
 
 
 if __name__ == "__main__":
-    write_docs()
+    raise SystemExit(main(sys.argv[1:]))

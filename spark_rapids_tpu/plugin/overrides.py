@@ -11,9 +11,14 @@ Reference analog:
 
 Differences by design: there is no separate "partitioning"/"scan" rule space
 yet (exchange and file scans register here as exec rules when those layers
-land), and expression supportability is checked both against the registry
-(docs/gating) and by abstractly tracing the actual lowering
-(eval.tpu_supports) so dtype-level gaps surface at plan time, not run time.
+land). Expression supportability is decided by the STATIC per-rule type
+matrix (plugin/typechecks.py, the TypeChecks.scala analog): the checker
+walks the plan without lowering anything and every fallback carries a
+reason naming the rule, parameter, and offending type. The old abstract
+lowering probe (eval.tpu_supports) survives as a conf-gated debug
+cross-check (spark.rapids.tpu.sql.matrix.probeCrossCheck.enabled) and as
+the value-level tag hook of the few rules whose support depends on
+literal values (regex patterns, UDF traces).
 """
 from __future__ import annotations
 
@@ -22,13 +27,12 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple, Type
 
 from .. import types as T
 from ..conf import (
-    DECIMAL_ENABLED,
     ENABLE_CAST_FLOAT_TO_TIMESTAMP,
     ENABLE_CAST_STRING_TO_FLOAT,
     ENABLE_CAST_STRING_TO_INTEGER,
     ENABLE_CAST_STRING_TO_TIMESTAMP,
     EXPLAIN,
-    IMPROVED_FLOAT_OPS,
+    MATRIX_PROBE_CROSS_CHECK,
     RapidsConf,
     SQL_ENABLED,
     TEST_ALLOWED_NONTPU,
@@ -204,32 +208,93 @@ for _cls, _name, _desc in [
     (E.SparkPartitionID, "SparkPartitionID", "current partition index"),
     (E.InputFileName, "InputFileName", "path of the file being scanned"),
     (E.Murmur3Hash, "Murmur3Hash", "Spark murmur3_32 hash of columns"),
+    # reference: RapidsUDF.java — a user columnar function traced into
+    # the fused projection; supportability is value-level (the trace),
+    # so its matrix tag hook IS the probe
+    (E.NativeUDF, "NativeUDF", "user JAX/Pallas columnar UDF"),
 ]:
     _expr_rule(_cls, _name, _desc)
 
 
 def _check_type(dt: T.DataType, conf: RapidsConf) -> Optional[str]:
     """Allowed-type matrix (reference: isSupportedType GpuOverrides.scala:531)."""
+    from .typechecks import decimal_reason
+
     if isinstance(dt, (T.ArrayType, T.StructType)):
         return f"type {dt.simpleString} is not supported on TPU"
     if isinstance(dt, T.DecimalType):
-        if not conf.get(DECIMAL_ENABLED):
-            return "decimal support is disabled (spark.rapids.tpu.sql.decimalType.enabled)"
-        if dt.precision > T.DecimalType.MAX_PRECISION:
-            return f"decimal precision {dt.precision} > 18 not supported"
+        return decimal_reason(dt, conf)
     return None
+
+
+_CONTEXT_EXPR_REASON = (
+    "nondeterministic/metadata expressions (rand, "
+    "monotonically_increasing_id, spark_partition_id, "
+    "input_file_name, hash over strings) only run on TPU "
+    "inside a projection"
+)
 
 
 def check_expression(
     expr: E.Expression, schema: StructType, conf: RapidsConf,
-    allow_context: bool = False,
+    allow_context: bool = False, context: Optional[str] = None,
 ) -> List[str]:
     """All the reasons this expression can't lower; empty = supported.
 
-    ``allow_context``: True only where the exec evaluates partition-
-    context expressions at its boundary (the project; reference: Spark
-    pins nondeterministic expressions into their own Project) — anywhere
-    else rand()/ids/input_file_name must tag the plan off."""
+    The verdict comes from the STATIC type matrix (plugin/typechecks.py):
+    nothing is traced. ``allow_context``: True only where the exec
+    evaluates partition-context expressions at its boundary (the project;
+    reference: Spark pins nondeterministic expressions into their own
+    Project) — anywhere else rand()/ids/input_file_name must tag the
+    plan off. ``context`` defaults to the project context."""
+    from . import typechecks as TC
+
+    if context is None:
+        context = TC.PROJECT
+    reasons: List[str] = []
+    if (E.has_context_expr(expr) or _has_string_hash(expr, schema)) \
+            and not allow_context:
+        reasons.append(_CONTEXT_EXPR_REASON)
+    if not reasons:
+        try:
+            bound = E.bind_references(expr, schema)
+        except (ValueError, KeyError) as e:
+            reasons.append(str(e))
+        else:
+            reasons.extend(TC.check_expr(bound, conf, context))
+            try:
+                err = _check_type(bound.dtype, conf)
+                if err:
+                    reasons.append(err)
+            except Exception:  # noqa: BLE001
+                pass  # already reported by the matrix walk
+    if conf.get(MATRIX_PROBE_CROSS_CHECK):
+        try:
+            legacy = _probe_check_expression(
+                expr, schema, conf, allow_context)
+        except Exception as e:  # noqa: BLE001 — probe crash = probe fallback
+            legacy = [f"lowering probe raised: {e}"]
+        if bool(legacy) != bool(reasons):
+            TC.note_cross_check_disagreement(
+                f"{type(expr).__name__}: matrix="
+                f"{'FALLBACK' if reasons else 'ON_TPU'}"
+                f"({'; '.join(reasons) or '-'}) probe="
+                f"{'FALLBACK' if legacy else 'ON_TPU'}"
+                f"({'; '.join(legacy) or '-'})")
+            if legacy and not reasons:
+                # conservative: a probe-detected lowering gap falls back
+                # even when the matrix disagrees (then fix the matrix)
+                reasons.extend(legacy)
+    return reasons
+
+
+def _probe_check_expression(
+    expr: E.Expression, schema: StructType, conf: RapidsConf,
+    allow_context: bool = False,
+) -> List[str]:
+    """The LEGACY verdict: abstractly trace the real lowering
+    (eval.tpu_supports). Kept verbatim as the probeCrossCheck debug path;
+    the matrix above is the primary tagging mechanism."""
     reasons: List[str] = []
 
     def visit(node: E.Expression):
@@ -243,20 +308,14 @@ def check_expression(
     visit(expr)
     if reasons:
         return reasons
-    # dtype-level probe: abstractly trace the real lowering. Context
-    # expressions (rand / ids / input_file_name, and hash() over strings,
-    # which needs the exec's host-synced byte bound) evaluate at the
-    # project's boundary, not in eval.py — probe them as typed
+    # context expressions (rand / ids / input_file_name, and hash() over
+    # strings, which needs the exec's host-synced byte bound) evaluate at
+    # the project's boundary, not in eval.py — probe them as typed
     # placeholders there, reject them everywhere else
     probe_expr = expr
     if E.has_context_expr(expr) or _has_string_hash(expr, schema):
         if not allow_context:
-            return [
-                "nondeterministic/metadata expressions (rand, "
-                "monotonically_increasing_id, spark_partition_id, "
-                "input_file_name, hash over strings) only run on TPU "
-                "inside a projection"
-            ]
+            return [_CONTEXT_EXPR_REASON]
 
         def _placeholder(node):
             if isinstance(node, E.NONDETERMINISTIC_CONTEXT_EXPRS) or (
@@ -325,8 +384,15 @@ def _gated_cast_reasons(bound: E.Expression, conf: RapidsConf) -> List[str]:
 
 
 def check_aggregate(
-    ae: A.AggregateExpression, schema: StructType, conf: RapidsConf
+    ae: A.AggregateExpression, schema: StructType, conf: RapidsConf,
+    context: Optional[str] = None,
 ) -> List[str]:
+    """Matrix verdict for one aggregate: the function's own cell in the
+    aggregation (or window) context, plus its input expression checked as
+    the projection it evaluates in."""
+    from . import typechecks as TC
+
+    context = context or TC.AGGREGATION
     reasons: List[str] = []
     f = ae.func
     if type(f) not in EXPRESSION_RULES:
@@ -334,28 +400,14 @@ def check_aggregate(
         return reasons
     if f.input is not None:
         try:
-            bound = E.bind_references(f.child, schema)
-            dt = bound.dtype
+            bound_f = E.bind_references(f, schema)
         except (ValueError, KeyError) as e:
             return [str(e)]
-        if isinstance(dt, (T.StringType, T.BinaryType)):
-            reasons.append(
-                f"{type(f).__name__} over string inputs is not supported on TPU yet"
-            )
-        else:
+        reasons.extend(TC.check_node(bound_f, conf, context))
+        if not reasons:
             reasons.extend(check_expression(f.child, schema, conf))
-        if (
-            isinstance(f, (A.Sum, A.Average))
-            and dt.is_floating
-            and not conf.get(IMPROVED_FLOAT_OPS)
-        ):
-            # same default as the reference: floating-point aggregation is
-            # order-dependent, so it stays on CPU unless the user opts in
-            # (RapidsConf.scala variableFloatAgg gate)
-            reasons.append(
-                "floating-point sum/average can differ from CPU results; set "
-                "spark.rapids.tpu.sql.variableFloatAgg.enabled=true to enable"
-            )
+    else:
+        reasons.extend(TC.check_node(f, conf, context))
     return reasons
 
 
@@ -541,8 +593,24 @@ def _convert_aggregate(cpu: C.CpuHashAggregateExec, conf, children):
             conf, cpu.group_exprs, cpu.agg_exprs, child, A.COMPLETE)
     # mesh path: the whole partial->exchange->final stage as one shard_map
     # program over ICI (the accelerated-shuffle analog the planner selects,
-    # RapidsShuffleInternalManager.scala:58-150)
-    if cpu.group_exprs and _mesh_eligible(conf, child.output_schema):
+    # RapidsShuffleInternalManager.scala:58-150). String AGGREGATE inputs
+    # (min/max over char columns) stay on the exchange path: their string
+    # buffer columns have no shard_map lowering yet.
+    def _string_agg_input() -> bool:
+        for ae in cpu.agg_exprs:
+            f = ae.func
+            if f.input is None:
+                continue
+            try:
+                b = E.bind_references(f.child, child.output_schema)
+            except (ValueError, KeyError):
+                return True
+            if isinstance(b.dtype, (T.StringType, T.BinaryType)):
+                return True
+        return False
+
+    if cpu.group_exprs and _mesh_eligible(conf, child.output_schema) \
+            and not _string_agg_input():
         try:
             bound_keys = [
                 E.bind_references(g, child.output_schema)
@@ -837,6 +905,8 @@ def _tag_window(meta: "PlanMeta") -> None:
             meta.will_not_work(
                 "only UNBOUNDED PRECEDING..CURRENT ROW, whole-partition, "
                 "literal ROWS, or literal RANGE window frames run on TPU")
+    from . import typechecks as TC
+
     for we in cpu.window_exprs:
         f = we.func
         if branged and isinstance(f, (A.Min, A.Max)):
@@ -851,28 +921,20 @@ def _tag_window(meta: "PlanMeta") -> None:
                 meta.will_not_work(r)
             continue
         if isinstance(f, (A.Count, A.Sum, A.Min, A.Max, A.Average)):
+            # the function's WINDOW-context matrix cell (reference: the
+            # window column of TypeChecks; float agg gated per
+            # GpuOverrides.scala:1725, strings off — the window kernels
+            # have no string frame path)
             if f.input is not None:
                 try:
-                    b = E.bind_references(f.child, schema)
-                    if isinstance(b.dtype, (T.StringType, T.BinaryType)):
-                        meta.will_not_work(
-                            "window aggregation over strings not supported on TPU")
-                    if (
-                        isinstance(f, (A.Sum, A.Average))
-                        and b.dtype.is_floating
-                        and not meta.conf.get(IMPROVED_FLOAT_OPS)
-                    ):
-                        # same gate as check_aggregate: running float sums use
-                        # cumsum-then-subtract, whose cancellation can diverge
-                        # from the CPU's per-frame order (reference gates float
-                        # agg in window contexts too, GpuOverrides.scala:1725)
-                        meta.will_not_work(
-                            "floating-point window sum/average can differ from "
-                            "CPU results; set spark.rapids.tpu.sql."
-                            "variableFloatAgg.enabled=true to enable"
-                        )
+                    bound_f = E.bind_references(f, schema)
                 except (ValueError, KeyError) as ex:
                     meta.will_not_work(str(ex))
+                    continue
+                for r in TC.check_node(bound_f, meta.conf, TC.WINDOW):
+                    meta.will_not_work(r)
+                for r in check_expression(f.child, schema, meta.conf):
+                    meta.will_not_work(r)
             continue
         meta.will_not_work(
             f"window function {type(f).__name__} is not supported on TPU")
@@ -957,6 +1019,11 @@ class PlanMeta:
 
     # -- reporting ---------------------------------------------------------
     def explain_lines(self, indent: int = 0) -> List[str]:
+        """The willNotWorkOnTpu report (reference: RapidsMeta.explain):
+        one line per exec, plus — for fallen-back execs — one nested
+        ``!Expression`` line per expression-level matrix reason, so the
+        operator AND the offending expression/parameter/type are both
+        named without reading code."""
         name = self.rule.name if self.rule else self.wrapped.node_name
         pad = "  " * indent
         if self.can_replace:
@@ -964,6 +1031,13 @@ class PlanMeta:
         else:
             why = "; ".join(self.reasons)
             lines = [f"{pad}!Exec <{name}> cannot run on TPU because {why}"]
+            known = {r.name for r in EXPRESSION_RULES.values()}
+            for r in self.reasons:
+                rule, sep, rest = r.partition(": ")
+                if sep and rule in known:
+                    lines.append(
+                        f"{pad}  !Expression <{rule}> cannot run on TPU "
+                        f"because {rest}")
         for c in self.child_metas:
             lines.extend(c.explain_lines(indent + 1))
         return lines

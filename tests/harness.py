@@ -88,10 +88,22 @@ def assert_tpu_and_cpu_equal(
         "spark.rapids.tpu.sql.enabled": True,
         "spark.rapids.tpu.sql.test.enabled": True,
         "spark.rapids.tpu.sql.test.allowedNonTpu": ",".join(allow_non_tpu),
+        # every differential run also cross-checks the static type matrix
+        # against the legacy lowering probe: a verdict disagreement on the
+        # tested surface fails loudly below instead of drifting silently
+        "spark.rapids.tpu.sql.matrix.probeCrossCheck.enabled": True,
     }
     tpu_sess = TpuSession(tpu_conf)
+    from spark_rapids_tpu.plugin import typechecks as _TC
+
+    before = len(_TC.cross_check_log())
     cpu_rows = build(cpu_sess).collect()
     tpu_rows = build(tpu_sess).collect()
+    new = _TC.cross_check_log()[before:]
+    assert not new, (
+        "static matrix vs lowering-probe verdict disagreement:\n"
+        + "\n".join(new)
+    )
     compare_rows(cpu_rows, tpu_rows, ignore_order, approx_float)
     return cpu_rows
 

@@ -59,12 +59,13 @@ def test_dlpack_and_numpy_export():
 
 def test_columnar_rdd_rejects_fallback_plans():
     sess = TpuSession(ON)
-    # string min aggregate falls back to CPU -> no device batches
+    # string first() aggregate falls back to CPU -> no device batches
+    # (min/max over strings now run on TPU via the rank kernels)
     schema = T.StructType([T.StructField("s", T.STRING)])
     df = sess.create_dataframe({"s": ["a", "b"]}, schema)
     from spark_rapids_tpu.expr import aggregates as A
 
-    bad = df.agg(A.agg(A.Min(col("s")), "m"))
+    bad = df.agg(A.agg(A.First(col("s")), "m"))
     with pytest.raises(ValueError, match="CPU fallback"):
         next(iter(columnar_rdd(bad)))
 

@@ -186,9 +186,22 @@ class TestFallback:
         assert_fallback(build, "CpuJoinExec")
 
     def test_string_agg_input_falls_back(self):
+        # min/max over strings now run on TPU (rank-based kernels); the
+        # remaining string-input aggregates (first/last) still fall back
         assert_fallback(
-            lambda s: make_df(s).group_by("k").agg(A.agg(A.Min(col("s")), "ms")),
+            lambda s: make_df(s).group_by("k").agg(A.agg(A.First(col("s")), "fs")),
             "CpuHashAggregateExec",
+        )
+
+    def test_string_minmax_agg_runs_on_tpu(self):
+        # VERDICT #4: TPC-DS min/max over char columns — lexicographic
+        # min/max lowers via the rank kernels, diffed vs the CPU oracle
+        assert_tpu_and_cpu_equal(
+            lambda s: make_df(s).group_by("k").agg(
+                A.agg(A.Min(col("s")), "mn"),
+                A.agg(A.Max(col("s")), "mx"),
+                A.agg(A.Count(), "n"),
+            )
         )
 
     def test_test_mode_raises_on_fallback(self):
@@ -196,7 +209,7 @@ class TestFallback:
             "spark.rapids.tpu.sql.enabled": True,
             "spark.rapids.tpu.sql.test.enabled": True,
         })
-        df = make_df(sess).group_by("k").agg(A.agg(A.Min(col("s")), "ms"))
+        df = make_df(sess).group_by("k").agg(A.agg(A.First(col("s")), "fs"))
         with pytest.raises(AssertionError, match="not columnar"):
             df.collect()
 
@@ -213,10 +226,21 @@ class TestExplain:
     def test_explain_marks_tpu_and_cpu(self):
         sess = TpuSession()
         df = make_df(sess).where(E.IsNotNull(col("k"))).group_by("k").agg(
-            A.agg(A.Min(col("s")), "ms"))
+            A.agg(A.First(col("s")), "fs"))
         report = df.explain()
         assert "!Exec <HashAggregateExec> cannot run on TPU" in report
         assert "*Exec <FilterExec> will run on TPU" in report
+
+    def test_explain_names_rule_param_and_type(self):
+        """Every fallback reason names the rule, parameter, and offending
+        type, and the exec line carries a nested !Expression annotation
+        (the willNotWorkOnTpu contract of the static matrix)."""
+        sess = TpuSession()
+        df = make_df(sess).group_by("k").agg(A.agg(A.First(col("s")), "fs"))
+        report = df.explain()
+        assert "First: input string is not supported" in report
+        assert "aggregation context" in report
+        assert "!Expression <First>" in report
 
     def test_explain_conf_capture(self):
         sess = TpuSession({"spark.rapids.tpu.sql.explain": "ALL"})
@@ -225,7 +249,7 @@ class TestExplain:
 
     def test_explain_not_on_tpu_only(self):
         sess = TpuSession({"spark.rapids.tpu.sql.explain": "NOT_ON_TPU"})
-        make_df(sess).group_by("k").agg(A.agg(A.Min(col("s")), "ms")).collect()
+        make_df(sess).group_by("k").agg(A.agg(A.First(col("s")), "fs")).collect()
         assert "cannot run on TPU" in sess.last_explain
         assert "will run on TPU" not in sess.last_explain
 
@@ -240,7 +264,7 @@ class TestMixedPlan:
             .where(E.GreaterThan(col("a"), lit(-50)))
             .select(col("k"), col("s"))
             .group_by("k")
-            .agg(A.agg(A.Min(col("s")), "ms"))
+            .agg(A.agg(A.First(col("s")), "fs"))
         )
         rows = df.collect()
         assert len(rows) > 0

@@ -1,0 +1,629 @@
+"""Matrix-conformance suite for the static type-support subsystem.
+
+Reference analog: the TypeChecks-driven doc/tagging invariants of the
+reference plugin — every supported cell must actually lower and match
+the CPU oracle, every unsupported cell must fall back cleanly with a
+reason naming the rule, and docs/supported_ops.md must be byte-identical
+to what the matrix generates.
+
+Layers:
+  * coverage: every registered expression rule declares a matrix
+  * safety sweep: NO cell where the matrix says ON_TPU but the legacy
+    lowering probe says the trace fails (that direction = runtime crash)
+  * execution sweep: supported project-context cells lower a one-op
+    plan and diff against the row-interpreter CPU oracle
+  * aggregation cells: supported cells run a full differential plan;
+    unsupported cells produce a reasoned, named fallback in explain()
+  * string min/max (VERDICT #4): grouped/grand/multi-partition/dict
+    differential tests for the new rank-based kernels
+  * docgen --check and the tracing-hazard lint
+"""
+import decimal
+import os
+import subprocess
+import sys
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import ColumnarBatch, schema_of
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.cpu import eval_expression_rows
+from spark_rapids_tpu.expr import aggregates as A
+from spark_rapids_tpu.expr import bind_references, evaluate_projection
+from spark_rapids_tpu.expr import expressions as E
+from spark_rapids_tpu.expr.expressions import col, lit
+from spark_rapids_tpu.plugin import typechecks as TC
+from spark_rapids_tpu.plugin.overrides import (
+    EXPRESSION_RULES,
+    _probe_check_expression,
+    check_aggregate,
+    check_expression,
+)
+from spark_rapids_tpu.sql import TpuSession
+
+from harness import assert_tpu_and_cpu_equal, compare_rows
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# decimal(7,2): Multiply/Divide results fit DECIMAL64, so the decimal
+# cells exercise DECLARED support (the PR-1 drift: the old doc probed
+# decimal(10,2), whose products overflow, and published the resulting
+# fallback as "unsupported")
+DEC = T.DecimalType(7, 2)
+
+PROBE_TYPES = (
+    T.BOOLEAN, T.BYTE, T.SHORT, T.INT, T.LONG, T.FLOAT, T.DOUBLE,
+    DEC, T.STRING, T.DATE, T.TIMESTAMP,
+)
+#: one representative per kernel family for the (compile-heavy)
+#: execution sweep; the verdict sweeps above it stay exhaustive
+EXEC_TYPES = (T.BOOLEAN, T.INT, T.LONG, T.DOUBLE, DEC, T.STRING,
+              T.DATE, T.TIMESTAMP)
+
+# moderate magnitudes on purpose: the conformance sweep verifies CELLS
+# (does the op lower and agree for this type), not numeric edge
+# semantics — the dedicated suites (test_expressions/test_decimal/...)
+# own overflow/NaN/saturation torture
+_DATA = {
+    "boolean": [True, False, None, True, False, True, None, False],
+    "tinyint": [1, -3, None, 7, 0, 20, -20, 5],
+    "smallint": [1, -3, None, 7, 0, 20, -20, 5],
+    "int": [1, -3, None, 7, 0, 20, -20, 5],
+    "bigint": [1, -3, None, 7, 0, 20, -20, 5],
+    "float": [1.5, -2.25, None, 0.0, 3.75, -0.5, 20.25, 7.0],
+    "double": [1.5, -2.25, None, 0.0, 3.75, -0.5, 20.25, 7.0],
+    "decimal": [decimal.Decimal("12.34"), decimal.Decimal("-0.05"), None,
+                decimal.Decimal("31.99"), decimal.Decimal("0.00"),
+                decimal.Decimal("-23.45"), decimal.Decimal("1.00"),
+                decimal.Decimal("7.77")],
+    "string": ["a", "bb", None, "ccc", "", "zz", "a", "mn"],
+    "date": [18321, 0, None, -365, 19000, 1, 7300, 18321],
+    "timestamp": [1_600_000_000_000_000, 0, None, -86_400_000_000,
+                  1_700_000_000_123_456, 1, 777, 42],
+}
+
+_SKIP_INSTANCE = {
+    E.Literal, E.UnresolvedAttribute, E.BoundReference, E.Alias,
+    E.NativeUDF, A.AggregateExpression,
+}
+_AGG_CLASSES = (A.Count, A.Sum, A.Min, A.Max, A.Average, A.First, A.Last)
+
+
+def _schema_of(dt):
+    return T.StructType((T.StructField("c", dt, True),))
+
+
+def _instance(cls, dt):
+    """Best-effort single-column instance of an expression rule (the old
+    docgen probe builder, now test-side only)."""
+    import dataclasses
+
+    from spark_rapids_tpu.expr import windows as W
+
+    c = col("c")
+    if cls in _SKIP_INSTANCE or issubclass(cls, (W.WindowFunction,)) \
+            or cls is W.WindowExpression:
+        return None
+    if issubclass(cls, A.AggregateFunction):
+        return cls(c)
+    if cls is E.TimeAdd:  # days/microseconds are plain ints, not exprs
+        return E.TimeAdd(c, 1, 500_000)
+    lit1 = E.Literal(1, T.INT)
+    lits = E.Literal("a", T.STRING)
+    try:
+        args = []
+        for f in dataclasses.fields(cls):
+            if f.name in ("child", "left", "right", "column", "str",
+                          "start_date", "end_date", "sec", "start", "date",
+                          "predicate", "true_value", "false_value"):
+                args.append(c)
+            elif f.name in ("pattern", "substr", "search", "replacement",
+                            "pad", "delim", "format", "fmt"):
+                args.append(lits)
+            elif f.name in ("pos", "len", "days", "count", "index"):
+                args.append(lit1)
+            elif f.name in ("exprs", "children_"):
+                args.append((c,))
+            elif f.name == "values":
+                args.append((1, 2))
+            elif f.name == "branches":
+                args.append(((E.IsNotNull(c), c),))
+            elif f.name == "to":
+                args.append(T.LONG)
+            elif f.default is not dataclasses.MISSING or \
+                    f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+                break
+            else:
+                args.append(c)
+        else:
+            return cls(*args)
+        return cls(*args)
+    except Exception:
+        return None
+
+
+def _cells():
+    for cls in sorted(EXPRESSION_RULES, key=lambda c: c.__name__):
+        if issubclass(cls, A.AggregateFunction):
+            continue
+        for dt in PROBE_TYPES:
+            node = _instance(cls, dt)
+            if node is None:
+                continue
+            yield cls, dt, node
+
+
+class TestMatrixCoverage:
+    def test_every_rule_declares_a_matrix(self):
+        missing = [
+            r.name for cls, r in EXPRESSION_RULES.items()
+            if cls not in TC.CHECKS
+        ]
+        assert not missing, f"rules without a type matrix: {missing}"
+
+    def test_unsupported_reasons_name_rule_param_and_type(self):
+        conf = RapidsConf({})
+        schema = _schema_of(T.STRING)
+        reasons = check_expression(E.Sqrt(col("c")), schema, conf)
+        assert reasons and "Sqrt" in reasons[0] and "string" in reasons[0]
+        reasons = check_aggregate(
+            A.agg(A.First(col("c")), "f"), schema, conf)
+        assert reasons == [
+            "First: input string is not supported in the aggregation context"
+        ]
+
+
+class TestVerdictSafety:
+    """The direction that would crash at runtime must be empty: no cell
+    where the matrix tags ON_TPU but the abstract lowering trace fails.
+    (The matrix being NARROWER than the lenient trace is fine — that is
+    a clean documented fallback, e.g. sin() over a timestamp column.)"""
+
+    def test_matrix_supported_implies_probe_supported(self):
+        conf = RapidsConf({})
+        bad = []
+        for cls, dt, node in _cells():
+            schema = _schema_of(dt)
+            if check_expression(node, schema, conf, allow_context=True):
+                continue  # matrix says fallback — safe by construction
+            probe = _probe_check_expression(
+                node, schema, conf, allow_context=True)
+            if probe:
+                bad.append((cls.__name__, dt.simpleString, probe[0][:90]))
+        assert not bad, (
+            "matrix claims ON_TPU where the lowering trace fails "
+            f"({len(bad)} cells):\n" + "\n".join(map(str, bad)))
+
+
+class TestProjectCellExecution:
+    """Every supported project-context cell lowers a ONE-OP plan and
+    matches the row-interpreter CPU oracle; every unsupported cell
+    produces a reason (never a crash)."""
+
+    @pytest.mark.parametrize("dt", EXEC_TYPES,
+                             ids=lambda d: d.simpleString)
+    def test_supported_cells_match_cpu_oracle(self, dt):
+        conf = RapidsConf({})
+        schema = _schema_of(dt)
+        tag = TC.tag_of(dt)
+        data = {"c": _DATA[tag]}
+        batch = ColumnarBatch.from_pydict(data, schema)
+        rows = [(v,) for v in data["c"]]
+        ran = 0
+        for cls, cdt, node in _cells():
+            if cdt != dt:
+                continue
+            if check_expression(node, schema, conf, allow_context=True):
+                continue
+            if E.has_context_expr(node):
+                continue  # partition-context values differ by design
+            bound = bind_references(node, schema)
+            [out] = evaluate_projection([bound], batch)
+            cpu = eval_expression_rows(bound, rows)
+            compare_rows([tuple([v]) for v in cpu],
+                         [tuple([v]) for v in out.to_pylist()],
+                         ignore_order=False, approx_float=True)
+            ran += 1
+        assert ran > 0
+
+    def test_unsupported_cells_fall_back_with_reason(self):
+        conf = RapidsConf({})
+        for cls, dt, node in _cells():
+            schema = _schema_of(dt)
+            reasons = check_expression(node, schema, conf,
+                                       allow_context=True)
+            for r in reasons:
+                assert isinstance(r, str) and r, (cls, dt)
+
+
+class TestAggregationCells:
+    """Aggregate matrix cells: supported -> full differential plan;
+    unsupported -> a clean, named fallback reason in explain()."""
+
+    @pytest.mark.parametrize("func_cls", _AGG_CLASSES,
+                             ids=lambda c: c.__name__)
+    def test_agg_cells(self, func_cls):
+        for dt in EXEC_TYPES:
+            tag = TC.tag_of(dt)
+            schema = schema_of(k=T.INT, c=dt)
+            data = {"k": [1, 1, 2, 2, 1, 2, None, 1],
+                    "c": _DATA[tag]}
+            conf = RapidsConf({})
+            ae = A.agg(func_cls(col("c")), "a")
+            reasons = check_aggregate(ae, schema, conf)
+            if not reasons:
+                assert_tpu_and_cpu_equal(
+                    lambda s: s.create_dataframe(data, schema)
+                    .group_by("k").agg(A.agg(func_cls(col("c")), "a")),
+                    approx_float=True,
+                )
+            else:
+                assert any(func_cls.__name__ in r for r in reasons), (
+                    func_cls, dt, reasons)
+                sess = TpuSession()
+                report = (
+                    sess.create_dataframe(data, schema)
+                    .group_by("k")
+                    .agg(A.agg(func_cls(col("c")), "a"))
+                    .explain()
+                )
+                assert "cannot run on TPU" in report
+                assert func_cls.__name__ in report
+
+    def test_float_agg_conf_flips_the_cell(self):
+        schema = schema_of(k=T.INT, c=T.DOUBLE)
+        off = RapidsConf({})
+        on = RapidsConf(
+            {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True})
+        ae = A.agg(A.Sum(col("c")), "s")
+        assert check_aggregate(ae, schema, off)
+        assert not check_aggregate(ae, schema, on)
+
+    def test_window_string_minmax_stays_off_with_reason(self):
+        from spark_rapids_tpu.expr import windows as W
+
+        schema = schema_of(k=T.INT, s=T.STRING)
+        conf = RapidsConf({})
+        bound = bind_references(A.Min(col("s")), schema)
+        reasons = TC.check_node(bound, conf, TC.WINDOW)
+        assert reasons == [
+            "Min: input string is not supported in the window context"
+        ]
+        assert not TC.check_node(bound, conf, TC.AGGREGATION)
+
+
+class TestProbeCrossCheckConf:
+    def test_cross_check_logs_nothing_on_clean_plans(self):
+        TC.clear_cross_check_log()
+        sess = TpuSession({
+            "spark.rapids.tpu.sql.matrix.probeCrossCheck.enabled": True})
+        schema = schema_of(a=T.LONG, s=T.STRING)
+        df = sess.create_dataframe(
+            {"a": [1, 2, None], "s": ["x", None, "z"]}, schema)
+        df.where(E.IsNotNull(col("a"))).select(
+            E.Alias(E.Add(col("a"), lit(1)), "a1"),
+            E.Alias(E.Upper(col("s")), "u"),
+        ).collect()
+        assert TC.cross_check_log() == []
+
+
+# ---------------------------------------------------------------------------
+# String min/max aggregates (VERDICT #4) — CPU-oracle differentials
+# ---------------------------------------------------------------------------
+STR_POOL = ["apple", "Banana", "", "cherry", "apple", "kiwi", "zz",
+            "éclair", None]
+
+
+def _str_df(s, n=200, parts=1):
+    schema = schema_of(k=T.INT, s=T.STRING, t=T.STRING)
+    data = {
+        "k": [i % 7 if i % 11 else None for i in range(n)],
+        "s": [STR_POOL[i % len(STR_POOL)] for i in range(n)],
+        "t": [None if i % 3 == 0 else STR_POOL[(i * 5) % len(STR_POOL)]
+              for i in range(n)],
+    }
+    return s.create_dataframe(data, schema, num_partitions=parts)
+
+
+class TestStringMinMax:
+    def test_grouped(self):
+        assert_tpu_and_cpu_equal(
+            lambda s: _str_df(s).group_by("k").agg(
+                A.agg(A.Min(col("s")), "mn"), A.agg(A.Max(col("s")), "mx"),
+                A.agg(A.Min(col("t")), "mnt"), A.agg(A.Max(col("t")), "mxt"),
+                A.agg(A.Count(), "n"),
+            ))
+
+    def test_grand(self):
+        assert_tpu_and_cpu_equal(
+            lambda s: _str_df(s).agg(
+                A.agg(A.Min(col("s")), "mn"), A.agg(A.Max(col("s")), "mx")))
+
+    def test_multi_partition_partial_final(self):
+        # string buffer columns cross the exchange between PARTIAL/FINAL
+        assert_tpu_and_cpu_equal(
+            lambda s: _str_df(s, n=503, parts=3).group_by("k").agg(
+                A.agg(A.Min(col("s")), "mn"), A.agg(A.Max(col("s")), "mx")))
+
+    def test_mixed_with_numeric_aggs(self):
+        schema = schema_of(k=T.INT, s=T.STRING, v=T.LONG)
+        data = {
+            "k": [i % 4 for i in range(100)],
+            "s": [STR_POOL[i % len(STR_POOL)] for i in range(100)],
+            "v": [i * 3 - 50 if i % 9 else None for i in range(100)],
+        }
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(data, schema).group_by("k").agg(
+                A.agg(A.Sum(col("v")), "sv"), A.agg(A.Min(col("s")), "mn"),
+                A.agg(A.Max(col("v")), "mxv"), A.agg(A.Max(col("s")), "mx"),
+            ))
+
+    def test_all_null_group(self):
+        schema = schema_of(k=T.INT, s=T.STRING)
+        data = {"k": [1, 1, 2, 2], "s": [None, None, "b", "a"]}
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(data, schema).group_by("k").agg(
+                A.agg(A.Min(col("s")), "mn"), A.agg(A.Max(col("s")), "mx")))
+
+    def test_dict_encoded_sorted_code_order(self, monkeypatch):
+        """The dictionary path (sorted-code order) vs forced
+        materialization vs the groupby oracle — same answers on both
+        lowerings, and the dict path keeps its output dict-encoded."""
+        import jax
+
+        from spark_rapids_tpu import columnar as COL
+        from spark_rapids_tpu.columnar.column import (
+            DeviceColumn,
+            dict_column_from_pylist,
+        )
+        from spark_rapids_tpu.expr.eval import ColV
+        from spark_rapids_tpu.expr.values import as_plain_str
+        from spark_rapids_tpu.ops import groupby as G
+        import jax.numpy as jnp
+
+        strs = [STR_POOL[i % len(STR_POOL)] for i in range(64)]
+        keys = [i % 5 for i in range(64)]
+        dc = dict_column_from_pylist(strs, T.STRING)
+        assert dc.is_dict
+        cap = dc.dictv.codes.shape[0]
+        kd = jnp.zeros(cap, jnp.int32).at[:64].set(
+            jnp.array(keys, jnp.int32))
+        kv = jnp.zeros(cap, bool).at[:64].set(True)
+
+        def run(v):
+            ks, ags, n = G.groupby_agg(
+                [ColV(kd, kv)], [T.INT], [v, v], ["min", "max"], 64)
+            n = int(n)
+            out = {}
+            kvals = jax.device_get(ks[0].data)[:n]
+            for ai, a in enumerate(ags):
+                s = as_plain_str(a)
+                offs, chars, val = jax.device_get(
+                    (s.offsets, s.chars, s.validity))
+                out[ai] = {
+                    int(kvals[g]): (
+                        bytes(chars[offs[g]:offs[g + 1]]).decode()
+                        if val[g] else None)
+                    for g in range(n)
+                }
+            return out, ags
+
+        dict_out, dict_ags = run(dc.dictv)
+        from spark_rapids_tpu.expr.values import DictV
+
+        assert all(isinstance(a, DictV) for a in dict_ags), (
+            "dict path must keep min/max output dict-encoded")
+        plain_out, _ = run(
+            __import__(
+                "spark_rapids_tpu.expr.values", fromlist=["x"]
+            ).materialize_dict(dc.dictv))
+        oracle = {0: {}, 1: {}}
+        for k, s in zip(keys, strs):
+            if s is None:
+                continue
+            cur = oracle[0].get(k)
+            oracle[0][k] = s if cur is None else min(cur, s)
+            cur = oracle[1].get(k)
+            oracle[1][k] = s if cur is None else max(cur, s)
+        for ai in (0, 1):
+            want = {k: oracle[ai].get(k) for k in set(keys)}
+            assert dict_out[ai] == want
+            assert plain_out[ai] == want
+
+    def test_dict_encoded_through_aggregate_exec(self):
+        """Dict columns through the REAL exec: BoundReference values
+        arrive as DictV, the byte bound comes from static metadata (no
+        host sync), and the buffer batch carries dict-encoded output."""
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.columnar import ColumnarBatch
+        from spark_rapids_tpu.columnar.column import (
+            DeviceColumn,
+            dict_column_from_pylist,
+        )
+        from spark_rapids_tpu.conf import RapidsConf as RC
+        from spark_rapids_tpu.exec import aggregate as XA
+        from spark_rapids_tpu.exec import basic as XB
+
+        n = 48
+        strs = [STR_POOL[i % len(STR_POOL)] for i in range(n)]
+        keys = [i % 3 for i in range(n)]
+        dc = dict_column_from_pylist(strs, T.STRING)
+        cap = dc.dictv.codes.shape[0]
+        kd = jnp.zeros(cap, jnp.int32).at[:n].set(jnp.array(keys, jnp.int32))
+        kv = jnp.zeros(cap, bool).at[:n].set(True)
+        schema = schema_of(k=T.INT, s=T.STRING)
+        batch = ColumnarBatch(
+            [DeviceColumn(T.INT, n, kd, kv), dc], schema, n)
+        conf = RC({})
+        scan = XB.InMemoryScanExec(conf, [[batch]], schema)
+        agg = XA.TpuHashAggregateExec(
+            conf, [col("k")],
+            [A.agg(A.Min(col("s")), "mn"), A.agg(A.Max(col("s")), "mx")],
+            scan)
+        got = {r[0]: (r[1], r[2]) for r in agg.collect()}
+        want = {}
+        for k, s in zip(keys, strs):
+            if s is None:
+                continue
+            mn, mx = want.get(k, (s, s))
+            want[k] = (min(mn, s), max(mx, s))
+        assert got == want
+
+    def test_first_last_string_still_fall_back(self):
+        sess = TpuSession()
+        report = _str_df(sess).group_by("k").agg(
+            A.agg(A.Last(col("s")), "l")).explain()
+        assert "Last: input string is not supported" in report
+
+    def test_projected_computed_string_minmax_is_exact(self):
+        """Review regression: a concat PROJECTED below the aggregate is a
+        direct column ref at the agg — the plan stays on TPU, so the exec
+        must NOT fuse the projection into the update program (the fused
+        bound is measured on the source batch, under-bounding the
+        computed string and truncating the rank comparison). All values
+        here tie on the first 4 bytes and differ at byte 4."""
+        schema = schema_of(k=T.INT, p=T.STRING, s=T.STRING)
+        data = {"k": [1, 1, 2, 2], "p": ["aaaa"] * 4,
+                "s": ["z", "b", "m", "q"]}
+        rows = assert_tpu_and_cpu_equal(
+            lambda sess: sess.create_dataframe(data, schema)
+            .select(col("k"), E.Alias(E.Concat((col("p"), col("s"))), "t"))
+            .group_by("k")
+            .agg(A.agg(A.Min(col("t")), "mn"), A.agg(A.Max(col("t")), "mx")))
+        assert sorted(rows) == [(1, "aaaab", "aaaaz"),
+                                (2, "aaaam", "aaaaq")]
+
+    def test_minmax_same_column_shares_one_rank_sort(self):
+        """min(s)+max(s) over one column must reuse a single rank sort
+        (both lower to the SAME traced value, keyed by identity)."""
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.ops import groupby as G
+        from spark_rapids_tpu.expr.eval import StrV
+        from spark_rapids_tpu.ops import sort as sort_mod
+
+        offs = jnp.array([0, 1, 2, 3, 4], jnp.int32)
+        chars = jnp.array(list(b"dbca"), jnp.uint8)
+        v = StrV(offs, chars, jnp.ones(4, bool))
+        calls = []
+        orig = sort_mod.sort_with_radix_keys
+
+        def counting(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+
+        G.sort_with_radix_keys, saved = counting, G.sort_with_radix_keys
+        try:
+            cols = [v, v]
+            G.string_minmax_ranks(cols, ["min", "max"], 4, (4, 4))
+        finally:
+            G.sort_with_radix_keys = saved
+        assert len(calls) == 1
+
+    def test_computed_string_minmax_falls_back(self):
+        """Review regression: min(concat(s, t)) must NOT run on TPU — a
+        computed string has no static byte bound, so the rank sort would
+        compare only a source-bounded prefix and silently pick the wrong
+        winner. The matrix tags it off with a named reason and results
+        stay correct on the CPU path."""
+        from harness import assert_fallback
+
+        schema = schema_of(k=T.INT, s=T.STRING, t=T.STRING)
+        data = {"k": [1, 1], "s": ["abcd", "abcd"],
+                "t": ["XXXXXXXXXXXXzz", "XXXXXXXXXXXXaa"]}
+
+        def build(sess):
+            return sess.create_dataframe(data, schema).group_by("k").agg(
+                A.agg(A.Min(E.Concat((col("s"), col("t")))), "m"))
+
+        assert_fallback(build, "CpuHashAggregateExec")
+        sess = TpuSession()
+        report = build(sess).explain()
+        assert "direct column references" in report
+        # aliased direct refs stay ON (the alias is transparent)
+        ok = check_aggregate(
+            A.agg(A.Min(E.Alias(col("s"), "x")), "m"), schema,
+            RapidsConf({}))
+        assert ok == []
+
+
+# ---------------------------------------------------------------------------
+# docgen --check and the tracing-hazard lint
+# ---------------------------------------------------------------------------
+class TestGeneratedDocs:
+    def test_docs_in_sync(self):
+        from spark_rapids_tpu.plugin.docgen import check_docs
+
+        assert check_docs(os.path.join(REPO, "docs")) == []
+
+    def test_check_detects_drift(self, tmp_path):
+        from spark_rapids_tpu.plugin.docgen import check_docs, write_docs
+
+        d = str(tmp_path)
+        write_docs(d)
+        assert check_docs(d) == []
+        p = os.path.join(d, "supported_ops.md")
+        with open(p) as f:
+            txt = f.read()
+        with open(p, "w") as f:
+            f.write(txt.replace("| Abs |", "| AbsEdited |", 1))
+        assert check_docs(d) == ["supported_ops.md"]
+
+    def test_doc_reflects_declared_decimal_support(self):
+        """The PR-1 drift: Multiply/Divide/Pmod/Remainder/Bitwise* decimal
+        cells must state DECLARED support, not the probe environment —
+        Multiply decimal = PS (fits-DECIMAL64 note), modulo/bitwise
+        decimal = unsupported, and the probeCrossCheck conf is listed."""
+        with open(os.path.join(REPO, "docs", "supported_ops.md")) as f:
+            ops = f.read()
+        mul_lhs = next(l for l in ops.splitlines()
+                       if l.startswith("| Multiply |"))
+        assert "| S | S | S | S | S | S | S |" in mul_lhs
+        for line in ops.splitlines():
+            if line.startswith("| Pmod |") or line.startswith("| Remainder |"):
+                cells = [c.strip() for c in line.split("|")]
+                assert "PS" not in cells
+            if line.startswith("| BitwiseAnd |"):
+                # integral only: float/double/decimal cells blank
+                assert "| S | S | S | S |  |  |  |" in line
+        with open(os.path.join(REPO, "docs", "configs.md")) as f:
+            cfg = f.read()
+        assert "spark.rapids.tpu.sql.matrix.probeCrossCheck.enabled" in cfg
+        assert "spark.rapids.tpu.tools.lint.allowlistPath" in cfg
+
+
+class TestTpuLint:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "tpu_lint.py"),
+             *args],
+            capture_output=True, text=True)
+
+    def test_repo_is_clean(self):
+        r = self._run("--strict-allowlist")
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_catches_seeded_hazards(self, tmp_path):
+        bad = tmp_path / "spark_rapids_tpu" / "exec"
+        bad.mkdir(parents=True)
+        (bad / "bad.py").write_text(
+            "import jax\nimport numpy as np\n\n\n"
+            "def hot(batch):\n"
+            "    n = batch.num_rows.item()\n"
+            "    return jax.device_get(batch.data), n\n\n\n"
+            "def build(cap):\n"
+            "    def run(cols, num_rows):\n"
+            "        if num_rows > 0:\n"
+            "            return cols\n"
+            "        return np.asarray(cols), float(num_rows)\n\n"
+            "    return jax.jit(run), jax.jit(lambda c: c + 1)\n"
+        )
+        r = self._run(str(tmp_path / "spark_rapids_tpu"))
+        assert r.returncode == 1
+        for rule in ("TPU001", "TPU002", "TPU003"):
+            assert rule in r.stdout, (rule, r.stdout)
+        assert ".item()" in r.stdout
+        assert "lambda" in r.stdout
+        assert "if/while on a traced value" in r.stdout

@@ -1,0 +1,419 @@
+#!/usr/bin/env python3
+"""tpu_lint — repo-directed AST lint for TPU tracing hazards.
+
+The engine's hot path is XLA-traced JAX: the classic regressions are a
+host sync smuggled into a per-batch loop (``.item()``, a stray
+``jax.device_get``), python control flow on a traced value inside a
+jitted function (silent recompiles or trace errors), and jit cache keys
+that churn (a fresh lambda per call compiles every batch). They all
+look innocent in review — this lint makes them CI failures instead.
+
+Rules
+-----
+TPU001  device→host pull outside the sanctioned sync helpers
+        (exec/base.py host_pull/host_fence): ``jax.device_get``,
+        ``jax.block_until_ready``, or ``<expr>.item()`` anywhere in
+        ``spark_rapids_tpu/{exec,ops,expr}/``. One batched pull through
+        the helper costs one tunnel RTT and is auditable; scattered raw
+        pulls are how per-batch RTTs regress.
+TPU002  unstable jit cache key: ``jax.jit(lambda ...)`` (a fresh lambda
+        can never hit the executable cache), ``jax.jit`` called inside a
+        function without storing the result in a cache (subscript
+        assignment or an lru_cache'd enclosing function), or ``id(...)``
+        inside a cache-key tuple (ids are reused after GC).
+TPU003  traced-value hazard inside a jit region: within a function
+        passed to ``jax.jit`` (and its nested defs) — ``float()`` /
+        ``int()`` / ``bool()`` / ``np.asarray()`` applied to a traced
+        parameter, ``.item()``, or an ``if``/``while`` whose test reads
+        a traced parameter (python control flow cannot branch on traced
+        values).
+
+Allowlist
+---------
+``tools/tpu_lint_allow.txt`` (path configurable via the
+``spark.rapids.tpu.tools.lint.allowlistPath`` conf entry): one
+``relpath::qualname::RULE`` per line for the documented legitimate
+sites; ``#`` comments. The sanctioned helpers themselves (exec/base.py)
+are exempt from TPU001 by construction.
+
+Exit status: 0 clean, 1 findings, 2 usage/IO error.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_TARGET = os.path.join(REPO_ROOT, "spark_rapids_tpu")
+#: dirs where ANY raw host-sync primitive is a finding (TPU001); the rest
+#: of the package is host-boundary code where pulls are the point
+SYNC_STRICT_DIRS = ("exec", "ops", "expr")
+SANCTIONED_FILES = (os.path.join("exec", "base.py"),)
+
+JAX_MODULE_ALIASES = {"jax", "_jax", "_jx"}
+NUMPY_ALIASES = {"np", "numpy"}
+
+
+def _default_allowlist_path() -> str:
+    try:
+        sys.path.insert(0, REPO_ROOT)
+        from spark_rapids_tpu.conf import LINT_ALLOWLIST_PATH
+
+        return os.path.join(REPO_ROOT, LINT_ALLOWLIST_PATH.default)
+    except Exception:  # noqa: BLE001 — lint must run without deps
+        return os.path.join(REPO_ROOT, "tools", "tpu_lint_allow.txt")
+
+
+class Finding:
+    __slots__ = ("path", "line", "rule", "qualname", "message")
+
+    def __init__(self, path, line, rule, qualname, message):
+        self.path = path
+        self.line = line
+        self.rule = rule
+        self.qualname = qualname
+        self.message = message
+
+    def key(self) -> str:
+        return f"{self.path}::{self.qualname}::{self.rule}"
+
+    def __str__(self):
+        return (f"{self.path}:{self.line}: {self.rule} [{self.qualname}] "
+                f"{self.message}")
+
+
+def load_allowlist(path: str) -> Set[str]:
+    allowed: Set[str] = set()
+    if not os.path.exists(path):
+        return allowed
+    with open(path) as f:
+        for raw in f:
+            line = raw.split("#", 1)[0].strip()
+            if line:
+                allowed.add(line)
+    return allowed
+
+
+def _attr_chain(node: ast.AST) -> Optional[str]:
+    """'jax.device_get' for Attribute(Name('jax'), 'device_get'), else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Scope:
+    """Qualname + traced-parameter bookkeeping while walking."""
+
+    def __init__(self):
+        self.stack: List[str] = []
+
+    def push(self, name: str):
+        self.stack.append(name)
+
+    def pop(self):
+        self.stack.pop()
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(self.stack) if self.stack else "<module>"
+
+
+def _function_defs(tree: ast.AST) -> Dict[ast.AST, str]:
+    """Every function/lambda node -> qualname."""
+    out: Dict[ast.AST, str] = {}
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[child] = ".".join(stack + [child.name])
+                walk(child, stack + [child.name])
+            elif isinstance(child, ast.Lambda):
+                out[child] = ".".join(stack + ["<lambda>"])
+                walk(child, stack)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, stack + [child.name])
+            else:
+                walk(child, stack)
+
+    walk(tree, [])
+    return out
+
+
+def _parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    par: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            par[child] = node
+    return par
+
+
+def _enclosing_function(node, parents):
+    cur = parents.get(node)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        cur = parents.get(cur)
+    return cur
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    chain = _attr_chain(call.func)
+    return chain is not None and chain.split(".")[0] in JAX_MODULE_ALIASES \
+        and chain.endswith(".jit")
+
+
+def _jit_regions(tree: ast.AST, parents) -> Set[ast.AST]:
+    """Function defs passed to jax.jit — resolved by NAME within the
+    jit call's enclosing function (then module) scope."""
+    regions: Set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_jit_call(node)):
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Lambda):
+            regions.add(arg)
+            continue
+        if not isinstance(arg, ast.Name):
+            continue
+        scope = _enclosing_function(node, parents)
+        while True:
+            body = scope.body if scope is not None else tree.body
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and stmt.name == arg.id:
+                    regions.add(stmt)
+                    break
+            else:
+                if scope is None:
+                    break
+                scope = _enclosing_function(scope, parents)
+                continue
+            break
+    return regions
+
+
+def _region_nodes(region: ast.AST):
+    """All nodes inside a jit region, including nested defs."""
+    yield from ast.walk(region)
+
+
+def _traced_params(region: ast.AST) -> Set[str]:
+    """Parameter names of the jit entry and every nested def (all are
+    trace-time values when the region runs under jax.jit)."""
+    names: Set[str] = set()
+    for node in ast.walk(region):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            a = node.args
+            for p in (list(a.posonlyargs) + list(a.args)
+                      + list(a.kwonlyargs)):
+                names.add(p.arg)
+            if a.vararg:
+                names.add(a.vararg.arg)
+            if a.kwarg:
+                names.add(a.kwarg.arg)
+    names.discard("self")
+    return names
+
+
+def _refs_any(node: ast.AST, names: Set[str]) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id in names for n in ast.walk(node))
+
+
+def _in_cache_store(call: ast.Call, parents) -> bool:
+    """jax.jit(...) whose result lands in a subscript store
+    (``_CACHE[key] = jax.jit(run)``) or is returned from an
+    lru_cache-decorated function."""
+    cur = call
+    while True:
+        parent = parents.get(cur)
+        if parent is None:
+            return False
+        if isinstance(parent, ast.Assign):
+            return any(isinstance(t, ast.Subscript) for t in parent.targets)
+        if isinstance(parent, ast.Return):
+            fn = _enclosing_function(parent, parents)
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in fn.decorator_list:
+                    chain = _attr_chain(dec) or (
+                        _attr_chain(dec.func)
+                        if isinstance(dec, ast.Call) else None)
+                    if chain and ("lru_cache" in chain or chain.endswith(
+                            ".cache") or chain == "cache"):
+                        return True
+            return False
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Module)):
+            return False
+        cur = parent
+
+
+def lint_file(path: str, relpath: str) -> List[Finding]:
+    with open(path, "rb") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(relpath, e.lineno or 0, "TPU000", "<module>",
+                        f"syntax error: {e.msg}")]
+    parents = _parents(tree)
+    qualnames = _function_defs(tree)
+    regions = _jit_regions(tree, parents)
+    region_node_sets = {r: set(ast.walk(r)) for r in regions}
+
+    def qual_of(node) -> str:
+        fn = node if node in qualnames else _enclosing_function(node, parents)
+        while fn is not None and fn not in qualnames:
+            fn = _enclosing_function(fn, parents)
+        return qualnames.get(fn, "<module>")
+
+    findings: List[Finding] = []
+    strict_sync = (
+        any(f"spark_rapids_tpu{os.sep}{d}{os.sep}" in relpath
+            for d in SYNC_STRICT_DIRS)
+        and not any(relpath.endswith(s) for s in SANCTIONED_FILES)
+    )
+
+    in_any_region = set()
+    for s in region_node_sets.values():
+        in_any_region |= s
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        root = chain.split(".")[0] if chain else None
+
+        # --- TPU001: raw host syncs in the strict dirs -------------------
+        if strict_sync:
+            if chain and root in JAX_MODULE_ALIASES and chain.endswith(
+                    (".device_get", ".block_until_ready")):
+                findings.append(Finding(
+                    relpath, node.lineno, "TPU001", qual_of(node),
+                    f"raw {chain.split('.', 1)[1]} — batch it through "
+                    "exec/base.py host_pull()/host_fence()"))
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item" and not node.args):
+                findings.append(Finding(
+                    relpath, node.lineno, "TPU001", qual_of(node),
+                    ".item() is a per-value device sync — pull once via "
+                    "exec/base.py host_pull()"))
+
+        # --- TPU002: unstable jit cache keys -----------------------------
+        if _is_jit_call(node):
+            if node.args and isinstance(node.args[0], ast.Lambda):
+                findings.append(Finding(
+                    relpath, node.lineno, "TPU002", qual_of(node),
+                    "jax.jit(lambda ...): a fresh lambda never hits the "
+                    "executable cache — jit a module-level def"))
+            elif _enclosing_function(node, parents) is not None \
+                    and not _in_cache_store(node, parents):
+                findings.append(Finding(
+                    relpath, node.lineno, "TPU002", qual_of(node),
+                    "jax.jit(...) inside a function without a cache "
+                    "store — every call retraces; keep compiled fns in "
+                    "a keyed cache or an lru_cache'd builder"))
+        if (isinstance(node.func, ast.Name) and node.func.id == "id"
+                and node.args):
+            parent = parents.get(node)
+            if isinstance(parent, ast.Tuple):
+                holder = parents.get(parent)
+                tgt = getattr(holder, "targets", None)
+                names = [t.id for t in (tgt or [])
+                         if isinstance(t, ast.Name)]
+                if any("key" in n.lower() for n in names):
+                    findings.append(Finding(
+                        relpath, node.lineno, "TPU002", qual_of(node),
+                        "id(...) in a cache key: ids are reused after GC "
+                        "and silently alias entries — key on values"))
+
+    # --- TPU003: traced-value hazards inside jit regions -----------------
+    for region in regions:
+        traced = _traced_params(region)
+        qn = qualnames.get(region, "<lambda>")
+        for node in region_node_sets[region]:
+            if isinstance(node, (ast.If, ast.While)):
+                if _refs_any(node.test, traced):
+                    findings.append(Finding(
+                        relpath, node.lineno, "TPU003", qn,
+                        "python if/while on a traced value inside a jit "
+                        "region — use jnp.where/lax.cond"))
+            elif isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item" and not node.args):
+                    findings.append(Finding(
+                        relpath, node.lineno, "TPU003", qn,
+                        ".item() inside a jit region is a trace error / "
+                        "hidden sync"))
+                elif (isinstance(node.func, ast.Name)
+                      and node.func.id in ("float", "int", "bool")
+                      and node.args and _refs_any(node.args[0], traced)):
+                    findings.append(Finding(
+                        relpath, node.lineno, "TPU003", qn,
+                        f"{node.func.id}() on a traced value inside a jit "
+                        "region — trace error; use astype/jnp casts"))
+                elif (chain and chain.split(".")[0] in NUMPY_ALIASES
+                      and chain.endswith(".asarray") and node.args
+                      and _refs_any(node.args[0], traced)):
+                    findings.append(Finding(
+                        relpath, node.lineno, "TPU003", qn,
+                        "np.asarray(traced value) pulls to host inside a "
+                        "jit region — use jnp.asarray"))
+    return findings
+
+
+def iter_py_files(target: str):
+    for dirpath, dirnames, filenames in os.walk(target):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def main(argv: List[str]) -> int:
+    args = [a for a in argv if not a.startswith("--")]
+    target = os.path.abspath(args[0]) if args else DEFAULT_TARGET
+    allow_path = _default_allowlist_path()
+    for a in argv:
+        if a.startswith("--allowlist="):
+            allow_path = a.split("=", 1)[1]
+    if not os.path.exists(target):
+        print(f"tpu_lint: no such target {target}", file=sys.stderr)
+        return 2
+    allowed = load_allowlist(allow_path)
+    findings: List[Finding] = []
+    used: Set[str] = set()
+    for path in iter_py_files(target):
+        rel = os.path.relpath(path, REPO_ROOT)
+        for f in lint_file(path, rel):
+            if f.key() in allowed:
+                used.add(f.key())
+                continue
+            findings.append(f)
+    for f in findings:
+        print(str(f))
+    stale = allowed - used
+    if stale and "--strict-allowlist" in argv:
+        for s in sorted(stale):
+            print(f"tpu_lint: stale allowlist entry: {s}", file=sys.stderr)
+        return 1
+    if findings:
+        print(f"tpu_lint: {len(findings)} finding(s) "
+              f"({len(used)} allowlisted)", file=sys.stderr)
+        return 1
+    print(f"tpu_lint: clean ({len(used)} allowlisted site(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
