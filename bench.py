@@ -125,9 +125,15 @@ def _dev_stats(exec_, bytes_read, tpu_t):
     the agg headline."""
     dev_t = _device_time(exec_)
     gbps = bytes_read / tpu_t / 1e9
+    # static forecast of the HBM bytes this plan touches, from the plan
+    # analyzer (plugin/plananalysis.py) — emitted next to the measured
+    # roofline so BENCH rounds can track forecast accuracy over time
+    from spark_rapids_tpu.plugin.plananalysis import predict_exec_hbm
+
     out = {"hbm_gbps": round(gbps, 1),
            "hbm_frac": round(gbps / HBM_GBPS, 3),
-           "device_ms": round(dev_t * 1e3, 3)}
+           "device_ms": round(dev_t * 1e3, 3),
+           "predicted_hbm_bytes": predict_exec_hbm(exec_)}
     if dev_t >= 1e-4:
         dev_gbps = bytes_read / dev_t / 1e9
         out["hbm_gbps_device"] = round(dev_gbps, 1)

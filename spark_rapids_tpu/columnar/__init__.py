@@ -1,2 +1,8 @@
-from .column import DeviceColumn, HostColumn, column_from_pylist, string_column_from_parts  # noqa: F401
+from .column import (  # noqa: F401
+    DeviceColumn,
+    HostColumn,
+    choose_capacity,
+    column_from_pylist,
+    string_column_from_parts,
+)
 from .batch import ColumnarBatch, batch_from_rows, schema_of  # noqa: F401

@@ -19,15 +19,28 @@ class ColumnarBatch:
     caches; ``num_rows_lazy`` never syncs.
     """
 
-    __slots__ = ("columns", "schema", "_num_rows")
+    __slots__ = ("columns", "schema", "_num_rows", "_capacity")
 
     def __init__(self, columns: Sequence[DeviceColumn], schema: StructType,
-                 num_rows=None):
+                 num_rows=None, capacity: Optional[int] = None):
         self.columns: List[DeviceColumn] = list(columns)
         self.schema = schema
         if num_rows is None:
             num_rows = int(columns[0].length) if columns else 0
         self._num_rows = num_rows
+        # capacity travels on the batch itself so a zero-column batch (a
+        # column-pruning projection feeding count(*)) still knows its row
+        # bucket — reading columns[0] would report 0 and silently truncate
+        # the live mask downstream
+        if self.columns:
+            self._capacity = self.columns[0].capacity
+        elif capacity is not None:
+            self._capacity = capacity
+        else:
+            from .column import choose_capacity
+
+            self._capacity = choose_capacity(
+                num_rows if isinstance(num_rows, int) else 0)
         if isinstance(num_rows, int):
             for c in self.columns:
                 if isinstance(c.length, int) and c.length != num_rows:
@@ -50,7 +63,7 @@ class ColumnarBatch:
 
     @property
     def capacity(self) -> int:
-        return self.columns[0].capacity if self.columns else 0
+        return self._capacity
 
     @property
     def num_columns(self) -> int:
@@ -75,15 +88,16 @@ class ColumnarBatch:
 
     # -- host interchange -------------------------------------------------
     @staticmethod
-    def from_pydict(data: Dict[str, Sequence[Any]], schema: StructType) -> "ColumnarBatch":
+    def from_pydict(data: Dict[str, Sequence[Any]], schema: StructType,
+                    num_rows: Optional[int] = None) -> "ColumnarBatch":
         cols = []
-        n = None
+        n = num_rows
         for f in schema.fields:
             values = data[f.name]
             if n is None:
                 n = len(values)
-            cols.append(column_from_pylist(values, f.dataType))
-        return ColumnarBatch(cols, schema, n or 0)
+            cols.append(column_from_pylist(values, f.dataType, name=f.name))
+        return ColumnarBatch(cols, schema, n if n is not None else 0)
 
     @staticmethod
     def _parallel_get(leaves: List[Any]) -> List[Any]:
@@ -310,7 +324,10 @@ def schema_of(**kwargs: DataType) -> StructType:
 
 
 def batch_from_rows(rows: Sequence[Sequence[Any]], schema: StructType) -> ColumnarBatch:
-    """Row-to-columnar transition (reference: GpuRowToColumnarExec.scala:37)."""
+    """Row-to-columnar transition (reference: GpuRowToColumnarExec.scala:37).
+
+    The row count is passed explicitly: a fully-pruned (zero-column)
+    schema has no column to recover it from."""
     data: Dict[str, List[Any]] = {f.name: [] for f in schema.fields}
     width = len(schema.fields)
     for i, row in enumerate(rows):
@@ -318,4 +335,4 @@ def batch_from_rows(rows: Sequence[Sequence[Any]], schema: StructType) -> Column
             raise ValueError(f"row {i} has {len(row)} values, schema has {width}")
         for f, v in zip(schema.fields, row):
             data[f.name].append(v)
-    return ColumnarBatch.from_pydict(data, schema)
+    return ColumnarBatch.from_pydict(data, schema, num_rows=len(rows))

@@ -39,6 +39,17 @@ def _np_storage(dt: DataType) -> np.dtype:
     return dt.to_numpy()
 
 
+def choose_capacity(rows: int, min_bucket: int = 128) -> int:
+    """THE sanctioned capacity decision for a logical row count.
+
+    Every planner/exec-chosen batch capacity routes through here (and
+    through this module) so the static plan analyzer
+    (plugin/plananalysis.py) can reproduce the exact buckets the runtime
+    will allocate — tools/tpu_lint.py TPU004 flags direct ``bucket_rows``
+    calls outside the columnar layer for the same reason."""
+    return bucket_rows(rows, min_bucket)
+
+
 @dataclasses.dataclass
 class HostColumn:
     """Host mirror of a device column (reference: RapidsHostColumnVector.java).
@@ -109,8 +120,9 @@ class HostColumn:
                 out.append(v)
         return out
 
-    def to_device(self, capacity: Optional[int] = None) -> "DeviceColumn":
-        return DeviceColumn.from_host(self, capacity)
+    def to_device(self, capacity: Optional[int] = None,
+                  name: Optional[str] = None) -> "DeviceColumn":
+        return DeviceColumn.from_host(self, capacity, name)
 
 
 class DeviceColumn:
@@ -175,11 +187,16 @@ class DeviceColumn:
             self.dtype, self.length, None, s.validity, s.offsets, s.chars)
 
     @staticmethod
-    def from_host(host: HostColumn, capacity: Optional[int] = None) -> "DeviceColumn":
+    def from_host(host: HostColumn, capacity: Optional[int] = None,
+                  name: Optional[str] = None) -> "DeviceColumn":
         n = len(host)
-        cap = capacity or bucket_rows(n)
+        cap = capacity or choose_capacity(n)
         if cap < n:
-            raise ValueError(f"capacity {cap} < row count {n}")
+            col = f"column {name!r} ({host.dtype.simpleString})" if name \
+                else f"column of type {host.dtype.simpleString}"
+            raise ValueError(
+                f"{col}: requested capacity {cap} < row count {n} — "
+                "capacity buckets must come from choose_capacity(rows)")
         validity = np.zeros(cap, dtype=bool)
         validity[:n] = host.validity
         if isinstance(host.dtype, (StringType, BinaryType)):
@@ -365,8 +382,9 @@ def dict_column_from_pylist(
         unique=True, dtype=dtype)
 
 
-def column_from_pylist(values: Sequence[Any], dtype: DataType) -> DeviceColumn:
-    return HostColumn.from_pylist(values, dtype).to_device()
+def column_from_pylist(values: Sequence[Any], dtype: DataType,
+                       name: Optional[str] = None) -> DeviceColumn:
+    return HostColumn.from_pylist(values, dtype).to_device(name=name)
 
 
 def string_column_from_parts(

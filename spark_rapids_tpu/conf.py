@@ -331,6 +331,35 @@ MATRIX_PROBE_CROSS_CHECK = conf(
     "primary tagging mechanism; when this is on, a probe-only failure is "
     "conservatively added to the fallback reasons and the disagreement "
     "is kept in typechecks.cross_check_log() for inspection.")
+ANALYSIS_ENABLED = conf(
+    "spark.rapids.tpu.sql.analysis.enabled", True,
+    "Run the static plan analyzer (plugin/plananalysis.py) and render its "
+    "report — per-operator batch layouts, nullability, predicted peak HBM "
+    "footprint, and the forecast of distinct XLA compile signatures per "
+    "pipeline cache site — in explain(). The analysis walks the bound "
+    "plan without lowering or executing anything; see docs/tuning.md.")
+ANALYSIS_CROSS_CHECK = conf(
+    "spark.rapids.tpu.sql.analysis.crossCheck.enabled", False,
+    "Debug: the test harness runs the static plan analyzer for every "
+    "query and asserts its forecasts against reality — actual compile "
+    "cache misses per site never exceed the forecast, measured "
+    "bytesTouched never exceeds the analyzer's byte bound, and "
+    "nullability-elided execution matches the mask-carrying path "
+    "exactly (same pattern as sql.matrix.probeCrossCheck.enabled).")
+ANALYSIS_NULL_ELISION = conf(
+    "spark.rapids.tpu.sql.analysis.nullElision.enabled", True,
+    "Elide validity-plane HBM reads for statically NON_NULL columns at "
+    "fused-pipeline entries: a declared non-null column's validity is "
+    "exactly the liveness mask (padding rows invalid, live rows valid), "
+    "so the iota-derived mask replaces the stored plane bit-for-bit and "
+    "null-park arithmetic folds away. Disable to force the "
+    "mask-carrying path (the analysis cross-check diffs the two).")
+ANALYSIS_STORM_THRESHOLD = conf(
+    "spark.rapids.tpu.sql.analysis.recompileStorm.threshold", 8,
+    "Warn in explain() when the analyzer forecasts at least this many "
+    "distinct compile signatures for ONE pipeline cache site — the "
+    "static recompile-storm detector (the profiler's cache-miss footer "
+    "reports the same storms after the fact).", check=_positive)
 LINT_ALLOWLIST_PATH = conf(
     "spark.rapids.tpu.tools.lint.allowlistPath", "tools/tpu_lint_allow.txt",
     "Path (relative to the repo root) of the tracing-hazard lint's "

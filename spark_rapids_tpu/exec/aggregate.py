@@ -28,7 +28,7 @@ from ..ops import concat as concat_ops
 from ..ops import groupby as groupby_ops
 from ..ops.sort import max_string_len
 from ..types import StructField, StructType
-from ..utils.bucketing import bucket_rows
+from ..columnar.column import choose_capacity
 from .base import (
     TpuExec,
     batch_from_vals,
@@ -53,17 +53,20 @@ def _agg_pipeline(
     approx_float_sum: bool = False,
     sides: Sequence[tuple] = (),
     str_val_max_lens: Tuple[int, ...] = (),
+    nonnull: Tuple[bool, ...] = (),
 ):
     """ONE fused program: child chain (filter/project/join probe...),
     key+input projection, groupby reduce — a whole query stage per
     dispatch. ``str_val_max_lens``: static byte bound per string-typed
-    min/max input, in order (drives the rank sort's chunk count)."""
+    min/max input, in order (drives the rank sort's chunk count).
+    ``nonnull``: the plan analyzer's validity-elision flags for the input
+    columns (ops/filter_gather.elide_validity)."""
     from .base import side_signature
 
     key = (
         tuple(e.fusion_key() for e in chain), key_exprs, key_dtypes,
         value_exprs, ops, sig, cap, str_max_lens, approx_float_sum,
-        side_signature(sides), str_val_max_lens,
+        side_signature(sides), str_val_max_lens, nonnull,
     )
     fn = _AGG_CACHE.get(key)
     if fn is not None:
@@ -71,9 +74,10 @@ def _agg_pipeline(
     chain_t = tuple(chain)
 
     def run(cols, num_rows, side_args):
-        from ..ops.filter_gather import live_of
+        from ..ops.filter_gather import elide_validity, live_of
 
         live = live_of(num_rows, cap)
+        cols = elide_validity(cols, live, nonnull)
         for e, s in zip(chain_t, side_args):
             cols, live = e.lower_batch(cols, live, cap, s)
         keys = [lower(e, cols, cap) for e in key_exprs]
@@ -141,7 +145,7 @@ def _fused_agg_trace(key_exprs, key_dts, value_exprs, update_ops, merge_ops,
             ]
             counts = [p[1] for p in partial_sets]
             pcaps = [p[0][0].validity.shape[0] for p in partial_sets]
-            out_cap = bucket_rows(sum(pcaps), bucket_min)
+            out_cap = choose_capacity(sum(pcaps), bucket_min)
             cols2, mask, _ = concat_ops.concat_padded_cols(
                 col_parts, counts, out_cap)
             merged_vals, nseg = agg_once(
@@ -322,7 +326,7 @@ class TpuHashAggregateExec(TpuExec):
                         ]
                         source_max = max(ms) if ms else 64
                     m = source_max
-                lens.append(max(4, bucket_rows(max(1, m), 4)))
+                lens.append(max(4, choose_capacity(max(1, m), 4)))
         return tuple(lens)
 
     def _str_max_lens(self, batch: ColumnarBatch, direct: bool) -> Tuple[int, ...]:
@@ -331,14 +335,13 @@ class TpuHashAggregateExec(TpuExec):
 
     def _run_batch(self, batch: ColumnarBatch, ops: Sequence[str],
                    value_exprs: Sequence[Optional[E.Expression]],
-                   chain=(), live=None) -> ColumnarBatch:
+                   chain=(), live=None, nonnull=None) -> ColumnarBatch:
         """Aggregate one (source) batch into a [keys..., buffers...] batch,
         fusing any fusable child execs into the same XLA program. The group
         count stays a device scalar — no sync. ``live``: optional (cap,)
         bool mask overriding the batch's prefix row count (used by the
         sync-free merge, where live rows are NOT a prefix)."""
-        cap = batch.capacity if batch.columns else bucket_rows(
-            batch.num_rows, self.conf.shape_bucket_min)
+        cap = batch.capacity  # batches carry their bucket even zero-column
         sml = self._str_max_lens(batch, direct=not chain)
         # string-typed min/max inputs need a static byte bound for the
         # rank sort (one per such input, in op order)
@@ -351,12 +354,16 @@ class TpuHashAggregateExec(TpuExec):
                                         direct=not chain)
         from ..conf import IMPROVED_FLOAT_OPS
 
+        if nonnull is None:  # cold callers (merge, zero-row grand agg)
+            from ..plugin.plananalysis import entry_nonnull_flags
+
+            nonnull = entry_nonnull_flags(batch.schema, self.conf)
         sides = [e.side_vals() for e in chain]
         fn = _agg_pipeline(
             chain, tuple(self._bound_keys), self._key_dtypes(),
             tuple(value_exprs), tuple(ops), batch_signature(batch), cap, sml,
             approx_float_sum=self.conf.get(IMPROVED_FLOAT_OPS),
-            sides=sides, str_val_max_lens=svml,
+            sides=sides, str_val_max_lens=svml, nonnull=nonnull,
         )
         keys, aggs, nseg = fn(
             vals_of_batch(batch),
@@ -375,7 +382,7 @@ class TpuHashAggregateExec(TpuExec):
         at capacity on device with a live mask, so row counts never leave
         the device (a host pull costs a full tunnel RTT per batch)."""
         caps = [max(1, b.capacity) for b in partials]
-        out_cap = bucket_rows(sum(caps), self.conf.shape_bucket_min)
+        out_cap = choose_capacity(sum(caps), self.conf.shape_bucket_min)
         cols, mask, total = concat_ops.concat_padded_cols(
             [vals_of_batch(b) for b in partials],
             [count_scalar(b.num_rows_lazy) for b in partials], out_cap)
@@ -443,14 +450,14 @@ class TpuHashAggregateExec(TpuExec):
                     for c in b.columns:
                         c.length = n
             total = sum(lengths)
-            out_cap = bucket_rows(total, self.conf.shape_bucket_min)
+            out_cap = choose_capacity(total, self.conf.shape_bucket_min)
             ns = len(str_cols)
             byte_lengths = [
                 pulled[nb + i * ns : nb + (i + 1) * ns]
                 for i in range(nb)
             ]
             out_char_caps = [
-                bucket_rows(max(1, sum(bl[k] for bl in byte_lengths)), 128)
+                choose_capacity(max(1, sum(bl[k] for bl in byte_lengths)), 128)
                 for k in range(len(str_cols))
             ]
             cols, n = concat_ops.concat_batches_cols(
@@ -494,11 +501,14 @@ class TpuHashAggregateExec(TpuExec):
     def _evaluate(self, buffers: ColumnarBatch) -> ColumnarBatch:
         """Final projection from [keys..., buffers...] to results."""
         exprs = self._eval_exprs()
+        from ..plugin.plananalysis import entry_nonnull_flags
         from .basic import _project_pipeline
 
         cap = buffers.columns[0].capacity if buffers.columns else 1
-        fn = _project_pipeline(tuple(exprs), batch_signature(buffers), cap)
-        vals = fn(vals_of_batch(buffers))
+        fn = _project_pipeline(
+            tuple(exprs), batch_signature(buffers), cap,
+            entry_nonnull_flags(buffers.schema, self.conf))
+        vals = fn(vals_of_batch(buffers), count_scalar(buffers.num_rows_lazy))
         return batch_from_vals(vals, self._schema, buffers.num_rows_lazy)
 
     # -- whole-stage fusion ------------------------------------------------
@@ -646,7 +656,7 @@ class TpuHashAggregateExec(TpuExec):
         chain_t = tuple(chain)
         sigs = tuple(batch_signature(b) for b in batches)
         caps = tuple(
-            b.capacity if b.columns else bucket_rows(
+            b.capacity if b.columns else choose_capacity(
                 b.num_rows, self.conf.shape_bucket_min)
             for b in batches
         )
@@ -753,12 +763,19 @@ class TpuHashAggregateExec(TpuExec):
         batches: List[ColumnarBatch] = []
         cap_sum = 0
         byte_sum = 0
+        # per-partition constant: the source schema's elision flags
+        # (recomputing per batch would put a conf+schema walk on the
+        # per-batch dispatch hot path)
+        from ..plugin.plananalysis import entry_nonnull_flags
+
+        src_nonnull = entry_nonnull_flags(source.output_schema, self.conf)
 
         def flush_buffered():
             for b in batches:
                 with self.op_timed("update"):
                     partials.append(
-                        self._run_batch(b, ops, exprs, tuple(chain)))
+                        self._run_batch(b, ops, exprs, tuple(chain),
+                                        nonnull=src_nonnull))
             batches.clear()
 
         for batch in source.execute_partition(index):
@@ -768,7 +785,8 @@ class TpuHashAggregateExec(TpuExec):
             if not use_fused:
                 with self.op_timed("update"):
                     partials.append(
-                        self._run_batch(batch, ops, exprs, tuple(chain)))
+                        self._run_batch(batch, ops, exprs, tuple(chain),
+                                        nonnull=src_nonnull))
                 continue
             batches.append(batch)
             cap_sum += max(1, batch.capacity if batch.columns else 1)

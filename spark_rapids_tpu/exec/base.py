@@ -463,7 +463,8 @@ def materialized_batch(batch: ColumnarBatch) -> ColumnarBatch:
 
 
 def batch_from_vals(
-    vals: Sequence[Val], schema: StructType, num_rows: int
+    vals: Sequence[Val], schema: StructType, num_rows: int,
+    capacity: Optional[int] = None,
 ) -> ColumnarBatch:
     cols = []
     for f, v in zip(schema.fields, vals):
@@ -475,7 +476,9 @@ def batch_from_vals(
             )
         else:
             cols.append(DeviceColumn(f.dataType, num_rows, v.data, v.validity))
-    return ColumnarBatch(cols, schema, num_rows)
+    # ``capacity`` matters only for zero-column outputs (fully-pruned
+    # projections): the batch then has no column to carry the bucket
+    return ColumnarBatch(cols, schema, num_rows, capacity=capacity)
 
 
 _FUSED_CACHE: Dict[tuple, Callable] = {}
@@ -496,15 +499,21 @@ def side_signature(sides: Sequence[tuple]) -> tuple:
 
 
 def fused_pipeline(chain: Sequence[TpuExec], sig: tuple, cap: int,
-                   sides: Sequence[tuple] = ()):
+                   sides: Sequence[tuple] = (), nonnull: tuple = ()):
     """One jitted program applying every exec in ``chain`` bottom-up.
 
     The chain threads a liveness MASK between stages; if any stage
     sparsified it (a filter), rows compact once at the end so the emitted
     batch is dense — otherwise the input row count passes straight through.
+
+    ``nonnull``: per-input-column elision flags from the static plan
+    analyzer's nullability lattice (plugin/plananalysis.py) — flagged
+    columns enter the chain with the iota-derived liveness mask as their
+    validity instead of reading the stored plane (see
+    ops/filter_gather.elide_validity for why that is bit-identical).
     """
     key = (tuple(e.fusion_key() for e in chain), sig, cap,
-           side_signature(sides))
+           side_signature(sides), nonnull)
     fn = _FUSED_CACHE.get(key)
     if fn is None:
         chain_t = tuple(chain)
@@ -514,6 +523,7 @@ def fused_pipeline(chain: Sequence[TpuExec], sig: tuple, cap: int,
             from ..ops import filter_gather
 
             live = filter_gather.live_of(num_rows, cap)
+            cols = filter_gather.elide_validity(cols, live, nonnull)
             for e, s in zip(chain_t, side_args):
                 cols, live = e.lower_batch(cols, live, cap, s)
             if needs_compact:
@@ -532,17 +542,21 @@ def run_fused_chain(exec_self: TpuExec, index: int) -> Iterator[ColumnarBatch]:
     """Shared execute_partition for fusable execs: the whole chain below
     (and including) ``exec_self`` runs as one XLA dispatch per batch, with
     the row count threaded through as a device scalar (no host syncs)."""
+    from ..plugin.plananalysis import entry_nonnull_flags
+
     source, chain = exec_self.fused_source_chain()
     out_schema = exec_self.output_schema
     sides = [e.side_vals() for e in chain]
+    nonnull = entry_nonnull_flags(source.output_schema, exec_self.conf)
     for batch in source.execute_partition(index):
         with exec_self.op_timed():
-            cap = batch.capacity if batch.columns else 128
-            fn = fused_pipeline(chain, batch_signature(batch), cap, sides)
+            cap = batch.capacity
+            fn = fused_pipeline(chain, batch_signature(batch), cap, sides,
+                                nonnull)
             vals, nr = fn(
                 vals_of_batch(batch), count_scalar(batch.num_rows_lazy),
                 sides)
-            out = batch_from_vals(vals, out_schema, nr)
+            out = batch_from_vals(vals, out_schema, nr, capacity=cap)
         yield exec_self.record_batch(out)
 
 

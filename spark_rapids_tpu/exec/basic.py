@@ -28,7 +28,7 @@ from ..expr.eval import ColV, StrV, lower
 from ..ops import concat as concat_ops
 from ..ops import filter_gather
 from ..types import StructField, StructType
-from ..utils.bucketing import bucket_rows
+from ..columnar.column import choose_capacity
 from .base import (
     NUM_OUTPUT_BATCHES,
     NUM_OUTPUT_ROWS,
@@ -92,8 +92,14 @@ class InMemoryScanExec(TpuExec):
 _PROJECT_CACHE: dict = {}
 
 
-def _project_pipeline(exprs: Tuple[E.Expression, ...], sig: tuple, cap: int):
-    key = (exprs, sig, cap)
+def _project_pipeline(exprs: Tuple[E.Expression, ...], sig: tuple, cap: int,
+                      nonnull: Tuple[bool, ...] = ()):
+    """Standalone projection program. ``nonnull``: the plan analyzer's
+    validity-elision flags for the input columns — flagged columns swap
+    their stored validity plane for the iota-derived liveness mask
+    (ops/filter_gather.elide_validity); the compiled fn takes
+    ``(cols, num_rows)`` either way so call sites stay uniform."""
+    key = (exprs, sig, cap, nonnull)
     fn = _PROJECT_CACHE.get(key)
     if fn is None:
         if len(_PROJECT_CACHE) > 512:
@@ -102,7 +108,10 @@ def _project_pipeline(exprs: Tuple[E.Expression, ...], sig: tuple, cap: int):
 
         note_compile_miss("project")
 
-        def run(cols):
+        def run(cols, num_rows):
+            if nonnull and any(nonnull):
+                live = filter_gather.live_of(num_rows, cap)
+                cols = filter_gather.elide_validity(cols, live, nonnull)
             return [lower(e, cols, cap) for e in exprs]
 
         fn = _PROJECT_CACHE[key] = jax.jit(run)
@@ -259,7 +268,7 @@ class TpuProjectExec(TpuExec):
         row_base = 0
         for batch in child.execute_partition(index):
             with self.op_timed("ctx"):
-                cap = batch.capacity if batch.columns else 128
+                cap = batch.capacity
                 extra_cols, extra_fields = self._ctx_columns(
                     batch, index, row_base, cap, fpath)
                 ext = ColumnarBatch(
@@ -268,7 +277,9 @@ class TpuProjectExec(TpuExec):
                     batch.num_rows_lazy)
                 fn = _project_pipeline(
                     rewritten, batch_signature(ext), cap)
-                vals = fn(vals_of_batch(ext))
+                from .base import count_scalar as _cs
+
+                vals = fn(vals_of_batch(ext), _cs(batch.num_rows_lazy))
                 out = batch_from_vals(vals, self._schema, batch.num_rows_lazy)
             yield self.record_batch(out)
             nr = batch.num_rows_lazy
@@ -340,7 +351,7 @@ class TpuRangeExec(TpuExec):
         pos = lo
         while pos < hi:
             n = min(max_rows, hi - pos)
-            cap = bucket_rows(n, self.conf.shape_bucket_min)
+            cap = choose_capacity(n, self.conf.shape_bucket_min)
             base = self.start + pos * self.step
             data = jnp.arange(cap, dtype=jnp.int64) * self.step + base
             live = jnp.arange(cap, dtype=jnp.int32) < n
@@ -398,7 +409,7 @@ class TpuLocalLimitExec(TpuExec):
                     return  # don't pull (compute) another child batch
             else:
                 vals, count = filter_gather.slice_cols(
-                    vals_of_batch(batch), 0, bucket_rows(remaining, self.conf.shape_bucket_min),
+                    vals_of_batch(batch), 0, choose_capacity(remaining, self.conf.shape_bucket_min),
                     jnp.int32(min(remaining, batch.num_rows)),
                 )
                 out = batch_from_vals(vals, self.output_schema, remaining)
@@ -439,7 +450,7 @@ class TpuCollectLimitExec(TpuExec):
                 else:
                     vals, count = filter_gather.slice_cols(
                         vals_of_batch(batch), 0,
-                        bucket_rows(remaining, self.conf.shape_bucket_min),
+                        choose_capacity(remaining, self.conf.shape_bucket_min),
                         jnp.int32(remaining),
                     )
                     out = batch_from_vals(vals, self.output_schema, remaining)
@@ -471,14 +482,19 @@ class TpuExpandExec(TpuExec):
         return self._schema
 
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        from ..plugin.plananalysis import entry_nonnull_flags
+        from .base import count_scalar
+
+        nonnull = entry_nonnull_flags(
+            self.children[0].output_schema, self.conf)
         for batch in self.children[0].execute_partition(index):
-            cap = batch.columns[0].capacity if batch.columns else bucket_rows(batch.num_rows)
+            cap = batch.capacity
             sig = batch_signature(batch)
             vals_in = vals_of_batch(batch)
             for bound in self._bound:
                 with self.op_timed():
-                    fn = _project_pipeline(bound, sig, cap)
-                    vals = fn(vals_in)
+                    fn = _project_pipeline(bound, sig, cap, nonnull)
+                    vals = fn(vals_in, count_scalar(batch.num_rows))
                     out = batch_from_vals(vals, self._schema, batch.num_rows)
                 yield self.record_batch(out)
 
@@ -509,7 +525,7 @@ class TpuCoalesceBatchesExec(TpuExec):
         pending = [materialized_batch(b) for b in pending]
         lengths = [b.num_rows for b in pending]
         total = sum(lengths)
-        out_cap = bucket_rows(total, self.conf.shape_bucket_min)
+        out_cap = choose_capacity(total, self.conf.shape_bucket_min)
         str_cols = [
             j for j, f in enumerate(self.output_schema.fields)
             if isinstance(f.dataType, (T.StringType, T.BinaryType))
@@ -519,7 +535,7 @@ class TpuCoalesceBatchesExec(TpuExec):
             bl = [int(b.columns[j].offsets[b.num_rows]) for j in str_cols]
             byte_lengths.append(bl)
         out_char_caps = [
-            bucket_rows(max(1, sum(byte_lengths[i][k] for i in range(len(pending)))), 128)
+            choose_capacity(max(1, sum(byte_lengths[i][k] for i in range(len(pending)))), 128)
             for k in range(len(str_cols))
         ]
         cols, n = concat_ops.concat_batches_cols(

@@ -37,7 +37,7 @@ from ..shuffle.transport import (
     new_shuffle_id,
 )
 from ..types import StructType
-from ..utils.bucketing import bucket_rows
+from ..columnar.column import choose_capacity
 from .base import (
     TOTAL_TIME,
     TpuExec,
@@ -134,8 +134,8 @@ def _slice_piece(
     (host ints synced at the map boundary)."""
     n = b - a
     byte_lens = tuple(bb - ba for ba, bb in str_bounds)
-    pcap = bucket_rows(max(1, n))
-    ccaps = tuple(bucket_rows(max(1, bl), 128) for bl in byte_lens)
+    pcap = choose_capacity(max(1, n))
+    ccaps = tuple(choose_capacity(max(1, bl), 128) for bl in byte_lens)
     fn = _piece_slicer(_vals_signature(vals), pcap, ccaps)
     out = fn(vals, jnp.int32(a), jnp.int32(n))
     return ShufflePiece(out, n, byte_lens)
@@ -152,9 +152,9 @@ def concat_pieces(
     sizes reuse the same executable)."""
     lengths = [p.n for p in pieces]
     n_str = len(pieces[0].byte_lens)
-    out_cap = bucket_rows(max(1, sum(lengths)))
+    out_cap = choose_capacity(max(1, sum(lengths)))
     out_char_caps = tuple(
-        bucket_rows(max(1, sum(p.byte_lens[k] for p in pieces)), 128)
+        choose_capacity(max(1, sum(p.byte_lens[k] for p in pieces)), 128)
         for k in range(n_str)
     )
     sigs = tuple(_vals_signature(p.vals) for p in pieces)
@@ -221,7 +221,7 @@ class TpuShuffleExchangeExec(TpuExec):
             c = batch.columns[i]
             if c.is_string:
                 m = int(max_string_len(StrV(c.offsets, c.chars, c.validity)))
-                lens.append(max(4, bucket_rows(max(1, m), 4)))
+                lens.append(max(4, choose_capacity(max(1, m), 4)))
         return tuple(lens)
 
     def _map_fn(self, sig: tuple, cap: int, schema: StructType,

@@ -28,7 +28,7 @@ from ..ops import filter_gather
 from ..ops import join as join_ops
 from ..ops.sort import max_string_len, sort_with_radix_keys, SortOrder
 from ..types import StructField, StructType
-from ..utils.bucketing import bucket_rows
+from ..columnar.column import choose_capacity
 
 
 class _SpillableBuild:
@@ -104,9 +104,9 @@ def _concat_batches(
         [int(b.columns[j].offsets[b.num_rows]) for j in str_cols]
         for b in batches
     ]
-    out_cap = bucket_rows(sum(lengths))
+    out_cap = choose_capacity(sum(lengths))
     out_char_caps = [
-        bucket_rows(max(1, sum(bl[k] for bl in byte_lengths)), 128)
+        choose_capacity(max(1, sum(bl[k] for bl in byte_lengths)), 128)
         for k in range(len(str_cols))
     ]
     cols, n = concat_ops.concat_batches_cols(
@@ -206,7 +206,7 @@ class TpuShuffledHashJoinExec(TpuExec):
                     m = int(max_string_len(StrV(c.offsets, c.chars, c.validity)))
                 else:
                     m = 64
-                lens.append(max(4, bucket_rows(max(1, m), 4)))
+                lens.append(max(4, choose_capacity(max(1, m), 4)))
         return tuple(lens)
 
     def _concat_build(self) -> ColumnarBatch:
@@ -237,7 +237,7 @@ class TpuShuffledHashJoinExec(TpuExec):
                     {f.name: [] for f in bschema.fields}, bschema)
         else:
             batch = self._concat_build()
-        cap = batch.capacity if batch.columns else 128
+        cap = batch.capacity
         n = batch.num_rows
         sml = self._key_str_lens(batch, self._build_keys)
 
@@ -320,7 +320,7 @@ class TpuShuffledHashJoinExec(TpuExec):
             self._fast_built = False
             return False
         batch = self._concat_build()
-        bcap = batch.capacity if batch.columns else 128
+        bcap = batch.capacity
         tbl = 4 * bcap
         need_mat = self._jt in ("inner", "left")
         kd = [k.dtype for k in self._build_keys]
@@ -540,7 +540,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         total = int(jnp.sum(aux))
         if total == 0:
             return None, matched
-        out_cap = bucket_rows(total, self.conf.shape_bucket_min)
+        out_cap = choose_capacity(total, self.conf.shape_bucket_min)
 
         has_strings = any(isinstance(c, StrV) for c in build_cols) or any(
             c.is_string for c in pbatch.columns)
@@ -558,7 +558,7 @@ class TpuShuffledHashJoinExec(TpuExec):
                         lens = c.offsets[1:] - c.offsets[:-1]
                         need = jnp.sum(jnp.where(
                             live_mask, jnp.take(lens, rows, mode="clip"), 0))
-                        caps.append(bucket_rows(max(1, int(need)), 128))
+                        caps.append(choose_capacity(max(1, int(need)), 128))
                 return caps
 
             probe_side = filter_gather.gather(
@@ -691,14 +691,14 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             np_ = pbatch.num_rows
             if np_ == 0 or nb == 0:
                 continue
-            out_cap = bucket_rows(np_ * nb, self.conf.shape_bucket_min)
+            out_cap = choose_capacity(np_ * nb, self.conf.shape_bucket_min)
             pcap = pbatch.capacity
             pcaps = [
-                bucket_rows(max(1, int(c.offsets[np_]) * nb), 128)
+                choose_capacity(max(1, int(c.offsets[np_]) * nb), 128)
                 for c in pbatch.columns if c.is_string
             ]
             bcaps = [
-                bucket_rows(max(1, int(c.offsets[nb]) * np_), 128)
+                choose_capacity(max(1, int(c.offsets[nb]) * np_), 128)
                 for c in build.columns if c.is_string
             ]
 

@@ -17,7 +17,7 @@ from ..columnar import ColumnarBatch
 from ..columnar.column import DeviceColumn
 from ..conf import RapidsConf
 from ..types import StructType
-from ..utils.bucketing import bucket_rows
+from ..columnar.column import choose_capacity
 from .base import TpuExec
 
 SCAN_TIME = "scanTime"  # reference metric name (GpuMetricNames)
@@ -35,7 +35,7 @@ def constant_string_column(value, n: int, cap: int) -> DeviceColumn:
             chars=jnp.zeros(1, jnp.uint8))
     b = str(value).encode("utf-8")
     L = len(b)
-    ccap = bucket_rows(max(1, L * n), 128)
+    ccap = choose_capacity(max(1, L * n), 128)
     offsets = np.minimum(np.arange(cap + 1, dtype=np.int64) * L,
                          L * n).astype(np.int32)
     chars = np.zeros(ccap, np.uint8)

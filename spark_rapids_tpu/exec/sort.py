@@ -22,7 +22,7 @@ from ..expr.eval import StrV, lower
 from ..ops import filter_gather
 from ..ops.sort import SortOrder, max_string_len, sort_permutation
 from ..types import StructType
-from ..utils.bucketing import bucket_rows
+from ..columnar.column import choose_capacity
 from .base import (
     TOTAL_TIME,
     TpuExec,
@@ -93,7 +93,7 @@ class TpuSortExec(TpuExec):
                     m = int(max_string_len(StrV(c.offsets, c.chars, c.validity)))
                 else:
                     m = 64
-                lens.append(max(4, bucket_rows(max(1, m), 4)))
+                lens.append(max(4, choose_capacity(max(1, m), 4)))
         return tuple(lens)
 
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
@@ -103,7 +103,7 @@ class TpuSortExec(TpuExec):
         from .base import materialized_batch
 
         batch = materialized_batch(batch)  # chunk keys want plain bytes
-        cap = batch.capacity if batch.columns else 128
+        cap = batch.capacity
         sml = self._str_lens(batch)
 
         def run(cols, num_rows):

@@ -34,7 +34,7 @@ from ..ops.sort import (
     string_chunk_keys,
 )
 from ..types import StructField, StructType
-from ..utils.bucketing import bucket_rows
+from ..columnar.column import choose_capacity
 from .base import (
     TOTAL_TIME,
     TpuExec,
@@ -105,7 +105,7 @@ class TpuWindowExec(TpuExec):
                     m = int(max_string_len(StrV(c.offsets, c.chars, c.validity)))
                 else:
                     m = 64
-                lens.append(max(4, bucket_rows(max(1, m), 4)))
+                lens.append(max(4, choose_capacity(max(1, m), 4)))
         return tuple(lens)
 
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
@@ -113,7 +113,7 @@ class TpuWindowExec(TpuExec):
         batch = _concat_all(self.conf, self.children[0])
         if batch is None:
             return
-        cap = batch.capacity if batch.columns else 128
+        cap = batch.capacity
         all_keys = self._part_keys + self._order_keys
         sml = self._str_lens(batch, all_keys)
         frame = self.spec.resolved_frame()

@@ -19,7 +19,7 @@ pin this against the independent CPU interpreter.
 from __future__ import annotations
 
 import functools
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -864,6 +864,25 @@ def _compiled(exprs: Tuple[E.Expression, ...], cap: int, schema_sig: tuple):
     return jax.jit(run)
 
 
+@functools.lru_cache(maxsize=512)
+def _compiled_elided(exprs: Tuple[E.Expression, ...], cap: int,
+                     schema_sig: tuple, nonnull: Tuple[bool, ...]):
+    """Like :func:`_compiled`, but with the plan analyzer's validity
+    elision applied at entry: statically NON_NULL columns swap their
+    stored validity plane for the iota-derived liveness mask (see
+    ops/filter_gather.elide_validity) — the traced row count makes the
+    mask, so the plane is never read from HBM."""
+
+    def run(cols, num_rows):
+        from ..ops.filter_gather import elide_validity, live_of
+
+        live = live_of(num_rows, cap)
+        cols = elide_validity(cols, live, nonnull)
+        return [lower(e, cols, cap) for e in exprs]
+
+    return jax.jit(run)
+
+
 def tpu_supports(expr: E.Expression, schema: T.StructType) -> Tuple[bool, str]:
     """Static supportability probe used by the planner: trace with abstract
     values; UnsupportedExpressionError means fallback."""
@@ -912,19 +931,43 @@ def _walk_expressions(expr: E.Expression):
 
 
 def evaluate_projection(
-    bound_exprs: Sequence[E.Expression], batch: ColumnarBatch
+    bound_exprs: Sequence[E.Expression], batch: ColumnarBatch,
+    nonnull: Optional[Tuple[bool, ...]] = None,
+    conf=None,
 ) -> List[DeviceColumn]:
     """Evaluate bound expressions against a batch, one fused XLA call.
 
     Reference analog: GpuProjectExec.project (basicPhysicalOperators.scala:48)
     doing per-expression columnarEval; here it is a single executable.
+    ``nonnull``: per-column validity-elision flags (the plan analyzer's
+    nullability lattice; a flagged column's stored validity plane is
+    skipped in favor of the liveness mask — bit-identical, see
+    ops/filter_gather.elide_validity). When not given, flags derive from
+    the batch schema through plananalysis.entry_nonnull_flags IF a
+    ``conf`` (RapidsConf) is passed — which honors
+    sql.analysis.nullElision.enabled, so disabling the conf forces the
+    mask-carrying path here exactly as it does in the exec pipelines.
+    With neither, the mask-carrying path runs.
     """
-    cap = batch.columns[0].capacity if batch.columns else 128
-    from ..exec.base import batch_signature
+    if nonnull is None:
+        if conf is not None:
+            from ..plugin.plananalysis import entry_nonnull_flags
+
+            nonnull = entry_nonnull_flags(batch.schema, conf)
+        else:
+            nonnull = ()
+    cap = batch.capacity  # batches carry their bucket even zero-column
+    from ..exec.base import batch_signature, count_scalar
 
     schema_sig = batch_signature(batch)
-    fn = _compiled(tuple(bound_exprs), cap, schema_sig)
-    vals = fn([_col_to_vals(c) for c in batch.columns])
+    if nonnull and any(nonnull):
+        fn = _compiled_elided(tuple(bound_exprs), cap, schema_sig,
+                              tuple(nonnull))
+        vals = fn([_col_to_vals(c) for c in batch.columns],
+                  count_scalar(batch.num_rows_lazy))
+    else:
+        fn = _compiled(tuple(bound_exprs), cap, schema_sig)
+        vals = fn([_col_to_vals(c) for c in batch.columns])
     out = []
     for e, v in zip(bound_exprs, vals):
         if isinstance(v, DictV):

@@ -19,7 +19,7 @@ import numpy as np
 
 from .. import types as T
 from ..ops import strings as S
-from ..utils.bucketing import bucket_rows
+from ..columnar.column import choose_capacity
 from . import expressions as E
 from .values import ColV, StrV, UnsupportedExpressionError
 
@@ -228,7 +228,7 @@ def format_date(c: ColV, cap: int) -> StrV:
         jnp.int32)
     new_offsets = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(lens)])
-    out_cap = bucket_rows(max(cap * 11, 128))
+    out_cap = choose_capacity(max(cap * 11, 128))
     pos = jnp.arange(out_cap, dtype=jnp.int32)
     rid = S.rows_of_positions(new_offsets, pos.shape[0])
     w = pos - new_offsets[:-1][rid]
@@ -280,7 +280,7 @@ def format_timestamp(c: ColV, cap: int, with_fraction: bool = True) -> StrV:
         c.validity, base + jnp.where(fdig > 0, fdig + 1, 0), 0
     ).astype(jnp.int32)
     new_offsets = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(lens)])
-    out_cap = bucket_rows(max(cap * 27, 128))
+    out_cap = choose_capacity(max(cap * 27, 128))
     pos = jnp.arange(out_cap, dtype=jnp.int32)
     rid = S.rows_of_positions(new_offsets, pos.shape[0])
     w = pos - new_offsets[:-1][rid]

@@ -22,7 +22,7 @@ import numpy as np
 
 from .. import types as T
 from ..ops import strings as S
-from ..utils.bucketing import bucket_rows
+from ..columnar.column import choose_capacity
 from . import expressions as E
 from .values import (
     ColV,
@@ -522,7 +522,7 @@ def _replace(expr: E.StringReplace, c: StrV, cap: int) -> StrV:
     cnt = P[c.offsets[1:]] - P[c.offsets[:-1]]
     new_lens = jnp.where(c.validity, lens + cnt * (mr - ms), 0)
     new_offsets = S.offsets_of_lens(new_lens)
-    out_cap = n if mr <= ms else bucket_rows(n // ms * (mr - ms) + n)
+    out_cap = n if mr <= ms else choose_capacity(n // ms * (mr - ms) + n)
     in_match = (P[pos + 1] - P[jnp.clip(pos - ms + 1, 0, n)]) > 0
     repl_before = P[pos] - P[c.offsets[:-1]][rid]
     fwd = within + repl_before * (mr - ms)
@@ -574,7 +574,7 @@ def _pad(expr, c: StrV, cap: int, left: bool) -> StrV:
     str_bytes = jnp.where(trunc, tb, lens)
     out_lens = jnp.where(c.validity, str_bytes + jnp.where(trunc, 0, pad_bytes), 0)
     new_offsets = S.offsets_of_lens(out_lens)
-    out_cap = bucket_rows(max(cap * 4 * L, 1))
+    out_cap = choose_capacity(max(cap * 4 * L, 1))
     opos = jnp.arange(out_cap, dtype=jnp.int32)
     rid = S.rows_of_positions(new_offsets, opos.shape[0])
     w = opos - new_offsets[:-1][rid]
@@ -915,7 +915,7 @@ def cast_int_to_string(c: ColV, cap: int, frm: T.DataType) -> StrV:
     nd = jnp.where(mag == 0, 1, hi + 1).astype(jnp.int32)
     lens = jnp.where(c.validity, nd + neg.astype(jnp.int32), 0)
     new_offsets = S.offsets_of_lens(lens)
-    out_cap = bucket_rows(max(cap * 20, 128))
+    out_cap = choose_capacity(max(cap * 20, 128))
     pos = jnp.arange(out_cap, dtype=jnp.int32)
     rid = S.rows_of_positions(new_offsets, pos.shape[0])
     w = pos - new_offsets[:-1][rid]
@@ -930,7 +930,7 @@ def cast_int_to_string(c: ColV, cap: int, frm: T.DataType) -> StrV:
 def cast_bool_to_string(c: ColV, cap: int) -> StrV:
     lens = jnp.where(c.validity, jnp.where(c.data, 4, 5), 0)
     new_offsets = S.offsets_of_lens(lens)
-    out_cap = bucket_rows(max(cap * 5, 128))
+    out_cap = choose_capacity(max(cap * 5, 128))
     tpat = jnp.asarray(np.frombuffer(b"true\x00", np.uint8))
     fpat = jnp.asarray(np.frombuffer(b"false", np.uint8))
     pos = jnp.arange(out_cap, dtype=jnp.int32)

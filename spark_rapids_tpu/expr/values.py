@@ -127,13 +127,13 @@ def dict_rewrap(v: DictV, out_dict: StrV, mat_growth: int = 1,
     """
     import jax.numpy as jnp
 
-    from ..utils.bucketing import bucket_rows
+    from ..columnar.column import choose_capacity
 
     idx = clipped_codes(v)
     validity = v.validity & jnp.take(out_dict.validity, idx, mode="clip")
     dict_valid = jnp.ones(v.dict_size, jnp.bool_)
     mat_cap = (v.mat_cap if mat_growth == 1
-               else bucket_rows(max(1, v.mat_cap * mat_growth), 128))
+               else choose_capacity(max(1, v.mat_cap * mat_growth), 128))
     return DictV(
         v.codes, StrV(out_dict.offsets, out_dict.chars, dict_valid),
         validity, mat_cap, v.max_len * mat_growth, unique)

@@ -14,7 +14,7 @@ import numpy as np
 from .. import types as T
 from ..columnar.batch import ColumnarBatch
 from ..columnar.column import DeviceColumn
-from ..utils.bucketing import bucket_rows
+from ..columnar.column import choose_capacity
 
 
 def arrow_type_to_tpu(at) -> T.DataType:
@@ -194,7 +194,7 @@ def arrow_to_batch(table_or_rb, schema: Optional[T.StructType] = None,
     if schema is None:
         schema = arrow_schema_to_tpu(a_schema)
     n = table_or_rb.num_rows
-    cap = capacity or bucket_rows(max(1, n))
+    cap = capacity or choose_capacity(max(1, n))
     staged: List[np.ndarray] = []
     plans: List[tuple] = []  # per column: ("s", dt) | ("f", dt)
     for arr, f in zip(arrays, schema.fields):
@@ -203,7 +203,7 @@ def arrow_to_batch(table_or_rb, schema: Optional[T.StructType] = None,
         if len(parts) == 3:
             offsets, chars, validity = parts
             nb = int(offsets[n]) if n else 0
-            ccap = bucket_rows(max(1, nb), 128)
+            ccap = choose_capacity(max(1, nb), 128)
             o = np.zeros(cap + 1, np.int32)
             o[: n + 1] = offsets[: n + 1]
             o[n + 1:] = nb

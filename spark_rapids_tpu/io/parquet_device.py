@@ -587,7 +587,7 @@ def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int,
     import jax
     import jax.numpy as jnp
 
-    from ..utils.bucketing import bucket_rows
+    from ..columnar.column import choose_capacity
 
     n = plan.num_values
     has_def = plan.validity is not None
@@ -624,7 +624,7 @@ def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int,
         if plan.dict_offsets is not None and plan.dict_offsets.shape[0] < 2:
             plan.dict_offsets = np.zeros(2, np.int64)
         codes = plan.codes
-        pcap = bucket_rows(max(1, codes.shape[0]))
+        pcap = choose_capacity(max(1, codes.shape[0]))
         if codes.shape[0] < pcap:
             codes = np.concatenate(
                 [codes, np.zeros(pcap - codes.shape[0], codes.dtype)])
@@ -639,7 +639,7 @@ def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int,
                     minlength=D,
                 ) @ lens
             ) if plan.codes.shape[0] else 0
-            ccap = bucket_rows(max(1, total_bytes), 128)
+            ccap = choose_capacity(max(1, total_bytes), 128)
             max_len = int(lens.max()) if D > 0 and lens.size else 0
             args += [np.ascontiguousarray(plan.dict_offsets.astype(np.int32)),
                      np.ascontiguousarray(plan.dict_chars)]
@@ -845,7 +845,7 @@ def row_group_device_plans(
     beyond the argument uploads, so the consumer can splice ``run`` into
     one fused stage program. Returns None when ANY column needs the host
     decoder (the fused program has no host path)."""
-    from ..utils.bucketing import bucket_rows
+    from ..columnar.column import choose_capacity
 
     md = pf.metadata
     rgmd = md.row_group(rg)
@@ -854,7 +854,7 @@ def row_group_device_plans(
         rgmd.column(i).path_in_schema: i for i in range(rgmd.num_columns)
     }
     n = rgmd.num_rows
-    cap = bucket_rows(max(1, n))
+    cap = choose_capacity(max(1, n))
     plans, fallback_cols = _plan_columns(
         path, pf, rgmd, pqschema, name_to_ci, columns, file_bytes)
     if fallback_cols or len(plans) != len(columns):
@@ -881,7 +881,7 @@ def read_row_group_device(
     when NO column takes the device path (caller uses the plain reader)."""
     from ..columnar.batch import ColumnarBatch
     from ..types import StructType
-    from ..utils.bucketing import bucket_rows
+    from ..columnar.column import choose_capacity
 
     md = pf.metadata
     rgmd = md.row_group(rg)
@@ -890,7 +890,7 @@ def read_row_group_device(
         rgmd.column(i).path_in_schema: i for i in range(rgmd.num_columns)
     }
     n = rgmd.num_rows
-    cap = bucket_rows(max(1, n))
+    cap = choose_capacity(max(1, n))
 
     plans, fallback_cols = _plan_columns(
         path, pf, rgmd, pqschema, name_to_ci, columns, file_bytes)

@@ -49,6 +49,29 @@ INPUT_FROM_SHUFFLE_PRIORITY = -50
 ACTIVE_BATCHING_PRIORITY = 0
 
 
+def derive_hbm_budget(conf_: RapidsConf) -> Optional[int]:
+    """The device-tier spill budget: explicit hbm.budgetBytes, else
+    allocFraction * device memory, else None (unlimited / accounting
+    only). ONE derivation shared by the catalog and the static plan
+    analyzer (plugin/plananalysis.py), so the plan-time OOM warning and
+    the runtime spill trigger can never disagree on the budget."""
+    explicit = conf_.get(HBM_BUDGET_BYTES)
+    if explicit:
+        return int(explicit)
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+        limit = stats.get("bytes_limit") if stats else None
+    except Exception:  # pragma: no cover - backend-dependent
+        limit = None
+    if not limit:
+        return None
+    frac = conf_.get(HBM_POOL_FRACTION)
+    reserve = conf_.get(HBM_RESERVE)
+    return max(int(limit * frac) - reserve, 1 << 20)
+
+
 class SpillMetrics:
     def __init__(self):
         self.device_to_host = 0
@@ -94,21 +117,7 @@ class BufferCatalog:
             return cls._instance
 
     def _derive_budget(self) -> Optional[int]:
-        explicit = self.conf.get(HBM_BUDGET_BYTES)
-        if explicit:
-            return int(explicit)
-        try:
-            import jax
-
-            stats = jax.devices()[0].memory_stats()
-            limit = stats.get("bytes_limit") if stats else None
-        except Exception:  # pragma: no cover - backend-dependent
-            limit = None
-        if not limit:
-            return None  # unlimited: accounting only
-        frac = self.conf.get(HBM_POOL_FRACTION)
-        reserve = self.conf.get(HBM_RESERVE)
-        return max(int(limit * frac) - reserve, 1 << 20)
+        return derive_hbm_budget(self.conf)
 
     # -- registration ------------------------------------------------------
     def register(self, handle: "SpillableHandle") -> int:

@@ -30,6 +30,33 @@ def live_of(num_rows_or_mask, cap: int) -> jax.Array:
     return jnp.arange(cap, dtype=jnp.int32) < x
 
 
+def elide_validity(cols: Sequence[Val], live: jax.Array,
+                   nonnull: Sequence[bool]) -> List[Val]:
+    """Validity-plane elision for statically NON_NULL columns (the
+    analyzer's nullability lattice, plugin/plananalysis.py, decides
+    ``nonnull``): at a pipeline entry a non-null column's stored validity
+    is exactly the liveness mask — padding slots are always invalid, live
+    rows always valid — so the iota-derived ``live`` replaces it bit for
+    bit. The validity plane is then never read from HBM and every
+    downstream validity AND / null-park ``where`` folds against a
+    computed mask instead of a loaded one."""
+    if not nonnull or not any(nonnull):
+        return list(cols)
+    out: List[Val] = []
+    for c, nn in zip(cols, nonnull):
+        if not nn:
+            out.append(c)
+        elif isinstance(c, DictV):
+            out.append(DictV(c.codes, c.dictionary, live,
+                             c.mat_cap, c.max_len, c.unique))
+        elif isinstance(c, StrV):
+            out.append(StrV(c.offsets, c.chars, live))
+        else:
+            out.append(ColV(c.data, live))
+    out.extend(cols[len(out):])  # defensive: flags never exceed columns
+    return out
+
+
 def rows_of_positions(offsets: jax.Array, npos: int) -> jax.Array:
     """Row id per output position given row-boundary offsets (cap+1,).
 

@@ -709,10 +709,10 @@ def groupby_agg(
                 key_dtypes[i] = T.INT
                 code_keys[i] = c
             else:
-                from ..utils.bucketing import bucket_rows
+                from ..columnar.column import choose_capacity
 
                 key_cols[i] = materialize_dict(c)
-                eff_sml.append(max(4, bucket_rows(max(1, c.max_len), 4)))
+                eff_sml.append(max(4, choose_capacity(max(1, c.max_len), 4)))
         elif isinstance(c, StrV):
             eff_sml.append(str_max_lens[si] if si < len(str_max_lens) else 64)
             si += 1
@@ -720,14 +720,14 @@ def groupby_agg(
 
     def _rewrap(keys, aggs, n):
         if code_keys:
-            from ..utils.bucketing import bucket_rows
+            from ..columnar.column import choose_capacity
 
             keys = list(keys)
             for i, t in code_keys.items():
                 k = keys[i]
                 keys[i] = DictV(
                     k.data, t.dictionary, k.validity,
-                    bucket_rows(
+                    choose_capacity(
                         max(1, int(t.dictionary.chars.shape[0])), 128),
                     t.max_len, True)
         if recover:

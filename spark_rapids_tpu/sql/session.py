@@ -13,6 +13,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from ..conf import RapidsConf
 from ..cpu import plan as C
+from ..memory import catalog as _catalog  # noqa: F401 — registers the
+# memory.* conf entries (hbm.budgetBytes) BEFORE RapidsConf validates a
+# user's settings dict; the plan analyzer's OOM check reads them
 from ..exec.transitions import ColumnarToRowExec
 from ..expr import aggregates as A
 from ..expr import expressions as E
@@ -212,6 +215,7 @@ class TpuSession:
         self.overrides = TpuOverrides(self.conf)
         self.last_executed_plan = None
         self.last_cpu_plan = None
+        self.last_analysis = None
 
     @property
     def last_explain(self) -> str:
@@ -250,6 +254,14 @@ class TpuSession:
 
         cpu = _lower(node, self.conf)
         self.last_cpu_plan = cpu
+        from ..conf import ANALYSIS_CROSS_CHECK, SQL_ENABLED
+
+        if self.conf.get(SQL_ENABLED) and self.conf.get(ANALYSIS_CROSS_CHECK):
+            # the static analyzer runs BEFORE conversion/execution — it
+            # must never touch the device (plugin/plananalysis.py)
+            from ..plugin.plananalysis import analyze_plan
+
+            self.last_analysis = analyze_plan(cpu, self.conf)
         final, is_tpu = self.overrides.apply(cpu)
         if is_tpu:
             final = ColumnarToRowExec(self.conf, final)
@@ -505,9 +517,25 @@ class DataFrame:
         return {n: [r[i] for r in rows] for i, n in enumerate(names)}
 
     def explain(self) -> str:
-        cpu = _lower(self.node, self.session.conf)
+        """Tagging report (which operators run on TPU and why not) plus —
+        when sql.analysis.enabled — the static plan analysis: per-operator
+        batch layouts, nullability, the compile-signature forecast
+        (recompile-storm detection), and the predicted peak HBM footprint
+        checked against the memory budget. Nothing is lowered or executed
+        and no device allocation happens (see docs/tuning.md)."""
+        conf = self.session.conf
+        cpu = _lower(self.node, conf)
         from ..plugin.overrides import PlanMeta
 
-        meta = PlanMeta(cpu, self.session.conf)
+        meta = PlanMeta(cpu, conf)
         meta.tag_for_tpu()
-        return "\n".join(meta.explain_lines())
+        lines = meta.explain_lines()
+        from ..conf import ANALYSIS_ENABLED, SQL_ENABLED
+
+        if conf.get(SQL_ENABLED) and conf.get(ANALYSIS_ENABLED):
+            from ..plugin.plananalysis import analyze_plan
+
+            analysis = analyze_plan(cpu, conf, meta=meta)
+            self.session.last_analysis = analysis
+            lines.extend(analysis.render_lines())
+        return "\n".join(lines)

@@ -92,12 +92,18 @@ def assert_tpu_and_cpu_equal(
         # against the legacy lowering probe: a verdict disagreement on the
         # tested surface fails loudly below instead of drifting silently
         "spark.rapids.tpu.sql.matrix.probeCrossCheck.enabled": True,
+        # ... and the static plan analyzer (plugin/plananalysis.py): its
+        # compile-signature forecast and byte bounds are asserted against
+        # the measured run below
+        "spark.rapids.tpu.sql.analysis.crossCheck.enabled": True,
     }
     tpu_sess = TpuSession(tpu_conf)
+    from spark_rapids_tpu.exec.base import compile_snapshot
     from spark_rapids_tpu.plugin import typechecks as _TC
 
     before = len(_TC.cross_check_log())
     cpu_rows = build(cpu_sess).collect()
+    snap = compile_snapshot()
     tpu_rows = build(tpu_sess).collect()
     new = _TC.cross_check_log()[before:]
     assert not new, (
@@ -105,7 +111,78 @@ def assert_tpu_and_cpu_equal(
         + "\n".join(new)
     )
     compare_rows(cpu_rows, tpu_rows, ignore_order, approx_float)
+    _assert_analysis_cross_check(tpu_sess, snap, build, tpu_conf, tpu_rows)
     return cpu_rows
+
+
+def _assert_analysis_cross_check(tpu_sess, snap, build, tpu_conf, tpu_rows):
+    """The static-plan-analyzer cross-check (plugin/plananalysis.py):
+
+    1. for BOUNDED plans, the actual per-run compile cache-miss delta at
+       every pipeline site is covered by the forecast (warm caches may
+       miss less, never more — a miss above forecast means the analyzer
+       mispredicted the plan's shapes or its fusion decisions);
+    2. for BOUNDED plans, every operator's measured bytesTouched is
+       covered by the analyzer's static byte bound;
+    3. when the run elided validity planes, a rerun on the mask-carrying
+       path (nullElision disabled) produces identical results.
+    """
+    analysis = tpu_sess.last_analysis
+    if analysis is None:
+        return
+    from spark_rapids_tpu.exec.base import (
+        BYTES_TOUCHED,
+        COMPILE_COUNTER,
+        TpuExec,
+    )
+
+    if analysis.bounded:
+        base_total, base_sites = snap
+        deltas = {
+            k: v - base_sites.get(k, 0)
+            for k, v in COMPILE_COUNTER.by_site.items()
+            if v - base_sites.get(k, 0)
+        }
+        for site, actual in deltas.items():
+            forecast = analysis.site_forecast.get(site, 0)
+            assert actual <= forecast, (
+                f"compile-signature forecast disagreement at site {site}: "
+                f"actual misses {actual} > forecast {forecast} "
+                f"(full forecast: {analysis.site_forecast})\n"
+                + analysis.render()
+            )
+
+        plan = tpu_sess.last_executed_plan
+        node = getattr(plan, "tpu_child", plan)
+        if isinstance(node, TpuExec):
+            measured: Dict[str, int] = {}
+
+            def walk(n):
+                m = n.metrics.get(BYTES_TOUCHED)
+                if m is not None and m.value:
+                    measured[n.node_name] = (
+                        measured.get(n.node_name, 0) + m.value)
+                for c in n.children:
+                    walk(c)
+
+            walk(node)
+            for name, got in measured.items():
+                bound = analysis.bytes_by_op.get(name)
+                assert bound is not None and got <= bound, (
+                    f"footprint disagreement at {name}: measured "
+                    f"bytesTouched {got} > analyzer bound {bound} "
+                    f"(bounds: {analysis.bytes_by_op})\n" + analysis.render()
+                )
+
+    if analysis.elided_columns:
+        off_sess = TpuSession({
+            **tpu_conf,
+            "spark.rapids.tpu.sql.analysis.crossCheck.enabled": False,
+            "spark.rapids.tpu.sql.analysis.nullElision.enabled": False,
+        })
+        rows_off = build(off_sess).collect()
+        compare_rows(tpu_rows, rows_off, ignore_order=False,
+                     approx_float=False)
 
 
 def assert_fallback(

@@ -1,0 +1,385 @@
+"""Static plan analyzer (plugin/plananalysis.py) unit + behavior tests.
+
+The harness-wide cross-check (harness.assert_tpu_and_cpu_equal runs with
+sql.analysis.crossCheck.enabled for EVERY differential test) covers the
+three forecast-vs-reality invariants across the whole tier-1 suite; this
+file pins the analyzer's own semantics: the nullability lattice, the
+validity-elision differential, the OOM-warning path, recompile-storm
+detection, and the zero-column-batch capacity regression.
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from harness import assert_tpu_and_cpu_equal, compare_rows  # noqa: E402
+
+from spark_rapids_tpu import types as T  # noqa: E402
+from spark_rapids_tpu.columnar.batch import (  # noqa: E402
+    ColumnarBatch,
+    batch_from_rows,
+    schema_of,
+)
+from spark_rapids_tpu.expr import aggregates as A  # noqa: E402
+from spark_rapids_tpu.expr import expressions as E  # noqa: E402
+from spark_rapids_tpu.plugin import plananalysis as PA  # noqa: E402
+from spark_rapids_tpu.sql import TpuSession  # noqa: E402
+from spark_rapids_tpu.types import StructField, StructType  # noqa: E402
+
+
+def _analyze(df):
+    from spark_rapids_tpu.sql.session import _lower
+
+    return PA.analyze_plan(_lower(df.node, df.session.conf),
+                           df.session.conf)
+
+
+# ---------------------------------------------------------------------------
+# Nullability lattice units
+# ---------------------------------------------------------------------------
+class TestNullabilityLattice:
+    def _ref(self, i, dt=T.LONG, nullable=True):
+        return E.BoundReference(i, dt, nullable)
+
+    def test_literals(self):
+        assert PA.expr_nullability(E.lit(5), []) == PA.NON_NULL
+        assert PA.expr_nullability(
+            E.Literal(None, T.LONG), []) == PA.ALL_NULL
+
+    def test_bound_reference_reads_input_state(self):
+        r = self._ref(0)
+        assert PA.expr_nullability(r, [PA.NON_NULL]) == PA.NON_NULL
+        assert PA.expr_nullability(r, [PA.MAYBE_NULL]) == PA.MAYBE_NULL
+        assert PA.expr_nullability(r, [PA.ALL_NULL]) == PA.ALL_NULL
+
+    def test_isnull_isnotnull_always_non_null(self):
+        r = self._ref(0)
+        for cls in (E.IsNull, E.IsNotNull):
+            assert PA.expr_nullability(
+                cls(r), [PA.ALL_NULL]) == PA.NON_NULL
+
+    def test_coalesce_narrowing(self):
+        r = self._ref(0)
+        # a non-null fallback makes the whole coalesce NON_NULL
+        c = E.Coalesce((r, E.lit(0)))
+        assert PA.expr_nullability(c, [PA.MAYBE_NULL]) == PA.NON_NULL
+        # all-nullable branches stay maybe
+        c2 = E.Coalesce((r, self._ref(1)))
+        assert PA.expr_nullability(
+            c2, [PA.MAYBE_NULL, PA.MAYBE_NULL]) == PA.MAYBE_NULL
+        # every branch a null literal: provably ALL_NULL
+        c3 = E.Coalesce((E.Literal(None, T.LONG), E.Literal(None, T.LONG)))
+        assert PA.expr_nullability(c3, []) == PA.ALL_NULL
+
+    def test_arithmetic_meet(self):
+        a, b = self._ref(0), self._ref(1)
+        add = E.Add(a, b)
+        assert PA.expr_nullability(
+            add, [PA.NON_NULL, PA.NON_NULL]) == PA.NON_NULL
+        assert PA.expr_nullability(
+            add, [PA.NON_NULL, PA.MAYBE_NULL]) == PA.MAYBE_NULL
+        assert PA.expr_nullability(
+            add, [PA.ALL_NULL, PA.NON_NULL]) == PA.ALL_NULL
+
+    def test_divide_nulls_on_zero_divisor(self):
+        a, b = self._ref(0), self._ref(1)
+        assert PA.expr_nullability(
+            E.Divide(a, b), [PA.NON_NULL, PA.NON_NULL]) == PA.MAYBE_NULL
+        # literal non-zero divisor cannot introduce a null
+        assert PA.expr_nullability(
+            E.Divide(a, E.lit(2)), [PA.NON_NULL]) == PA.NON_NULL
+
+    def test_filter_isnull_narrowing(self):
+        cond = E.And(E.IsNotNull(self._ref(0)),
+                     E.GreaterThan(self._ref(1), E.lit(5)))
+        out = PA.narrow_by_predicate(
+            [PA.MAYBE_NULL, PA.MAYBE_NULL, PA.MAYBE_NULL], cond)
+        # IsNotNull narrows col 0; the comparison's 3VL NULL verdict (a
+        # filtered row) narrows col 1; col 2 untouched
+        assert out == [PA.NON_NULL, PA.NON_NULL, PA.MAYBE_NULL]
+
+    def test_outer_join_reintroduces_maybe_null(self):
+        sess = TpuSession({})
+        left = sess.create_dataframe(
+            {"k": [1, 2], "lv": [10, 20]}, schema_of(k=T.LONG, lv=T.LONG))
+        right = sess.create_dataframe(
+            {"k": [1, 3], "rv": [100, 300]}, schema_of(k=T.LONG, rv=T.LONG))
+        joined = left.join(right, "k", how="left")
+        analysis = _analyze(joined)
+
+        def find(rep, name):
+            if rep.name == name:
+                return rep
+            for c in rep.children:
+                r = find(c, name)
+                if r is not None:
+                    return r
+            return None
+
+        jr = find(analysis.root, "CpuJoinExec")
+        assert jr is not None
+        by_name = {c.name: c.null for c in jr.layout}
+        # right-side columns are MAYBE_NULL after a left join even though
+        # the inputs carry values everywhere
+        assert by_name["rv"] == PA.MAYBE_NULL
+
+    def test_aggregate_nullability(self):
+        cnt = A.Count()
+        assert PA.agg_nullability(cnt, PA.MAYBE_NULL, grouped=True) \
+            == PA.NON_NULL
+        s = A.Sum(E.col("x"))
+        assert PA.agg_nullability(s, PA.NON_NULL, grouped=True) \
+            == PA.NON_NULL
+        # a grand aggregate can see an empty input -> NULL sum
+        assert PA.agg_nullability(s, PA.NON_NULL, grouped=False) \
+            == PA.MAYBE_NULL
+        assert PA.agg_nullability(s, PA.MAYBE_NULL, grouped=True) \
+            == PA.MAYBE_NULL
+
+
+# ---------------------------------------------------------------------------
+# Analyzer end-to-end: bounded plans, forecasts, warnings
+# ---------------------------------------------------------------------------
+class TestAnalyzerReports:
+    def test_bounded_scan_filter_agg(self):
+        sess = TpuSession(
+            {"spark.rapids.tpu.sql.analysis.crossCheck.enabled": True})
+        df = sess.create_dataframe(
+            {"k": [1, 2, 1], "v": [10, 20, 30]}, schema_of(k=T.INT, v=T.LONG))
+        q = df.where(E.GreaterThan(E.col("v"), E.lit(5))) \
+            .group_by("k").agg(A.agg(A.Sum(E.col("v")), "s"))
+        q.collect()
+        an = sess.last_analysis
+        assert an is not None and an.bounded
+        assert sum(an.site_forecast.values()) >= 1
+        assert an.peak_hbm is not None and an.peak_hbm > 0
+        # the report names layouts and renders without error
+        text = an.render()
+        assert "TpuHashAggregateExec" in text
+        assert "InMemoryScanExec" in text
+
+    def test_explain_includes_analysis(self):
+        sess = TpuSession({})
+        df = sess.range(100)
+        out = df.select(E.Alias(E.Add(E.col("id"), E.lit(1)), "x")).explain()
+        assert "Static Plan Analysis" in out
+        assert "forecast compile signatures" in out
+        assert "NON_NULL" in out  # range ids are provably non-null
+
+    def test_oom_warning_fires_without_device_allocation(self):
+        """Acceptance: an over-budget plan warns at explain() time with
+        zero device allocations (the in-memory rows stay host-side)."""
+        sess = TpuSession(
+            {"spark.rapids.tpu.memory.hbm.budgetBytes": 1024})
+        n = 4096
+        df = sess.create_dataframe(
+            {"a": list(range(n)), "b": [float(i) for i in range(n)]},
+            schema_of(a=T.LONG, b=T.DOUBLE))
+        out = df.select("a", "b").explain()
+        assert "exceeds the device budget" in out
+        assert "spill/OOM at capacity 4096" in out
+
+    def test_recompile_storm_named_before_execution(self):
+        """Acceptance: a deliberately shape-polymorphic plan (a union of
+        many distinct capacity buckets under one projection) is flagged
+        with the site and the expected signature count at explain()."""
+        sess = TpuSession(
+            {"spark.rapids.tpu.sql.analysis.recompileStorm.threshold": 4})
+        schema = schema_of(x=T.LONG)
+        sizes = [100, 200, 400, 800, 1600]  # 5 distinct capacity buckets
+        dfs = [
+            sess.create_dataframe({"x": list(range(s))}, schema)
+            for s in sizes
+        ]
+        u = dfs[0]
+        for d in dfs[1:]:
+            u = u.union(d)
+        out = u.select(E.Alias(E.Add(E.col("x"), E.lit(1)), "y")).explain()
+        assert "recompile storm: site fused_chain expects 5" in out
+
+    def test_forecast_matches_actual_for_polymorphic_plan(self):
+        """The storm forecast is REAL: executing the polymorphic plan
+        compiles exactly as many fused_chain programs as forecast."""
+        from spark_rapids_tpu.exec.base import COMPILE_COUNTER
+
+        sess = TpuSession(
+            {"spark.rapids.tpu.sql.analysis.crossCheck.enabled": True})
+        schema = schema_of(x=T.LONG)
+        sizes = [129, 257, 513]
+        dfs = [
+            sess.create_dataframe({"x": list(range(s))}, schema)
+            for s in sizes
+        ]
+        u = dfs[0]
+        for d in dfs[1:]:
+            u = u.union(d)
+        q = u.select(E.Alias(E.Add(E.col("x"), E.lit(1)), "y"))
+        before = dict(COMPILE_COUNTER.by_site)
+        rows = q.collect()
+        assert len(rows) == sum(sizes)
+        an = sess.last_analysis
+        assert an.bounded
+        assert an.site_forecast.get("fused_chain") == 3
+        actual = (COMPILE_COUNTER.by_site.get("fused_chain", 0)
+                  - before.get("fused_chain", 0))
+        assert actual <= 3
+
+    def test_unbounded_plans_say_so(self):
+        sess = TpuSession({})
+        left = sess.create_dataframe(
+            {"k": [1, 2], "lv": [10, 20]}, schema_of(k=T.LONG, lv=T.LONG))
+        right = sess.create_dataframe(
+            {"k": [1, 2], "rv": [7, 8]}, schema_of(k=T.LONG, rv=T.LONG))
+        an = _analyze(left.join(right, "k"))
+        assert not an.bounded
+        assert "not statically bounded" in an.render()
+
+
+# ---------------------------------------------------------------------------
+# Nullability elision: differential identity + actual engagement
+# ---------------------------------------------------------------------------
+class TestNullElision:
+    def _run(self, elide: bool):
+        sess = TpuSession({
+            "spark.rapids.tpu.sql.analysis.nullElision.enabled": elide,
+        })
+        df = sess.range(0, 1000)
+        q = df.select(
+            E.Alias(E.Multiply(E.col("id"), E.lit(3)), "x"),
+            E.Alias(E.Cast(E.col("id"), T.DOUBLE), "f"),
+        ).where(E.GreaterThan(E.col("x"), E.lit(100))) \
+            .agg(A.agg(A.Sum(E.col("x")), "sx"),
+                 A.agg(A.Average(E.col("f")), "af"))
+        return q.collect()
+
+    def test_elided_identical_to_mask_carrying(self):
+        on = self._run(True)
+        off = self._run(False)
+        compare_rows(on, off, ignore_order=False)
+
+    def test_entry_flags_respect_conf_and_schema(self):
+        from spark_rapids_tpu.conf import RapidsConf
+
+        schema = StructType((
+            StructField("a", T.LONG, False),
+            StructField("b", T.LONG, True),
+        ))
+        on = PA.entry_nonnull_flags(schema, RapidsConf({}))
+        assert on == (True, False)
+        off = PA.entry_nonnull_flags(schema, RapidsConf({
+            "spark.rapids.tpu.sql.analysis.nullElision.enabled": False}))
+        assert off == ()
+        all_nullable = StructType((StructField("b", T.LONG, True),))
+        assert PA.entry_nonnull_flags(all_nullable, RapidsConf({})) == ()
+
+    def test_evaluate_projection_elided_path(self):
+        """expr/eval.py's consumption of the lattice: the elided compiled
+        path returns exactly what the mask-carrying path returns."""
+        from spark_rapids_tpu.expr.eval import evaluate_projection
+
+        schema = StructType((
+            StructField("a", T.LONG, False),
+            StructField("b", T.DOUBLE, True),
+        ))
+        batch = ColumnarBatch.from_pydict(
+            {"a": [1, 2, 3], "b": [1.5, None, 2.5]}, schema)
+        bound = [
+            E.bind_references(E.Add(E.col("a"), E.lit(1)), schema),
+            E.bind_references(E.Multiply(E.col("b"), E.col("a")), schema),
+        ]
+        from spark_rapids_tpu.conf import RapidsConf
+
+        # no flags/conf -> mask-carrying path; a conf derives the flags
+        # through entry_nonnull_flags and takes the elided path — and
+        # disabling the conf forces the mask-carrying path back on
+        plain = [c.to_pylist()
+                 for c in evaluate_projection(bound, batch)]
+        elided = [c.to_pylist()
+                  for c in evaluate_projection(bound, batch,
+                                               conf=RapidsConf({}))]
+        off = [c.to_pylist()
+               for c in evaluate_projection(bound, batch, conf=RapidsConf({
+                   "spark.rapids.tpu.sql.analysis.nullElision.enabled":
+                       False}))]
+        explicit = [c.to_pylist()
+                    for c in evaluate_projection(bound, batch,
+                                                 nonnull=(True, False))]
+        assert plain == elided == off == explicit \
+            == [[2, 3, 4], [1.5, None, 7.5]]
+
+    def test_harness_cross_check_runs_differential(self):
+        """End-to-end through the harness: a range-sourced plan elides
+        (range ids are declared non-null) and stays oracle-identical."""
+        assert_tpu_and_cpu_equal(
+            lambda s: s.range(0, 500).select(
+                E.Alias(E.Add(E.col("id"), E.lit(7)), "y"))
+            .where(E.LessThan(E.col("y"), E.lit(100))))
+
+
+# ---------------------------------------------------------------------------
+# Satellite: zero-column batch capacity regression (count(*) over a
+# fully-pruned scan)
+# ---------------------------------------------------------------------------
+class TestZeroColumnCapacity:
+    def test_batch_carries_capacity_without_columns(self):
+        schema = StructType(())
+        b = ColumnarBatch([], schema, 200)
+        assert b.num_rows == 200
+        assert b.capacity >= 200  # was 0 before the fix
+
+    def test_batch_from_rows_keeps_rows_for_empty_schema(self):
+        schema = StructType(())
+        b = batch_from_rows([() for _ in range(200)], schema)
+        assert b.num_rows == 200
+        assert b.capacity >= 200
+
+    def test_count_star_over_pruned_scan(self):
+        n = 300  # > the 128 minimum bucket: a lost capacity truncates
+        sess = TpuSession({"spark.rapids.tpu.sql.test.enabled": True})
+        df = sess.from_rows([() for _ in range(n)], StructType(()))
+        assert df.count() == n
+        out = df.agg(A.agg(A.Count(), "c")).collect()
+        assert out == [(n,)]
+
+    def test_context_project_over_pruned_source(self):
+        """A context-expression projection (monotonically_increasing_id)
+        over a zero-column source must run at the source's REAL capacity,
+        not the 128 fallback — 300 rows would otherwise alias."""
+        n = 300
+        sess = TpuSession({"spark.rapids.tpu.sql.test.enabled": True})
+        df = sess.from_rows([() for _ in range(n)], StructType(()))
+        rows = df.select(
+            E.Alias(E.MonotonicallyIncreasingID(), "id")).collect()
+        ids = [r[0] for r in rows]
+        assert len(ids) == n and len(set(ids)) == n
+
+    def test_count_star_after_column_pruning_projection(self):
+        assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(
+                {"a": list(range(300)), "b": list(range(300))},
+                schema_of(a=T.LONG, b=T.LONG),
+            ).select().agg(A.agg(A.Count(), "c")))
+
+
+# ---------------------------------------------------------------------------
+# Satellite: from_host error context + choose_capacity routing
+# ---------------------------------------------------------------------------
+class TestChooseCapacity:
+    def test_from_host_error_names_the_column(self):
+        from spark_rapids_tpu.columnar.column import HostColumn
+
+        h = HostColumn.from_pylist([1, 2, 3, 4, 5], T.LONG)
+        with pytest.raises(ValueError, match=r"column 'payload'.*capacity 2"):
+            h.to_device(capacity=2, name="payload")
+        with pytest.raises(ValueError, match="choose_capacity"):
+            h.to_device(capacity=2)
+
+    def test_choose_capacity_matches_bucket_rules(self):
+        from spark_rapids_tpu.columnar.column import choose_capacity
+        from spark_rapids_tpu.utils.bucketing import bucket_rows
+
+        for n in (0, 1, 127, 128, 129, 1000, 4096):
+            assert choose_capacity(n) == bucket_rows(n)
+        assert choose_capacity(3, 4) == bucket_rows(3, 4)

@@ -27,6 +27,16 @@ TPU003  traced-value hazard inside a jit region: within a function
         parameter, ``.item()``, or an ``if``/``while`` whose test reads
         a traced parameter (python control flow cannot branch on traced
         values).
+TPU004  capacity decision outside the sanctioned layer: a direct
+        ``bucket_rows``/``round_up_pow2`` call, or hand-rolled
+        power-of-two arithmetic (``1 << (...).bit_length()``), anywhere
+        in ``spark_rapids_tpu/`` outside ``columnar/``,
+        ``utils/bucketing.py``, and the static plan analyzer
+        (``plugin/plananalysis.py``). Batch/byte-pool capacities must
+        route through ``columnar.column.choose_capacity`` so the
+        analyzer can reproduce the exact buckets the runtime will
+        allocate — a hand-rolled bucket is invisible to the plan-time
+        layout/footprint/signature forecast.
 
 Allowlist
 ---------
@@ -54,6 +64,16 @@ SANCTIONED_FILES = (os.path.join("exec", "base.py"),)
 
 JAX_MODULE_ALIASES = {"jax", "_jax", "_jx"}
 NUMPY_ALIASES = {"np", "numpy"}
+
+#: dirs/files where raw bucket arithmetic is the implementation itself
+#: (TPU004 exempt): the columnar layer OWNS choose_capacity, bucketing.py
+#: defines the primitive, and the plan analyzer mirrors the rules
+CAPACITY_SANCTIONED = (
+    os.path.join("spark_rapids_tpu", "columnar") + os.sep,
+    os.path.join("spark_rapids_tpu", "utils", "bucketing.py"),
+    os.path.join("spark_rapids_tpu", "utils", "__init__.py"),
+    os.path.join("spark_rapids_tpu", "plugin", "plananalysis.py"),
+)
 
 
 def _default_allowlist_path() -> str:
@@ -282,12 +302,27 @@ def lint_file(path: str, relpath: str) -> List[Finding]:
             for d in SYNC_STRICT_DIRS)
         and not any(relpath.endswith(s) for s in SANCTIONED_FILES)
     )
+    capacity_strict = (
+        f"spark_rapids_tpu{os.sep}" in relpath
+        and not any(s in relpath for s in CAPACITY_SANCTIONED)
+    )
 
     in_any_region = set()
     for s in region_node_sets.values():
         in_any_region |= s
 
     for node in ast.walk(tree):
+        if (capacity_strict and isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.LShift)):
+            # hand-rolled power-of-two bucket: 1 << (...).bit_length()
+            if any(isinstance(n, ast.Call)
+                   and isinstance(n.func, ast.Attribute)
+                   and n.func.attr == "bit_length"
+                   for n in ast.walk(node)):
+                findings.append(Finding(
+                    relpath, node.lineno, "TPU004", qual_of(node),
+                    "hand-rolled power-of-two capacity arithmetic — use "
+                    "columnar.column.choose_capacity"))
         if not isinstance(node, ast.Call):
             continue
         chain = _attr_chain(node.func)
@@ -322,6 +357,17 @@ def lint_file(path: str, relpath: str) -> List[Finding]:
                     "jax.jit(...) inside a function without a cache "
                     "store — every call retraces; keep compiled fns in "
                     "a keyed cache or an lru_cache'd builder"))
+        # --- TPU004: capacity decisions outside the sanctioned layer -----
+        if capacity_strict:
+            callee = (node.func.id if isinstance(node.func, ast.Name)
+                      else (chain.rsplit(".", 1)[-1] if chain else None))
+            if callee in ("bucket_rows", "round_up_pow2"):
+                findings.append(Finding(
+                    relpath, node.lineno, "TPU004", qual_of(node),
+                    f"direct {callee}() — capacity decisions must go "
+                    "through columnar.column.choose_capacity so the plan "
+                    "analyzer can reproduce the bucket"))
+
         if (isinstance(node.func, ast.Name) and node.func.id == "id"
                 and node.args):
             parent = parents.get(node)
