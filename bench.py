@@ -452,6 +452,12 @@ def main() -> None:
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--shapes", type=str, default=",".join(SHAPES))
+    ap.add_argument(
+        "--event-log", type=str, default="",
+        help="directory for a structured JSONL event log of the bench run "
+             "(spark.rapids.tpu.eventLog.dir); inspect it offline with "
+             "tools/tpu_profile.py, or --diff the emitted BENCH json "
+             "against a previous round's")
     args = ap.parse_args()
 
     from spark_rapids_tpu import types as T
@@ -476,6 +482,13 @@ def main() -> None:
     # order-insensitive float aggregation, as the reference's own benchmark
     # runs enable (spark.rapids.sql.variableFloatAgg.enabled)
     conf_dict = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True}
+    if args.event_log:
+        # event-log the whole bench: the session-path shapes pick the dir
+        # up from conf, the exec-direct shapes from the installed logger
+        from spark_rapids_tpu import events as EV
+
+        conf_dict["spark.rapids.tpu.eventLog.dir"] = args.event_log
+        EV.install(EV.EventLogger(RapidsConf(conf_dict)))
     conf = RapidsConf(conf_dict)
 
     results = {}

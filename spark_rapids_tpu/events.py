@@ -1,0 +1,278 @@
+"""Structured query event log: durable, visualizable, diffable telemetry.
+
+Reference analog: the Spark event log consumed by the rapids-4-spark
+profiling/qualification tool — the OFFLINE half of the observability story
+(the online half is ``TpuSession.explain_metrics()``). Every interesting
+moment of a query's life — plan tagging, static analysis forecasts, per-op
+per-batch spans, compile-cache misses, host-link transfers, spills, shuffle
+traffic, scan-cache activity — is emitted as ONE typed JSON object with a
+monotonic timestamp, so a session's history survives the process and
+``tools/tpu_profile.py`` can answer "where did the time and memory actually
+go, and did it regress since last run?".
+
+Sinks: when ``spark.rapids.tpu.eventLog.dir`` is set, events append to a
+JSONL file (one file per logger, line-buffered, thread-safe); a bounded
+in-memory ring buffer ALWAYS backs ``TpuSession.export_trace()`` (Chrome /
+Perfetto trace-event JSON) even with no directory configured.
+
+Zero-overhead contract: with event logging off (the default), the module
+global ``_ENABLED`` stays False and every hot-path call site guards on
+``enabled()`` — no dict is built, no lock taken, no sink written, and
+``TpuExec.op_timed`` keeps its fast path (tests/test_events.py pins this).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .conf import RapidsConf, conf
+
+EVENT_LOG_ENABLED = conf(
+    "spark.rapids.tpu.eventLog.enabled", False,
+    "Enable the structured query event log (typed JSONL events covering "
+    "the full query lifecycle: plan tagging, analysis forecasts, per-op "
+    "spans, compile misses, transfers, spills, shuffle, scan cache). With "
+    "no eventLog.dir the events land only in the in-memory ring buffer "
+    "backing TpuSession.export_trace(); setting eventLog.dir implies this "
+    "key. Off by default — the emit fast path is a single boolean check.")
+EVENT_LOG_DIR = conf(
+    "spark.rapids.tpu.eventLog.dir", "",
+    "Directory for JSONL event-log files (one tpu-events-<pid>-<n>.jsonl "
+    "per session, append-only, thread-safe). Setting a directory turns "
+    "event logging on. Consume the files offline with "
+    "tools/tpu_profile.py, or open TpuSession.export_trace() output in "
+    "Perfetto (see docs/tuning.md).")
+EVENT_LOG_RING_SIZE = conf(
+    "spark.rapids.tpu.eventLog.ringBuffer.size", 65536,
+    "Events retained in the in-memory ring buffer that backs "
+    "TpuSession.export_trace() (oldest dropped first). The JSONL sink is "
+    "unbounded; the ring only bounds in-process memory.")
+
+
+# ---------------------------------------------------------------------------
+# Event schema: every event carries ``ts`` (perf_counter_ns — the same
+# monotonic clock op_timed stamps spans with) and ``event``; the registry
+# below names the REQUIRED typed fields per event so the emitters, the
+# profiler tool, and the schema round-trip test can never drift apart.
+# ---------------------------------------------------------------------------
+EVENT_TYPES: Dict[str, tuple] = {
+    # query lifecycle (sql/session.py)
+    "query_start": ("query_id", "plan_digest", "sql_hash"),
+    "query_end": ("query_id", "dur", "rows"),
+    # plan tagging: one record per query with every fallback reason the
+    # type matrix produced (plugin/overrides.py + typechecks.py)
+    "plan_tagged": ("query_id", "on_tpu", "fallbacks"),
+    # static plan analyzer forecasts (plugin/plananalysis.py)
+    "plan_analysis": ("query_id", "bounded", "site_forecast", "bytes_by_op",
+                      "peak_hbm", "budget", "warnings"),
+    # per-op per-batch spans: ``lane`` separates host wall-clock
+    # (op_timed) from the device-sync wait (record_batch's fence)
+    "op_span": ("op", "section", "start", "dur", "lane"),
+    # per-op batch accounting (rows may be null while still a device
+    # scalar — no sync just for logging)
+    "op_batch": ("op", "rows", "bytes"),
+    # pipeline-cache compile miss, naming the site (exec/base.py)
+    "compile_miss": ("site", "total"),
+    # host-link transfers: packed uploads (h2d), sanctioned host_pull
+    # reads (d2h), host_fence sync points (direction "fence", 0 bytes)
+    "transfer": ("direction", "bytes", "site"),
+    # spill lifecycle with the catalog's LIVE device-byte watermark
+    "spill": ("kind", "bytes", "device_bytes"),
+    # shuffle pieces through the transport SPI (shuffle/transport.py)
+    "shuffle_write": ("shuffle_id", "map_id", "reduce_id", "rows", "bytes",
+                      "codec"),
+    "shuffle_fetch": ("shuffle_id", "reduce_id", "pieces", "rows", "bytes",
+                      "codec"),
+    # device scan-cache activity (io/scan_cache.py)
+    "scan_cache": ("op", "bytes"),
+}
+
+
+class EventLogger:
+    """Thread-safe typed event sink: ring buffer + optional JSONL file."""
+
+    def __init__(self, conf_: Optional[RapidsConf] = None,
+                 path: Optional[str] = None,
+                 ring_size: Optional[int] = None):
+        conf_ = conf_ or RapidsConf({})
+        log_dir = conf_.get(EVENT_LOG_DIR)
+        self.enabled = bool(conf_.get(EVENT_LOG_ENABLED) or log_dir or path)
+        self._lock = threading.Lock()
+        size = ring_size or conf_.get(EVENT_LOG_RING_SIZE)
+        self._ring: collections.deque = collections.deque(maxlen=size)
+        self.path: Optional[str] = None
+        self._fh = None
+        if self.enabled and (path or log_dir):
+            if path is None:
+                os.makedirs(log_dir, exist_ok=True)
+                path = os.path.join(
+                    log_dir,
+                    f"tpu-events-{os.getpid()}-{_next_file_seq()}.jsonl")
+            self.path = path
+            # line-buffered so an offline reader sees every completed
+            # event even if the process never calls close()
+            self._fh = open(path, "a", buffering=1)
+
+    def emit(self, etype: str, **fields: Any) -> None:
+        if not self.enabled:
+            return
+        rec = {"ts": time.perf_counter_ns(), "event": etype}
+        rec.update(fields)
+        with self._lock:
+            self._ring.append(rec)
+            if self._fh is not None:
+                self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+
+    def records(self) -> List[dict]:
+        """Snapshot of the ring buffer (oldest first)."""
+        with self._lock:
+            return list(self._ring)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+_FILE_SEQ = [0]
+_FILE_SEQ_LOCK = threading.Lock()
+
+
+def _next_file_seq() -> int:
+    with _FILE_SEQ_LOCK:
+        _FILE_SEQ[0] += 1
+        return _FILE_SEQ[0]
+
+
+# ---------------------------------------------------------------------------
+# Process-global active logger. Emit sites live deep in the engine (the
+# buffer catalog, the scan cache, the shuffle transports) where no session
+# handle exists, so the session INSTALLS its logger at execute time; with
+# nothing installed the fast path is one module-global boolean read.
+# ---------------------------------------------------------------------------
+_ENABLED = False
+_ACTIVE: Optional[EventLogger] = None
+
+
+def enabled() -> bool:
+    """The hot-path guard: True only while an enabled logger is installed.
+    Call sites that would build an event dict per batch check this FIRST."""
+    return _ENABLED
+
+
+def install(logger: EventLogger) -> None:
+    global _ENABLED, _ACTIVE
+    if logger.enabled:
+        _ACTIVE = logger
+        _ENABLED = True
+
+
+def uninstall() -> None:
+    global _ENABLED, _ACTIVE
+    _ACTIVE = None
+    _ENABLED = False
+
+
+def emit(etype: str, **fields: Any) -> None:
+    """Emit through the active logger; a no-op when logging is off."""
+    if not _ENABLED:
+        return
+    logger = _ACTIVE
+    if logger is not None:
+        logger.emit(etype, **fields)
+
+
+# ---------------------------------------------------------------------------
+# Chrome / Perfetto trace-event export: the in-memory event stream becomes
+# a trace-event JSON object that opens directly in ui.perfetto.dev (or
+# chrome://tracing). One track (tid) per operator — host spans on the op's
+# own track, device-sync waits on "<op> [device]" — plus counter tracks for
+# the HBM device-byte watermark and cumulative compile misses, and instant
+# markers for transfers/shuffle/scan-cache activity.
+# ---------------------------------------------------------------------------
+_PID = 1
+
+
+def chrome_trace(records: List[dict]) -> dict:
+    if not records:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    base = min(
+        min(r["ts"] for r in records),
+        min((r["start"] for r in records if r.get("event") == "op_span"),
+            default=records[0]["ts"]),
+    )
+
+    tids: Dict[str, int] = {}
+    meta: List[dict] = []
+
+    def tid_of(track: str) -> int:
+        t = tids.get(track)
+        if t is None:
+            t = tids[track] = len(tids) + 1
+            meta.append({"ph": "M", "pid": _PID, "tid": t,
+                         "name": "thread_name", "args": {"name": track}})
+        return t
+
+    def us(ns: int) -> float:
+        return (ns - base) / 1e3
+
+    out: List[dict] = []
+    open_queries: Dict[Any, dict] = {}
+    for r in records:
+        ev = r.get("event")
+        ts = r["ts"]
+        if ev == "op_span":
+            track = r["op"] + (" [device]" if r.get("lane") == "device"
+                               else "")
+            name = r["op"] + (("." + r["section"]) if r.get("section")
+                              else "")
+            out.append({"ph": "X", "pid": _PID, "tid": tid_of(track),
+                        "name": name, "ts": us(r["start"]),
+                        "dur": r["dur"] / 1e3, "args": {"lane": r["lane"]}})
+        elif ev == "query_start":
+            open_queries[r.get("query_id")] = r
+        elif ev == "query_end":
+            qs = open_queries.pop(r.get("query_id"), None)
+            start = qs["ts"] if qs is not None else ts - r["dur"]
+            out.append({"ph": "X", "pid": _PID, "tid": tid_of("query"),
+                        "name": f"query {r.get('query_id')}",
+                        "ts": us(start), "dur": r["dur"] / 1e3,
+                        "args": {"rows": r.get("rows")}})
+        elif ev == "compile_miss":
+            out.append({"ph": "C", "pid": _PID, "name": "compile_misses",
+                        "ts": us(ts), "args": {"misses": r["total"]}})
+            out.append({"ph": "i", "pid": _PID, "tid": tid_of("compile"),
+                        "name": f"miss:{r['site']}", "ts": us(ts), "s": "t"})
+        elif ev == "spill":
+            out.append({"ph": "C", "pid": _PID, "name": "hbm_device_bytes",
+                        "ts": us(ts), "args": {"bytes": r["device_bytes"]}})
+            out.append({"ph": "i", "pid": _PID, "tid": tid_of("memory"),
+                        "name": f"{r['kind']} {r['bytes']}B", "ts": us(ts),
+                        "s": "t"})
+        elif ev == "transfer":
+            out.append({"ph": "i", "pid": _PID, "tid": tid_of("transfers"),
+                        "name": f"{r['direction']} {r['bytes']}B "
+                                f"({r['site']})",
+                        "ts": us(ts), "s": "t"})
+        elif ev in ("shuffle_write", "shuffle_fetch"):
+            out.append({"ph": "i", "pid": _PID, "tid": tid_of("shuffle"),
+                        "name": f"{ev} {r['bytes']}B", "ts": us(ts),
+                        "s": "t"})
+        elif ev == "scan_cache":
+            out.append({"ph": "i", "pid": _PID, "tid": tid_of("scan_cache"),
+                        "name": f"{r['op']}", "ts": us(ts), "s": "t"})
+        # plan_tagged / plan_analysis / op_batch carry no timeline shape;
+        # the offline profiler reads them from the JSONL log instead
+    out.sort(key=lambda e: e["ts"])
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(records: List[dict], path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(records), f)
+    return path

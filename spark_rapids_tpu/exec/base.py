@@ -15,6 +15,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
 import jax
 
+from .. import events as _events
 from ..columnar import ColumnarBatch, DeviceColumn
 from ..conf import RapidsConf
 from ..expr.eval import ColV, DictV, StrV, Val
@@ -58,6 +59,9 @@ COMPILE_COUNTER = CompileCounter()
 
 def note_compile_miss(site: str) -> None:
     COMPILE_COUNTER.note(site)
+    # misses are rare (that's the point); the event names the site so the
+    # offline profiler can attribute recompile storms without a rerun
+    _events.emit("compile_miss", site=site, total=COMPILE_COUNTER.total)
 
 
 def compile_miss_count() -> int:
@@ -79,14 +83,24 @@ def host_pull(tree):
     each separate pull pays a tunnel round trip. This is the only
     sanctioned way to read device values on the host outside this
     module; tools/tpu_lint.py flags raw jax.device_get/.item() sites."""
-    return jax.device_get(tree)
+    out = jax.device_get(tree)
+    if _events.enabled():
+        nb = sum(int(getattr(a, "nbytes", 0))
+                 for a in jax.tree_util.tree_leaves(out))
+        _events.emit("transfer", direction="d2h", bytes=nb,
+                     site="host_pull")
+    return out
 
 
 def host_fence(arrays):
     """Block until the given device buffers are computed (the profiling /
     ordering fence; the device-sync metric path uses it). Returns the
     arrays so call sites can chain."""
-    return jax.block_until_ready(arrays)
+    out = jax.block_until_ready(arrays)
+    if _events.enabled():
+        _events.emit("transfer", direction="fence", bytes=0,
+                     site="host_fence")
+    return out
 
 
 _PLANNING = threading.local()
@@ -147,9 +161,13 @@ class Metric:
 
 
 @contextlib.contextmanager
-def timed(metric: Optional[Metric], trace_name: str = "", trace: bool = False):
+def timed(metric: Optional[Metric], trace_name: str = "", trace: bool = False,
+          event_op: Optional[str] = None, event_section: str = ""):
     """Time a hot section into a metric; optionally emit a profiler range
-    (reference: NvtxWithMetrics.scala -> jax.profiler.TraceAnnotation)."""
+    (reference: NvtxWithMetrics.scala -> jax.profiler.TraceAnnotation).
+    ``event_op`` (set only while event logging is on) additionally emits a
+    host-lane ``op_span`` event, so the offline timeline shares the same
+    start/dur the metric accumulated."""
     ctx = (
         jax.profiler.TraceAnnotation(trace_name or (metric.name if metric else "op"))
         if trace
@@ -158,8 +176,12 @@ def timed(metric: Optional[Metric], trace_name: str = "", trace: bool = False):
     start = time.perf_counter_ns()
     with ctx:
         yield
+    dur = time.perf_counter_ns() - start
     if metric is not None:
-        metric.add(time.perf_counter_ns() - start)
+        metric.add(dur)
+    if event_op is not None:
+        _events.emit("op_span", op=event_op, section=event_section,
+                     start=start, dur=dur, lane="host")
 
 
 class TpuExec:
@@ -285,7 +307,11 @@ class TpuExec:
         work in this (reference: NvtxWithMetrics.scala pairing each hot
         section with a GpuMetric + NVTX range)."""
         name = self.node_name + ("." + section if section else "")
-        return timed(self.metric(metric_name), name, self._trace)
+        # event args attach only while logging is on, so the disabled fast
+        # path is byte-for-byte the pre-event-log behavior
+        return timed(self.metric(metric_name), name, self._trace,
+                     event_op=self.node_name if _events.enabled() else None,
+                     event_section=section)
 
     def record_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
         nr = batch.num_rows_lazy
@@ -296,15 +322,25 @@ class TpuExec:
             # (+ one dispatch) — the CUDA-event-timing analog.
             t0 = time.perf_counter_ns()
             jax.block_until_ready(batch_arrays(batch))
-            self.metric(OP_TIME_DEVICE, "ns").add(
-                time.perf_counter_ns() - t0)
+            dt = time.perf_counter_ns() - t0
+            self.metric(OP_TIME_DEVICE, "ns").add(dt)
+            if _events.enabled():
+                # the device lane: THIS op's isolated device wait (inputs
+                # were fenced by the child's record_batch under the
+                # plan-wide conf — see the deviceSync doc)
+                _events.emit("op_span", op=self.node_name,
+                             section="device_wait", start=t0, dur=dt,
+                             lane="device")
             if not isinstance(nr, int):
                 nr = int(jax.device_get(nr))  # free: buffers are ready
         if isinstance(nr, int):
             self.metrics[NUM_OUTPUT_ROWS].add(nr)
         self.metrics[NUM_OUTPUT_BATCHES].add(1)
-        self.metric(BYTES_TOUCHED, "bytes").add(
-            batch_bytes(batch, nr if isinstance(nr, int) else None))
+        by = batch_bytes(batch, nr if isinstance(nr, int) else None)
+        self.metric(BYTES_TOUCHED, "bytes").add(by)
+        if _events.enabled():
+            _events.emit("op_batch", op=self.node_name,
+                         rows=nr if isinstance(nr, int) else None, bytes=by)
         return batch
 
     def collect(self) -> List[tuple]:
@@ -427,7 +463,29 @@ def format_metrics(plan: TpuExec, since: Optional[tuple] = None) -> str:
     sites = ", ".join(f"{k}={v}" for k, v in sorted(deltas.items()))
     lines.append(f"compile cache misses: {total}"
                  + (f" ({sites})" if sites else ""))
+    lines.append(memory_footer())
     return "\n".join(lines)
+
+
+def memory_footer() -> str:
+    """The explain_metrics memory line: the buffer catalog's live device
+    bytes, the peak watermark, and the spill/unspill story (process-wide
+    counters — the catalog is a process singleton, like the reference's
+    RapidsBufferCatalog). ``spilled_bytes`` was tracked since the catalog
+    landed but never reported anywhere; this is its user-facing surface."""
+    from ..memory.catalog import BufferCatalog
+
+    cat = BufferCatalog.get()
+    m = cat.metrics
+
+    def mb(v: int) -> str:
+        return f"{v / 1e6:.1f}MB"
+
+    return (f"memory: device {mb(cat.device_bytes)} "
+            f"(peak {mb(m.peak_device_bytes)}), "
+            f"spilled {mb(m.spilled_bytes)} in {m.device_to_host} "
+            f"spill(s) ({m.host_to_disk} to disk), "
+            f"{m.unspills} unspill(s)")
 
 
 # ---------------------------------------------------------------------------

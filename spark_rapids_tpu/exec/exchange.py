@@ -50,6 +50,14 @@ from .base import (
 
 PARTITION_SIZE = "partitionSize"  # reference metric (GpuExec.scala:27-60)
 DATA_SIZE = "dataSize"
+# per-shuffle transport metrics (the layer the per-op profiler skipped):
+# wire bytes each way plus codec encode/decode time, pulled from the
+# transport's cumulative stats() after map/fetch (reference analog: the
+# RapidsShuffle* writeTime/fetchWaitTime/compression metrics)
+SHUFFLE_BYTES_WRITTEN = "shuffleBytesWritten"
+SHUFFLE_BYTES_FETCHED = "shuffleBytesFetched"
+CODEC_ENCODE_TIME = "codecEncodeTime"
+CODEC_DECODE_TIME = "codecDecodeTime"
 
 
 def make_transport(conf: RapidsConf) -> ShuffleTransport:
@@ -376,12 +384,26 @@ class TpuShuffleExchangeExec(TpuExec):
                         # feeding ShuffledBatchRDD's partition specs)
                         self.partition_rows[j] += b - a
             self.metrics[DATA_SIZE].set(self.transport.bytes_written())
+            self._note_transport_stats()
             self._map_done = True
+
+    def _note_transport_stats(self) -> None:
+        """Refresh the per-shuffle transport metrics from the transport's
+        cumulative counters (set, not add: stats() is already a running
+        total, and AQE readers share this exchange's transport)."""
+        st = self.transport.stats()
+        self.metric(SHUFFLE_BYTES_WRITTEN, "bytes").set(st["bytes_written"])
+        self.metric(SHUFFLE_BYTES_FETCHED, "bytes").set(st["bytes_fetched"])
+        if st["encode_ns"]:
+            self.metric(CODEC_ENCODE_TIME, "ns").set(st["encode_ns"])
+        if st["decode_ns"]:
+            self.metric(CODEC_DECODE_TIME, "ns").set(st["decode_ns"])
 
     # -- reduce side -------------------------------------------------------
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
         self._run_map_side()
         pieces = self.transport.fetch(self.shuffle_id, index)
+        self._note_transport_stats()
         self._consumed.add(index)
         if len(self._consumed) >= self.num_partitions:
             # every reduce partition fetched once: drop the cached pieces
@@ -445,6 +467,7 @@ class TpuAQEShuffleReadExec(TpuExec):
             _, rid, j, k = spec
             allp = ex.transport.fetch(ex.shuffle_id, rid)
             pieces = _slice_pieces_by_rows(allp, j, k)
+        ex._note_transport_stats()
         self._consumed.add(index)
         if len(self._consumed) >= len(self.specs):
             ex.transport.release(ex.shuffle_id)

@@ -141,6 +141,11 @@ def packed_upload(host_arrays: List[np.ndarray]):
     for a, (off, ln, _) in zip(host_arrays, layout):
         buf[off: off + a.nbytes] = a.view(np.uint8).reshape(-1)
     dev = jnp.asarray(buf)
+    from .. import events as _events
+
+    if _events.enabled():
+        _events.emit("transfer", direction="h2d", bytes=int(pos),
+                     site="packed_upload")
 
     key = tuple(layout)
     fn = _UNPACK_CACHE.get(key)

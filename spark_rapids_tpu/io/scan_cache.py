@@ -18,7 +18,9 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
+
+from .. import events as _events
 
 
 class DeviceScanCache:
@@ -32,6 +34,7 @@ class DeviceScanCache:
         self._bytes = 0
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
 
     @classmethod
     def get_instance(cls, conf) -> Optional["DeviceScanCache"]:
@@ -56,6 +59,20 @@ class DeviceScanCache:
             while self._bytes > self.max_bytes and self._entries:
                 _, (_, sz) = self._entries.popitem(last=False)
                 self._bytes -= sz
+                self.evictions += 1
+                if _events.enabled():
+                    _events.emit("scan_cache", op="evict", bytes=sz)
+
+    def stats(self) -> Dict[str, int]:
+        """Cache-effectiveness counters (previously unobservable): a hot
+        workload should show hits dominating misses and zero evictions; a
+        nonzero eviction rate means the working set exceeds
+        scan.deviceCache.maxBytes and uploads are being re-paid."""
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
+                    "entries": len(self._entries), "bytes": self._bytes,
+                    "max_bytes": self.max_bytes}
 
     @classmethod
     def reset(cls) -> None:
@@ -67,9 +84,13 @@ class DeviceScanCache:
             hit = self._entries.get(key)
             if hit is None:
                 self.misses += 1
+                if _events.enabled():
+                    _events.emit("scan_cache", op="miss", bytes=0)
                 return None
             self._entries.move_to_end(key)
             self.hits += 1
+            if _events.enabled():
+                _events.emit("scan_cache", op="hit", bytes=hit[1])
             return hit[0]
 
     def put(self, key: tuple, value: Any, nbytes: int) -> None:
@@ -82,9 +103,14 @@ class DeviceScanCache:
                 return
             self._entries[key] = (value, nbytes)
             self._bytes += nbytes
+            if _events.enabled():
+                _events.emit("scan_cache", op="put", bytes=nbytes)
             while self._bytes > self.max_bytes and self._entries:
                 _, (_, sz) = self._entries.popitem(last=False)
                 self._bytes -= sz
+                self.evictions += 1
+                if _events.enabled():
+                    _events.emit("scan_cache", op="evict", bytes=sz)
 
     def invalidate_path(self, path: str) -> None:
         """Drop every entry of one file (the writers' commit protocol

@@ -20,6 +20,7 @@ import tempfile
 import threading
 from typing import Dict, List, Optional
 
+from .. import events as _events
 from ..conf import (
     HBM_POOL_FRACTION,
     HBM_RESERVE,
@@ -77,6 +78,12 @@ class SpillMetrics:
         self.device_to_host = 0
         self.host_to_disk = 0
         self.spilled_bytes = 0
+        #: buffers re-materialized on device after a spill (each one paid
+        #: a host->device upload the plan didn't ask for)
+        self.unspills = 0
+        #: high-water mark of catalog-tracked device bytes — the figure
+        #: to compare against the HBM budget when sizing a deployment
+        self.peak_device_bytes = 0
 
 
 class BufferCatalog:
@@ -126,6 +133,8 @@ class BufferCatalog:
             self._next_id += 1
             self._buffers[bid] = handle
             self._device_bytes += handle.size
+            if self._device_bytes > self.metrics.peak_device_bytes:
+                self.metrics.peak_device_bytes = self._device_bytes
             if self.conf.get(MEMORY_DEBUG):
                 log.info("register buffer %d (%d B, prio %d): device=%d B",
                          bid, handle.size, handle.priority, self._device_bytes)
@@ -147,6 +156,12 @@ class BufferCatalog:
             if from_host:
                 self._host_bytes -= h.size
             self._device_bytes += h.size
+            self.metrics.unspills += 1
+            if self._device_bytes > self.metrics.peak_device_bytes:
+                self.metrics.peak_device_bytes = self._device_bytes
+            if _events.enabled():
+                _events.emit("spill", kind="unspill", bytes=h.size,
+                             device_bytes=self._device_bytes)
         # the just-materialized buffer is the one in use: spill OTHERS to
         # make room (the reference pins via addReference during access)
         self.request(0, exclude=h)
@@ -180,6 +195,10 @@ class BufferCatalog:
                     self._host_bytes += freed
                     self.metrics.device_to_host += 1
                     self.metrics.spilled_bytes += freed
+                    if _events.enabled():
+                        _events.emit("spill", kind="device_to_host",
+                                     bytes=freed,
+                                     device_bytes=self._device_bytes)
                 need -= freed
                 if self.conf.get(MEMORY_DEBUG):
                     log.info("spilled %d B to host (device=%d B)",
@@ -205,6 +224,10 @@ class BufferCatalog:
                 with self._lock:
                     self._host_bytes -= freed
                     self.metrics.host_to_disk += 1
+                    if _events.enabled():
+                        _events.emit("spill", kind="host_to_disk",
+                                     bytes=freed,
+                                     device_bytes=self._device_bytes)
 
     def _disk_dir(self) -> str:
         if self._spill_dir is None:

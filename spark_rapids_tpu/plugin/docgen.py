@@ -25,7 +25,8 @@ def _import_conf_modules() -> None:
     depend on what happens to be imported, so pull them all in first."""
     import importlib
 
-    for mod in ("spark_rapids_tpu.memory.catalog",
+    for mod in ("spark_rapids_tpu.events",
+                "spark_rapids_tpu.memory.catalog",
                 "spark_rapids_tpu.ml.columnar_rdd"):
         try:
             importlib.import_module(mod)

@@ -426,6 +426,17 @@ class PlanAnalysis:
     def render(self) -> str:
         return "\n".join(self.render_lines())
 
+    def event_fields(self) -> Dict[str, object]:
+        """The JSON-safe forecast payload for the ``plan_analysis``
+        event-log record — tools/tpu_profile.py diffs these bounds against
+        the measured compile_miss / op_batch events of the same query (the
+        offline twin of the test harness's analysis cross-check)."""
+        return {"bounded": self.bounded,
+                "site_forecast": dict(self.site_forecast),
+                "bytes_by_op": dict(self.bytes_by_op),
+                "peak_hbm": self.peak_hbm, "budget": self.budget,
+                "warnings": list(self.warnings)}
+
 
 # ---------------------------------------------------------------------------
 # The analyzer walk

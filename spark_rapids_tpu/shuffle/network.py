@@ -30,7 +30,11 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from .. import types as T
-from .transport import ShufflePiece, ShuffleTransport
+from .transport import (
+    SerializingTransportBase,
+    ShufflePiece,
+    ShuffleTransport,  # noqa: F401 — re-exported for SPI typing
+)
 
 _U64x3 = struct.Struct("<QQQ")
 _U64x5 = struct.Struct("<QQQQQ")
@@ -281,7 +285,7 @@ def local_server(port: int = 0) -> "ShuffleServer":
         return _LOCAL_SERVER
 
 
-class NetworkShuffleTransport(ShuffleTransport):
+class NetworkShuffleTransport(SerializingTransportBase):
     """ShuffleTransport over a set of remote block servers.
 
     ``write`` serializes and stores locally (this process's server owns
@@ -296,22 +300,17 @@ class NetworkShuffleTransport(ShuffleTransport):
                  codec: str = "none",
                  push_to: Optional[Tuple[str, int]] = None,
                  owns_server: bool = True):
+        super().__init__(codec)  # codec timing/byte/event accounting
         self.server = server
-        self.codec = codec
         self._clients = [ShuffleClient(a) for a in remotes]
         self._push = ShuffleClient(push_to) if push_to else None
-        self._bytes = 0
         # conf-built transports share the process-wide server; closing one
         # exchange must not tear it down for the others
         self._owns_server = owns_server
 
     def write(self, shuffle_id, map_id, reduce_id, piece, schema):
-        from ..exec.base import batch_from_vals
-        from .serializer import serialize_batch
-
-        batch = batch_from_vals(piece.vals, schema, piece.n)
-        data = serialize_batch(batch, self.codec)
-        self._bytes += len(data)
+        data = self._encode_piece(piece, schema, shuffle_id, map_id,
+                                  reduce_id)
         if self._push is not None:
             self._push.push_serialized(shuffle_id, map_id, reduce_id, data)
         elif self.server is not None:
@@ -320,28 +319,13 @@ class NetworkShuffleTransport(ShuffleTransport):
             raise RuntimeError("no local server and no push target")
 
     def fetch(self, shuffle_id, reduce_id):
-        from ..exec.base import vals_of_batch
-        from .serializer import deserialize_batch
-
         raw: List[Tuple[int, bytes]] = []
         if self.server is not None:
             raw.extend(self.server.store.get(shuffle_id, reduce_id))
         for c in self._clients:
             raw.extend(c.fetch_serialized(shuffle_id, reduce_id))
         raw.sort(key=lambda e: e[0])
-        out = []
-        for _, data in raw:
-            batch = deserialize_batch(data)
-            vals = vals_of_batch(batch)
-            byte_lens = tuple(
-                int(c.offsets[batch.num_rows])
-                for c in batch.columns if c.is_string
-            )
-            out.append(ShufflePiece(vals, batch.num_rows, byte_lens))
-        return out
-
-    def bytes_written(self):
-        return self._bytes
+        return self._decode_entries(raw, shuffle_id, reduce_id)
 
     def release(self, shuffle_id):
         if self.server is not None:

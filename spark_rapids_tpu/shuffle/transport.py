@@ -15,8 +15,10 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from .. import events as _events
 from .. import types as T
 from ..expr.eval import Val
 
@@ -38,6 +40,9 @@ class ShufflePiece:
 class ShuffleTransport:
     """Transport SPI (reference: RapidsShuffleTransport.scala:328)."""
 
+    #: wire codec of this transport ("none" when pieces never serialize)
+    codec = "none"
+
     def write(self, shuffle_id: int, map_id: int, reduce_id: int,
               piece: ShufflePiece, schema: T.StructType) -> None:
         raise NotImplementedError
@@ -48,6 +53,15 @@ class ShuffleTransport:
 
     def bytes_written(self) -> int:
         return 0
+
+    def stats(self) -> Dict[str, int]:
+        """Cumulative transport-side counters the exchange surfaces as
+        per-shuffle metrics (shuffle was the one layer the per-op profiler
+        skipped): wire bytes in both directions plus codec encode/decode
+        time, zero where a path doesn't apply (the device transport never
+        serializes)."""
+        return {"bytes_written": self.bytes_written(), "bytes_fetched": 0,
+                "encode_ns": 0, "decode_ns": 0}
 
     def release(self, shuffle_id: int) -> None:
         pass
@@ -63,6 +77,8 @@ class DeviceShuffleTransport(ShuffleTransport):
     def __init__(self):
         self._catalog: Dict[Tuple[int, int], List[Tuple[int, object]]] = {}
         self._lock = threading.Lock()
+        self._bytes = 0
+        self._fetched = 0
 
     def write(self, shuffle_id, map_id, reduce_id, piece, schema):
         from ..memory import INPUT_FROM_SHUFFLE_PRIORITY, SpillableVals
@@ -72,6 +88,11 @@ class DeviceShuffleTransport(ShuffleTransport):
         with self._lock:
             self._catalog.setdefault((shuffle_id, reduce_id), []).append(
                 (map_id, entry))
+            self._bytes += sv.size_bytes
+        if _events.enabled():
+            _events.emit("shuffle_write", shuffle_id=shuffle_id,
+                         map_id=map_id, reduce_id=reduce_id, rows=piece.n,
+                         bytes=sv.size_bytes, codec=self.codec)
 
     def fetch(self, shuffle_id, reduce_id):
         with self._lock:
@@ -79,10 +100,26 @@ class DeviceShuffleTransport(ShuffleTransport):
                 self._catalog.get((shuffle_id, reduce_id), ()),
                 key=lambda e: e[0],
             )
-        return [
+        out = [
             ShufflePiece(sv.get_vals(), n, bl)
             for _, (sv, n, bl) in entries
         ]
+        nb = sum(sv.size_bytes for _, (sv, _n, _bl) in entries)
+        with self._lock:
+            self._fetched += nb
+        if _events.enabled():
+            _events.emit("shuffle_fetch", shuffle_id=shuffle_id,
+                         reduce_id=reduce_id, pieces=len(out),
+                         rows=sum(p.n for p in out), bytes=nb,
+                         codec=self.codec)
+        return out
+
+    def bytes_written(self):
+        return self._bytes
+
+    def stats(self):
+        return {"bytes_written": self._bytes, "bytes_fetched": self._fetched,
+                "encode_ns": 0, "decode_ns": 0}
 
     def release(self, shuffle_id):
         with self._lock:
@@ -92,50 +129,104 @@ class DeviceShuffleTransport(ShuffleTransport):
             sv.close()
 
 
-class SerializedShuffleTransport(ShuffleTransport):
-    """Pieces round-trip through the host wire format (the fallback
-    serializer path: GpuColumnarBatchSerializer.scala:51)."""
+class SerializingTransportBase(ShuffleTransport):
+    """Shared wire-format accounting for transports whose pieces
+    round-trip through the host serializer (the host-bytes fallback and
+    the network transport): codec encode/decode timing, byte counters in
+    both directions, and the shuffle_write/shuffle_fetch events — ONE
+    implementation so the two transports' metrics can never drift."""
 
     def __init__(self, codec: str = "none"):
         self.codec = codec
-        self._store: Dict[Tuple[int, int], List[Tuple[int, bytes]]] = {}
         self._bytes = 0
+        self._fetched = 0
+        self._encode_ns = 0
+        self._decode_ns = 0
         self._lock = threading.Lock()
 
-    def write(self, shuffle_id, map_id, reduce_id, piece, schema):
+    def _encode_piece(self, piece: ShufflePiece, schema, shuffle_id: int,
+                      map_id: int, reduce_id: int) -> bytes:
+        """piece -> wire bytes, accounting encode time + written bytes."""
         from ..exec.base import batch_from_vals
         from .serializer import serialize_batch
 
         batch = batch_from_vals(piece.vals, schema, piece.n)
+        t0 = time.perf_counter_ns()
         data = serialize_batch(batch, self.codec)
+        enc = time.perf_counter_ns() - t0
         with self._lock:
             self._bytes += len(data)
-            self._store.setdefault((shuffle_id, reduce_id), []).append(
-                (map_id, data))
+            self._encode_ns += enc
+        if _events.enabled():
+            _events.emit("shuffle_write", shuffle_id=shuffle_id,
+                         map_id=map_id, reduce_id=reduce_id, rows=piece.n,
+                         bytes=len(data), codec=self.codec)
+        return data
 
-    def fetch(self, shuffle_id, reduce_id):
+    def _decode_entries(self, entries: Sequence[Tuple[int, bytes]],
+                        shuffle_id: int, reduce_id: int
+                        ) -> List[ShufflePiece]:
+        """map-ordered (map_id, wire bytes) -> pieces, accounting decode
+        time (incl. the device upload the decode implies) + fetched bytes."""
         from ..exec.base import vals_of_batch
-        from ..expr.eval import StrV
         from .serializer import deserialize_batch
 
-        with self._lock:
-            entries = sorted(
-                self._store.get((shuffle_id, reduce_id), ()),
-                key=lambda e: e[0],
-            )
-        out = []
+        out: List[ShufflePiece] = []
+        nb = 0
+        t0 = time.perf_counter_ns()
         for _, data in entries:
             batch = deserialize_batch(data)
+            nb += len(data)
             vals = vals_of_batch(batch)
             byte_lens = tuple(
                 int(c.offsets[batch.num_rows])
                 for c in batch.columns if c.is_string
             )
             out.append(ShufflePiece(vals, batch.num_rows, byte_lens))
+        dec = time.perf_counter_ns() - t0
+        with self._lock:
+            self._fetched += nb
+            self._decode_ns += dec
+        if _events.enabled():
+            _events.emit("shuffle_fetch", shuffle_id=shuffle_id,
+                         reduce_id=reduce_id, pieces=len(out),
+                         rows=sum(p.n for p in out), bytes=nb,
+                         codec=self.codec)
         return out
 
     def bytes_written(self):
         return self._bytes
+
+    def stats(self):
+        with self._lock:
+            return {"bytes_written": self._bytes,
+                    "bytes_fetched": self._fetched,
+                    "encode_ns": self._encode_ns,
+                    "decode_ns": self._decode_ns}
+
+
+class SerializedShuffleTransport(SerializingTransportBase):
+    """Pieces round-trip through the host wire format (the fallback
+    serializer path: GpuColumnarBatchSerializer.scala:51)."""
+
+    def __init__(self, codec: str = "none"):
+        super().__init__(codec)
+        self._store: Dict[Tuple[int, int], List[Tuple[int, bytes]]] = {}
+
+    def write(self, shuffle_id, map_id, reduce_id, piece, schema):
+        data = self._encode_piece(piece, schema, shuffle_id, map_id,
+                                  reduce_id)
+        with self._lock:
+            self._store.setdefault((shuffle_id, reduce_id), []).append(
+                (map_id, data))
+
+    def fetch(self, shuffle_id, reduce_id):
+        with self._lock:
+            entries = sorted(
+                self._store.get((shuffle_id, reduce_id), ()),
+                key=lambda e: e[0],
+            )
+        return self._decode_entries(entries, shuffle_id, reduce_id)
 
     def release(self, shuffle_id):
         with self._lock:

@@ -11,6 +11,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
+from .. import events as _events  # registers the eventLog.* conf entries
 from ..conf import RapidsConf
 from ..cpu import plan as C
 from ..memory import catalog as _catalog  # noqa: F401 — registers the
@@ -216,6 +217,12 @@ class TpuSession:
         self.last_executed_plan = None
         self.last_cpu_plan = None
         self.last_analysis = None
+        # the structured event log (events.py): a ring buffer always backs
+        # export_trace(); a JSONL sink appears when eventLog.dir is set.
+        # Disabled (the default) costs one boolean per emit site.
+        self.events = _events.EventLogger(self.conf)
+        self._query_seq = 0
+        self._active_query: Optional[int] = None
 
     @property
     def last_explain(self) -> str:
@@ -254,9 +261,15 @@ class TpuSession:
 
         cpu = _lower(node, self.conf)
         self.last_cpu_plan = cpu
-        from ..conf import ANALYSIS_CROSS_CHECK, SQL_ENABLED
+        from ..conf import ANALYSIS_CROSS_CHECK, ANALYSIS_ENABLED, SQL_ENABLED
 
-        if self.conf.get(SQL_ENABLED) and self.conf.get(ANALYSIS_CROSS_CHECK):
+        run_analysis = self.conf.get(SQL_ENABLED) and (
+            self.conf.get(ANALYSIS_CROSS_CHECK)
+            # with event logging on, the analyzer's forecasts ride in the
+            # log so tpu_profile's forecast-vs-actual report has its
+            # bounds without a separate explain() run
+            or (self.events.enabled and self.conf.get(ANALYSIS_ENABLED)))
+        if run_analysis:
             # the static analyzer runs BEFORE conversion/execution — it
             # must never touch the device (plugin/plananalysis.py)
             from ..plugin.plananalysis import analyze_plan
@@ -269,7 +282,81 @@ class TpuSession:
         # snapshot BEFORE execution so explain_metrics reports only the
         # misses THIS plan's run compiled (the counter is process-global)
         self._compile_baseline = compile_snapshot()
+        if self.events.enabled:
+            self._emit_query_events(node, cpu, is_tpu)
         return final
+
+    # -- event log ---------------------------------------------------------
+    def _emit_query_events(self, node: LNode, cpu: C.CpuExec,
+                           is_tpu: bool) -> None:
+        """query_start + plan_tagged + plan_analysis for one execution.
+        The session's logger becomes the process-wide active sink, so
+        engine-level emitters (catalog, caches, transports) attribute to
+        this session's log."""
+        import hashlib
+
+        _events.install(self.events)
+        self._query_seq += 1
+        qid = self._query_seq
+        self._active_query = qid
+
+        def digest(s: str) -> str:
+            return hashlib.sha1(s.encode()).hexdigest()[:12]
+
+        _events.emit("query_start", query_id=qid,
+                     plan_digest=digest(cpu.tree_string()),
+                     sql_hash=digest(repr(node)))
+        meta = self.overrides.last_meta
+        if meta is not None:
+            fallbacks = []
+
+            def walk(m):
+                if m.reasons:
+                    name = m.rule.name if m.rule else m.wrapped.node_name
+                    fallbacks.append({"op": name,
+                                      "reasons": list(m.reasons)})
+                for c in m.child_metas:
+                    walk(c)
+
+            walk(meta)
+            _events.emit("plan_tagged", query_id=qid, on_tpu=is_tpu,
+                         fallbacks=fallbacks)
+        if self.last_analysis is not None:
+            _events.emit("plan_analysis", query_id=qid,
+                         **self.last_analysis.event_fields())
+
+    def _run_collect(self, final: C.CpuExec) -> List[tuple]:
+        """Driver-side collect with the query_end event (duration + row
+        count) paired to _execute's query_start. Emitted in a finally so a
+        failing query still CLOSES its window — an unterminated
+        query_start would make the offline profiler attribute every later
+        event to the dead query."""
+        import time as _time
+
+        t0 = _time.perf_counter_ns()
+        rows: Optional[List[tuple]] = None
+        try:
+            rows = final.collect()
+            return rows
+        finally:
+            if self.events.enabled:
+                _events.emit(
+                    "query_end", query_id=self._active_query,
+                    dur=_time.perf_counter_ns() - t0,
+                    rows=len(rows) if rows is not None else None,
+                    error=rows is None)
+
+    def export_trace(self, path: str) -> str:
+        """Write the session's event ring buffer as Chrome/Perfetto
+        trace-event JSON — open it directly in ui.perfetto.dev. Works with
+        or without eventLog.dir (the ring buffer always backs it); raises
+        when event logging is off entirely."""
+        if not self.events.enabled:
+            raise RuntimeError(
+                "event logging is off: set spark.rapids.tpu.eventLog."
+                "enabled (ring buffer only) or eventLog.dir (JSONL file) "
+                "to record a trace")
+        return _events.export_chrome_trace(self.events.records(), path)
 
     def explain_metrics(self) -> str:
         """Per-operator metrics report for the LAST executed plan — the
@@ -340,27 +427,45 @@ class DataFrameWriter:
 
     def _batches(self):
         df = self._df
-        final = df.session._execute(df.node)
+        sess = df.session
+        final = sess._execute(df.node)
         schema = final.output_schema
+        # capture NOW: by the time the generator drains, another query on
+        # this session may have replaced _active_query
+        qid = sess._active_query
 
         def gen():
-            if isinstance(final, ColumnarToRowExec):
-                # columnar fast path: hand device batches to the writer
-                yield from final.tpu_child.execute_columnar()
-            else:
-                from ..columnar.batch import batch_from_rows
+            import time as _time
 
-                buf: List[tuple] = []
-                for row in (
-                    r for p in range(final.num_partitions)
-                    for r in final.execute_rows_partition(p)
-                ):
-                    buf.append(row)
-                    if len(buf) >= 65536:
+            t0 = _time.perf_counter_ns()
+            ok = False
+            try:
+                if isinstance(final, ColumnarToRowExec):
+                    # columnar fast path: hand device batches to the writer
+                    yield from final.tpu_child.execute_columnar()
+                else:
+                    from ..columnar.batch import batch_from_rows
+
+                    buf: List[tuple] = []
+                    for row in (
+                        r for p in range(final.num_partitions)
+                        for r in final.execute_rows_partition(p)
+                    ):
+                        buf.append(row)
+                        if len(buf) >= 65536:
+                            yield batch_from_rows(buf, schema)
+                            buf = []
+                    if buf:
                         yield batch_from_rows(buf, schema)
-                        buf = []
-                if buf:
-                    yield batch_from_rows(buf, schema)
+                ok = True
+            finally:
+                if sess.events.enabled:
+                    # writer path: duration only (a row count would force
+                    # a device sync per batch just for logging); the
+                    # finally closes the window even on error/abandonment
+                    _events.emit("query_end", query_id=qid,
+                                 dur=_time.perf_counter_ns() - t0,
+                                 rows=None, error=not ok)
 
         return gen(), schema
 
@@ -506,7 +611,7 @@ class DataFrame:
         return self.schema.names
 
     def collect(self) -> List[tuple]:
-        return self.session._execute(self.node).collect()
+        return self.session._run_collect(self.session._execute(self.node))
 
     def count(self) -> int:
         return len(self.collect())

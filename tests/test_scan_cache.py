@@ -54,6 +54,11 @@ def test_cache_hit_and_rewrite_invalidation(tmp_path):
     assert again == first
     assert cache.misses == misses0  # second read served from the pool
     assert cache.hits > 0
+    # the stats() API mirrors the raw counters (cache effectiveness was
+    # previously unobservable outside the attributes)
+    st = cache.stats()
+    assert st["hits"] == cache.hits and st["misses"] == cache.misses
+    assert st["evictions"] == 0 and st["entries"] >= 1 and st["bytes"] > 0
 
     # rewrite the file: mtime/size key must miss and recompute
     time.sleep(0.01)  # ensure mtime_ns moves even on coarse filesystems
@@ -76,11 +81,34 @@ def test_cache_lru_eviction():
     c = DeviceScanCache(100)
     c.put(("a", 0, 0, 0, (), None), "A", 60)
     c.put(("b", 0, 0, 0, (), None), "B", 60)  # evicts A
+    assert c.evictions == 1
     assert c.get(("a", 0, 0, 0, (), None)) is None
     assert c.get(("b", 0, 0, 0, (), None)) == "B"
-    # oversized entries never enter the pool
+    # oversized entries never enter the pool (and are not "evictions")
     c.put(("c", 0, 0, 0, (), None), "C", 1000)
     assert c.get(("c", 0, 0, 0, (), None)) is None
+    assert c.stats() == {"hits": 1, "misses": 2, "evictions": 1,
+                         "entries": 1, "bytes": 60, "max_bytes": 100}
+
+
+def test_cache_events_emitted():
+    # hit/miss/evict activity lands in the structured event log
+    from spark_rapids_tpu import events as EV
+
+    logger = EV.EventLogger(RapidsConf(
+        {"spark.rapids.tpu.eventLog.enabled": True}))
+    EV.install(logger)
+    try:
+        c = DeviceScanCache(100)
+        c.get(("a", 0, 0, 0, (), None))          # miss
+        c.put(("a", 0, 0, 0, (), None), "A", 60)
+        c.get(("a", 0, 0, 0, (), None))          # hit
+        c.put(("b", 0, 0, 0, (), None), "B", 60)  # evicts a
+        ops = [r["op"] for r in logger.records()
+               if r["event"] == "scan_cache"]
+        assert ops == ["miss", "put", "hit", "put", "evict"]
+    finally:
+        EV.uninstall()
 
 
 def test_budget_resize_on_get_instance():

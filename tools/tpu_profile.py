@@ -1,0 +1,453 @@
+#!/usr/bin/env python
+"""Offline query profiler over structured event logs.
+
+The rapids-4-spark profiling-tool analog: consume one or more JSONL event
+logs produced by ``spark.rapids.tpu.eventLog.dir`` (spark_rapids_tpu/
+events.py) and answer "where did this query's time and memory actually go,
+and did it regress since last run?" without re-running anything.
+
+Report sections:
+  * queries           — per-query duration, rows, plan digest, fallbacks
+  * top ops           — top-N operators by device time (host time when no
+                        deviceSync lane was recorded), batches/rows/bytes
+  * compile misses    — per-site counts, storm flag at/over the threshold
+  * transfers         — host-link bytes each way + sync-point count
+  * shuffle           — pieces/bytes/rows each way, per codec
+  * spill timeline    — every spill/unspill with the live device-byte
+                        watermark, plus the peak
+  * scan cache        — hit/miss/evict counts and bytes
+  * forecast vs actual— the static plan analyzer's bounds (plan_analysis
+                        events) diffed against measured compile misses and
+                        per-op bytes; any measured value above its bound is
+                        a VIOLATION (the offline twin of the test
+                        harness's analysis cross-check) and makes the exit
+                        code nonzero so CI catches emitter/analyzer drift
+
+Diff mode (``--diff A B``): compare two event logs (per-op host/device
+time and bytes) or two bench JSON result files (``BENCH_*.json`` — the
+``per_shape`` block's tpu_ms/device_ms per shape). Regressions beyond
+``--threshold`` (default 20%) are flagged and make the exit code nonzero.
+
+Usage:
+  python tools/tpu_profile.py LOG.jsonl [LOG2.jsonl ...] [--top N]
+  python tools/tpu_profile.py --diff OLD NEW [--threshold 0.2]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_STORM_THRESHOLD = 8
+#: time deltas under this (ns) are measurement noise, never a regression
+DIFF_MIN_NS = 1_000_000
+#: same floor for bench-JSON ms fields (0.1ms of scheduler jitter on a
+#: 0.3ms shape is a 1.33x "ratio", not a regression)
+DIFF_MIN_MS = 1.0
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+def load_events(paths: List[str]) -> List[dict]:
+    """Events from JSONL files (directories expand to their *.jsonl),
+    merged and sorted by timestamp."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if f.endswith(".jsonl")))
+        else:
+            files.append(p)
+    out: List[dict] = []
+    for f in files:
+        with open(f) as fh:
+            for i, line in enumerate(fh):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError as e:
+                    raise SystemExit(
+                        f"{f}:{i + 1}: not a JSONL event log ({e})")
+    out.sort(key=lambda r: r.get("ts", 0))
+    return out
+
+
+def _is_bench_json(path: str) -> bool:
+    try:
+        with open(path) as f:
+            head = f.read(1 << 20)
+        return "per_shape" in head and path.endswith(".json")
+    except OSError:
+        return False
+
+
+def _ms(ns: Optional[float]) -> str:
+    return "-" if ns is None else f"{ns / 1e6:.1f}ms"
+
+
+def _mb(b: Optional[float]) -> str:
+    return "-" if b is None else f"{b / 1e6:.2f}MB"
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+class OpStats:
+    __slots__ = ("host_ns", "device_ns", "batches", "rows", "bytes")
+
+    def __init__(self):
+        self.host_ns = 0
+        self.device_ns = 0
+        self.batches = 0
+        self.rows = 0
+        self.bytes = 0
+
+
+def aggregate_ops(events: List[dict]) -> Dict[str, OpStats]:
+    ops: Dict[str, OpStats] = defaultdict(OpStats)
+    for r in events:
+        ev = r.get("event")
+        if ev == "op_span":
+            s = ops[r["op"]]
+            if r.get("lane") == "device":
+                s.device_ns += r["dur"]
+            else:
+                s.host_ns += r["dur"]
+        elif ev == "op_batch":
+            s = ops[r["op"]]
+            s.batches += 1
+            s.rows += r.get("rows") or 0
+            s.bytes += r.get("bytes") or 0
+    return dict(ops)
+
+
+def _query_windows(events: List[dict]) -> List[dict]:
+    """One record per query: start/end ts, duration, rows, tagging and
+    analysis payloads, and the events inside its window (single-session
+    logs interleave queries serially, so windows are ts ranges)."""
+    queries: Dict[object, dict] = {}
+    order: List[dict] = []
+    for r in events:
+        ev = r.get("event")
+        if ev == "query_start":
+            q = {"query_id": r.get("query_id"), "start": r["ts"],
+                 "end": None, "dur": None, "rows": None,
+                 "plan_digest": r.get("plan_digest"),
+                 "tagged": None, "analysis": None}
+            queries[r.get("query_id")] = q
+            order.append(q)
+        elif ev == "plan_tagged" and r.get("query_id") in queries:
+            queries[r["query_id"]]["tagged"] = r
+        elif ev == "plan_analysis" and r.get("query_id") in queries:
+            queries[r["query_id"]]["analysis"] = r
+        elif ev == "query_end" and r.get("query_id") in queries:
+            q = queries[r["query_id"]]
+            q["end"] = r["ts"]
+            q["dur"] = r.get("dur")
+            q["rows"] = r.get("rows")
+    for q in order:
+        lo, hi = q["start"], q["end"] if q["end"] is not None else float("inf")
+        q["events"] = [r for r in events if lo <= r.get("ts", 0) <= hi]
+    return order
+
+
+def forecast_vs_actual(queries: List[dict]) -> Tuple[List[str], int]:
+    """Per bounded query: measured compile misses per site vs the
+    analyzer's forecast, and measured per-op bytes vs the byte bound.
+    Mirrors tests/harness.py::_assert_analysis_cross_check semantics —
+    warm caches may miss LESS than forecast, never more."""
+    lines: List[str] = []
+    violations = 0
+    for q in queries:
+        an = q.get("analysis")
+        if an is None:
+            continue
+        qid = q["query_id"]
+        if not an.get("bounded"):
+            lines.append(f"  query {qid}: not statically bounded "
+                         "(layouts reported, forecasts omitted)")
+            continue
+        actual_sites: Dict[str, int] = defaultdict(int)
+        actual_bytes: Dict[str, int] = defaultdict(int)
+        for r in q["events"]:
+            if r.get("event") == "compile_miss":
+                actual_sites[r["site"]] += 1
+            elif r.get("event") == "op_batch":
+                actual_bytes[r["op"]] += r.get("bytes") or 0
+        forecast = an.get("site_forecast") or {}
+        bounds = an.get("bytes_by_op") or {}
+        for site in sorted(set(actual_sites) | set(forecast)):
+            got, exp = actual_sites.get(site, 0), forecast.get(site, 0)
+            bad = got > exp
+            violations += bad
+            lines.append(
+                f"  query {qid} compile[{site}]: actual {got} <= "
+                f"forecast {exp}" if not bad else
+                f"  query {qid} compile[{site}]: VIOLATION actual {got} > "
+                f"forecast {exp}")
+        for op in sorted(actual_bytes):
+            got = actual_bytes[op]
+            bound = bounds.get(op)
+            bad = bound is None or got > bound
+            violations += bad
+            if bound is None:
+                lines.append(f"  query {qid} bytes[{op}]: VIOLATION "
+                             f"measured {_mb(got)} has no analyzer bound")
+            elif bad:
+                lines.append(f"  query {qid} bytes[{op}]: VIOLATION "
+                             f"measured {_mb(got)} > bound {_mb(bound)}")
+            else:
+                lines.append(f"  query {qid} bytes[{op}]: measured "
+                             f"{_mb(got)} <= bound {_mb(bound)}")
+    if not lines:
+        lines.append("  no plan_analysis events in log (enable "
+                     "sql.analysis.enabled with the event log on)")
+    lines.append(f"  {violations} violation(s)")
+    return lines, violations
+
+
+# ---------------------------------------------------------------------------
+# the report
+# ---------------------------------------------------------------------------
+def build_report(events: List[dict], top_n: int = 10,
+                 storm_threshold: int = DEFAULT_STORM_THRESHOLD
+                 ) -> Tuple[str, int]:
+    """(report text, violation count) for one merged event stream."""
+    lines: List[str] = []
+    queries = _query_windows(events)
+
+    lines.append("== queries ==")
+    if not queries:
+        lines.append("  none recorded")
+    for q in queries:
+        fb = q.get("tagged") or {}
+        nfb = len(fb.get("fallbacks") or [])
+        lines.append(
+            f"  query {q['query_id']} plan={q.get('plan_digest')} "
+            f"dur={_ms(q['dur'])} rows={q['rows']}"
+            + (f" fallbacks={nfb}" if nfb else ""))
+        for f in (fb.get("fallbacks") or []):
+            lines.append(f"    !{f['op']}: {'; '.join(f['reasons'])}")
+
+    ops = aggregate_ops(events)
+    have_device = any(s.device_ns for s in ops.values())
+    lane = "device" if have_device else "host"
+    lines.append(f"== top ops by {lane} time ==")
+    ranked = sorted(
+        ops.items(),
+        key=lambda kv: (kv[1].device_ns if have_device else kv[1].host_ns),
+        reverse=True)[:top_n]
+    if not ranked:
+        lines.append("  no op spans recorded")
+    for name, s in ranked:
+        gbps = (s.bytes / s.device_ns if s.device_ns else None)
+        lines.append(
+            f"  {name}: device={_ms(s.device_ns) if s.device_ns else '-'} "
+            f"host={_ms(s.host_ns)} batches={s.batches} rows={s.rows} "
+            f"bytes={_mb(s.bytes)}"
+            + (f" hbm_gbps={gbps:.2f}" if gbps else ""))
+    if not have_device and ranked:
+        lines.append("  (no device lane: run with "
+                     "spark.rapids.tpu.metrics.deviceSync.enabled for "
+                     "device-accurate ranking)")
+
+    sites: Dict[str, int] = defaultdict(int)
+    for r in events:
+        if r.get("event") == "compile_miss":
+            sites[r["site"]] += 1
+    lines.append("== compile cache misses ==")
+    if not sites:
+        lines.append("  none (steady state)")
+    for site, n in sorted(sites.items(), key=lambda kv: -kv[1]):
+        storm = " <-- COMPILE STORM" if n >= storm_threshold else ""
+        lines.append(f"  {site}: {n}{storm}")
+
+    xfer: Dict[str, List[int]] = defaultdict(lambda: [0, 0])
+    for r in events:
+        if r.get("event") == "transfer":
+            t = xfer[r["direction"]]
+            t[0] += 1
+            t[1] += r.get("bytes") or 0
+    lines.append("== transfers ==")
+    if not xfer:
+        lines.append("  none recorded")
+    for d, (n, b) in sorted(xfer.items()):
+        lines.append(f"  {d}: {n} transfer(s), {_mb(b)}")
+
+    sh: Dict[Tuple[str, str], List[int]] = defaultdict(lambda: [0, 0, 0])
+    for r in events:
+        if r.get("event") in ("shuffle_write", "shuffle_fetch"):
+            t = sh[(r["event"], r.get("codec", "none"))]
+            t[0] += 1
+            t[1] += r.get("bytes") or 0
+            t[2] += r.get("rows") or 0
+    lines.append("== shuffle ==")
+    if not sh:
+        lines.append("  none recorded")
+    for (ev, codec), (n, b, rows) in sorted(sh.items()):
+        lines.append(f"  {ev}[{codec}]: {n} piece(s), {_mb(b)}, "
+                     f"{rows} row(s)")
+
+    spills = [r for r in events if r.get("event") == "spill"]
+    lines.append("== spill timeline ==")
+    if not spills:
+        lines.append("  none (working set fit the budget)")
+    else:
+        base = events[0]["ts"]
+        peak = 0
+        for r in spills:
+            peak = max(peak, r["device_bytes"])
+            lines.append(
+                f"  +{(r['ts'] - base) / 1e6:.1f}ms {r['kind']} "
+                f"{_mb(r['bytes'])} (device watermark "
+                f"{_mb(r['device_bytes'])})")
+        lines.append(f"  peak device watermark: {_mb(peak)}")
+
+    sc: Dict[str, List[int]] = defaultdict(lambda: [0, 0])
+    for r in events:
+        if r.get("event") == "scan_cache":
+            t = sc[r["op"]]
+            t[0] += 1
+            t[1] += r.get("bytes") or 0
+    lines.append("== scan cache ==")
+    if not sc:
+        lines.append("  no activity")
+    for op, (n, b) in sorted(sc.items()):
+        lines.append(f"  {op}: {n} ({_mb(b)})")
+
+    lines.append("== forecast vs actual ==")
+    fa_lines, violations = forecast_vs_actual(queries)
+    lines.extend(fa_lines)
+    return "\n".join(lines), violations
+
+
+# ---------------------------------------------------------------------------
+# diff mode
+# ---------------------------------------------------------------------------
+def diff_bench(old: dict, new: dict, threshold: float
+               ) -> Tuple[str, int]:
+    lines: List[str] = []
+    regressions = 0
+    shapes = sorted(set(old.get("per_shape") or {})
+                    | set(new.get("per_shape") or {}))
+    for shape in shapes:
+        a = (old.get("per_shape") or {}).get(shape)
+        b = (new.get("per_shape") or {}).get(shape)
+        if a is None or b is None:
+            lines.append(f"  {shape}: only in "
+                         f"{'new' if a is None else 'old'} run")
+            continue
+        for field in ("tpu_ms", "device_ms"):
+            va, vb = a.get(field), b.get(field)
+            if va is None or vb is None or va <= 0:
+                continue
+            ratio = vb / va
+            if ratio > 1.0 + threshold and vb - va > DIFF_MIN_MS:
+                regressions += 1
+                lines.append(
+                    f"  {shape}.{field}: REGRESSION {va:.1f} -> {vb:.1f} "
+                    f"({ratio:.2f}x, threshold {1 + threshold:.2f}x)")
+            else:
+                lines.append(
+                    f"  {shape}.{field}: ok {va:.1f} -> {vb:.1f} "
+                    f"({ratio:.2f}x)")
+    lines.append(f"  {regressions} regression(s)")
+    return "\n".join(lines), regressions
+
+
+def diff_logs(old_events: List[dict], new_events: List[dict],
+              threshold: float) -> Tuple[str, int]:
+    lines: List[str] = []
+    regressions = 0
+    a, b = aggregate_ops(old_events), aggregate_ops(new_events)
+    for op in sorted(set(a) | set(b)):
+        sa, sb = a.get(op), b.get(op)
+        if sa is None or sb is None:
+            lines.append(f"  {op}: only in {'new' if sa is None else 'old'} "
+                         "log")
+            continue
+        for field in ("device_ns", "host_ns"):
+            va, vb = getattr(sa, field), getattr(sb, field)
+            if va <= 0 or vb <= 0:
+                continue
+            ratio = vb / va
+            # ignore sub-millisecond deltas — host scheduling noise
+            if ratio > 1.0 + threshold and vb - va > DIFF_MIN_NS:
+                regressions += 1
+                lines.append(
+                    f"  {op}.{field[:-3]}: REGRESSION {_ms(va)} -> "
+                    f"{_ms(vb)} ({ratio:.2f}x)")
+            else:
+                lines.append(f"  {op}.{field[:-3]}: ok {_ms(va)} -> "
+                             f"{_ms(vb)}")
+        if sb.bytes > sa.bytes * (1.0 + threshold) and sa.bytes > 0:
+            regressions += 1
+            lines.append(f"  {op}.bytes: REGRESSION {_mb(sa.bytes)} -> "
+                         f"{_mb(sb.bytes)}")
+    lines.append(f"  {regressions} regression(s)")
+    return "\n".join(lines), regressions
+
+
+def run_diff(old_path: str, new_path: str, threshold: float
+             ) -> Tuple[str, int]:
+    if _is_bench_json(old_path) or _is_bench_json(new_path):
+        with open(old_path) as f:
+            old = json.load(f)
+        with open(new_path) as f:
+            new = json.load(f)
+        head = [f"== diff (bench) {old_path} -> {new_path} =="]
+        body, n = diff_bench(old, new, threshold)
+    else:
+        head = [f"== diff (event logs) {old_path} -> {new_path} =="]
+        body, n = diff_logs(load_events([old_path]),
+                            load_events([new_path]), threshold)
+    return "\n".join(head + [body]), n
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Offline profiler for spark_rapids_tpu event logs "
+                    "(see module docstring)")
+    ap.add_argument("paths", nargs="+",
+                    help="event-log files/dirs; with --diff, exactly two "
+                         "logs or bench JSON files (old new)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="operators to show in the top-ops table")
+    ap.add_argument("--diff", action="store_true",
+                    help="compare two logs / bench JSONs; nonzero exit on "
+                         "regressions beyond --threshold")
+    ap.add_argument("--threshold", type=float, default=0.2,
+                    help="relative regression threshold for --diff "
+                         "(0.2 = 20%%)")
+    ap.add_argument("--storm-threshold", type=int,
+                    default=DEFAULT_STORM_THRESHOLD,
+                    help="compile misses per site that flag a storm")
+    args = ap.parse_args(argv)
+
+    if args.diff:
+        if len(args.paths) != 2:
+            ap.error("--diff takes exactly two paths (old new)")
+        text, bad = run_diff(args.paths[0], args.paths[1], args.threshold)
+        print(text)
+        return 1 if bad else 0
+
+    events = load_events(args.paths)
+    if not events:
+        print("no events found", file=sys.stderr)
+        return 1
+    text, violations = build_report(events, args.top, args.storm_threshold)
+    print(text)
+    # forecast violations mean the analyzer's bounds or the emitters
+    # drifted — CI runs this on a fresh log so the drift can't land
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
