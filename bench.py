@@ -95,6 +95,46 @@ def _consume(exec_):
     return [b.to_rows() for b in exec_.execute_columnar()]
 
 
+def _mem_snapshot():
+    """(scan-cache hits, misses) before a shape runs — the deltas give
+    the per-shape hit rate (the cache is a process singleton). Also
+    rebases the BufferCatalog's peak watermark to the CURRENT level so
+    the value read after the shape is THIS shape's peak, not a hungrier
+    earlier shape's (the watermark is a monotonic process-wide max;
+    bench owns the process, so resetting it between shapes is safe)."""
+    from spark_rapids_tpu.io.scan_cache import DeviceScanCache
+    from spark_rapids_tpu.memory.catalog import BufferCatalog
+
+    cat = BufferCatalog.get()
+    cat.metrics.peak_device_bytes = cat.device_bytes
+    inst = DeviceScanCache._instance
+    return (inst.hits, inst.misses) if inst is not None else (0, 0)
+
+
+def _mem_stats(before):
+    """Per-shape memory-pressure block for the BENCH json: the
+    BufferCatalog's peak device-byte watermark over THIS shape's window
+    (rebased in _mem_snapshot — how close the shape got to the spill
+    budget; the perf trajectory should track memory pressure, not just
+    time) and the scan-cache hit rate over the shape's own accesses
+    (None when the shape never touched the cache)."""
+    from spark_rapids_tpu.io.scan_cache import DeviceScanCache
+    from spark_rapids_tpu.memory.catalog import BufferCatalog
+
+    h0, m0 = before
+    h1, m1 = _mem_snapshot()
+    seen = (h1 - h0) + (m1 - m0)
+    cat = BufferCatalog.get()
+    return {
+        "peak_device_bytes": cat.metrics.peak_device_bytes,
+        "scan_cache_hit_rate": (
+            round((h1 - h0) / seen, 3) if seen else None),
+        "scan_cache_bytes": (
+            DeviceScanCache._instance.stats()["bytes"]
+            if DeviceScanCache._instance is not None else 0),
+    }
+
+
 def _device_time(exec_, iters=4):
     """Device-side wallclock of one query, net of the host link.
 
@@ -497,7 +537,9 @@ def main() -> None:
     for name in (s.strip() for s in args.shapes.split(",")):
         fn = SHAPES[name]
         carg = conf_dict if name == "parquet" else conf
+        mem_before = _mem_snapshot()
         cpu_t, tpu_t, extra = fn(args.scale, args.iters, carg, T, E, A, X)
+        extra.update(_mem_stats(mem_before))
         sp = cpu_t / tpu_t
         results[name] = sp
         details[name] = {"speedup": round(sp, 2),

@@ -369,6 +369,65 @@ LINT_ALLOWLIST_PATH = conf(
     "--allowlist=); not a per-session runtime setting.")
 
 # ---------------------------------------------------------------------------
+# Live observability plane (obs/): metrics registry, /metrics + /status
+# HTTP exporter, stall/pressure/storm watchdog. Reference analog: the
+# SQLMetrics stream into the live Spark UI (the event log covers offline).
+# ---------------------------------------------------------------------------
+LIVE_METRICS_ENABLED = conf(
+    "spark.rapids.tpu.metrics.live.enabled", False,
+    "Install the process-global live metrics registry (obs/): per-op "
+    "host/device time and bytes, compile misses by site, the "
+    "BufferCatalog HBM watermark, shuffle transport traffic, scan-cache "
+    "hit rate, per-query progress. Implied by metrics.http.enabled and "
+    "watchdog.enabled. Off by default — the engine's emit fast path is "
+    "a single boolean check (the event-log zero-overhead contract).")
+METRICS_HTTP_ENABLED = conf(
+    "spark.rapids.tpu.metrics.http.enabled", False,
+    "Start the stdlib-HTTP exporter daemon thread serving /metrics "
+    "(Prometheus text exposition 0.0.4 of the whole metric catalog) and "
+    "/status (JSON: live queries with forecast-derived per-op progress, "
+    "HBM watermark vs budget, watchdog alerts — the payload "
+    "tools/tpu_top.py renders). Implies metrics.live.enabled.")
+METRICS_HTTP_PORT = conf(
+    "spark.rapids.tpu.metrics.http.port", 0,
+    "TCP port for the metrics exporter; 0 picks an ephemeral port "
+    "(read the chosen address from TpuSession.obs_address).")
+METRICS_HTTP_HOST = conf(
+    "spark.rapids.tpu.metrics.http.host", "127.0.0.1",
+    "Bind address for the metrics exporter (localhost by default; bind "
+    "0.0.0.0 only behind your own auth/network policy).")
+WATCHDOG_ENABLED = conf(
+    "spark.rapids.tpu.watchdog.enabled", False,
+    "Start the watchdog sampler thread: raises typed alerts — operator "
+    "span open past watchdog.stallThresholdMs (stall), HBM watermark "
+    "above watchdog.hbmPressureFraction of the derived budget "
+    "(hbm_pressure), at least sql.analysis.recompileStorm.threshold "
+    "compile misses on one site inside watchdog.recompileStorm.windowMs "
+    "(recompile_storm) — surfaced as log warnings, 'alert' events in "
+    "the event log, and the /status alerts list. Implies "
+    "metrics.live.enabled. Tune thresholds offline with "
+    "tools/tpu_profile.py --alerts over a recorded event log.")
+WATCHDOG_INTERVAL_MS = conf(
+    "spark.rapids.tpu.watchdog.intervalMs", 1000,
+    "Watchdog sample interval.", check=_positive)
+WATCHDOG_STALL_MS = conf(
+    "spark.rapids.tpu.watchdog.stallThresholdMs", 30000,
+    "An operator span still open after this long raises a stall alert "
+    "(a hung device dispatch, a wedged host decode).", check=_positive)
+WATCHDOG_PRESSURE_FRACTION = conf(
+    "spark.rapids.tpu.watchdog.hbmPressureFraction", 0.85,
+    "Raise an hbm_pressure alert when the BufferCatalog device-byte "
+    "watermark reaches this fraction of the derived HBM budget (the "
+    "SAME derive_hbm_budget the spiller and plan analyzer use).",
+    check=_fraction)
+WATCHDOG_STORM_WINDOW_MS = conf(
+    "spark.rapids.tpu.watchdog.recompileStorm.windowMs", 10000,
+    "Sliding window for the LIVE recompile-storm alert; the per-site "
+    "miss-count threshold is sql.analysis.recompileStorm.threshold (one "
+    "storm definition engine-wide: static forecast, offline profiler "
+    "footer, and live watchdog all agree).", check=_positive)
+
+# ---------------------------------------------------------------------------
 # Test hooks (reference: RapidsConf 'test' keys)
 # ---------------------------------------------------------------------------
 TEST_CONF = conf(

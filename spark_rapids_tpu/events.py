@@ -66,9 +66,11 @@ EVENT_TYPES: Dict[str, tuple] = {
     # plan tagging: one record per query with every fallback reason the
     # type matrix produced (plugin/overrides.py + typechecks.py)
     "plan_tagged": ("query_id", "on_tpu", "fallbacks"),
-    # static plan analyzer forecasts (plugin/plananalysis.py)
+    # static plan analyzer forecasts (plugin/plananalysis.py); rows/
+    # batches_by_op are the denominators /status progress divides into
     "plan_analysis": ("query_id", "bounded", "site_forecast", "bytes_by_op",
-                      "peak_hbm", "budget", "warnings"),
+                      "rows_by_op", "batches_by_op", "peak_hbm", "budget",
+                      "warnings"),
     # per-op per-batch spans: ``lane`` separates host wall-clock
     # (op_timed) from the device-sync wait (record_batch's fence)
     "op_span": ("op", "section", "start", "dur", "lane"),
@@ -89,6 +91,10 @@ EVENT_TYPES: Dict[str, tuple] = {
                       "codec"),
     # device scan-cache activity (io/scan_cache.py)
     "scan_cache": ("op", "bytes"),
+    # watchdog alerts (obs/watchdog.py): kind is stall / hbm_pressure /
+    # recompile_storm; the same rules replay offline via
+    # tools/tpu_profile.py --alerts
+    "alert": ("kind", "detail", "value", "threshold"),
 }
 
 
@@ -116,6 +122,26 @@ class EventLogger:
             # line-buffered so an offline reader sees every completed
             # event even if the process never calls close()
             self._fh = open(path, "a", buffering=1)
+            # teardown durability: a dying interpreter (SystemExit mid-
+            # query, a session nobody closed) must not strand a truncated
+            # final line — atexit flushes/closes the sink as a last
+            # resort. Registered through a WEAKREF so the hook never
+            # pins a dropped logger (a service churning short-lived
+            # sessions must not accumulate fds/ring buffers until exit:
+            # a collected logger's fh still closes via the io finalizer,
+            # as before); close() unregisters the hook entirely.
+            import atexit
+            import weakref
+
+            ref = weakref.ref(self)
+
+            def _atexit_close(_ref=ref):
+                logger = _ref()
+                if logger is not None:
+                    logger.close()
+
+            self._atexit_cb = _atexit_close
+            atexit.register(_atexit_close)
 
     def emit(self, etype: str, **fields: Any) -> None:
         if not self.enabled:
@@ -134,9 +160,20 @@ class EventLogger:
 
     def close(self) -> None:
         with self._lock:
-            if self._fh is not None:
-                self._fh.close()
-                self._fh = None
+            if self._fh is None:
+                return
+            self._fh.flush()
+            self._fh.close()
+            self._fh = None
+        cb = getattr(self, "_atexit_cb", None)
+        if cb is not None:
+            self._atexit_cb = None
+            import atexit
+
+            try:
+                atexit.unregister(cb)
+            except Exception:  # pragma: no cover - interpreter teardown
+                pass
 
 
 _FILE_SEQ = [0]
@@ -266,6 +303,10 @@ def chrome_trace(records: List[dict]) -> dict:
         elif ev == "scan_cache":
             out.append({"ph": "i", "pid": _PID, "tid": tid_of("scan_cache"),
                         "name": f"{r['op']}", "ts": us(ts), "s": "t"})
+        elif ev == "alert":
+            out.append({"ph": "i", "pid": _PID, "tid": tid_of("watchdog"),
+                        "name": f"{r['kind']}: {r.get('detail', '')}",
+                        "ts": us(ts), "s": "t"})
         # plan_tagged / plan_analysis / op_batch carry no timeline shape;
         # the offline profiler reads them from the JSONL log instead
     out.sort(key=lambda e: e["ts"])

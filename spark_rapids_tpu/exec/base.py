@@ -16,6 +16,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Sequence
 import jax
 
 from .. import events as _events
+from .. import obs as _obs
 from ..columnar import ColumnarBatch, DeviceColumn
 from ..conf import RapidsConf
 from ..expr.eval import ColV, DictV, StrV, Val
@@ -62,6 +63,10 @@ def note_compile_miss(site: str) -> None:
     # misses are rare (that's the point); the event names the site so the
     # offline profiler can attribute recompile storms without a rerun
     _events.emit("compile_miss", site=site, total=COMPILE_COUNTER.total)
+    if _obs.enabled():
+        # live twin: the registry's miss ring feeds the watchdog's
+        # recompile-storm window
+        _obs.note_compile_miss(site)
 
 
 def compile_miss_count() -> int:
@@ -84,11 +89,14 @@ def host_pull(tree):
     sanctioned way to read device values on the host outside this
     module; tools/tpu_lint.py flags raw jax.device_get/.item() sites."""
     out = jax.device_get(tree)
-    if _events.enabled():
+    if _events.enabled() or _obs.enabled():
         nb = sum(int(getattr(a, "nbytes", 0))
                  for a in jax.tree_util.tree_leaves(out))
         _events.emit("transfer", direction="d2h", bytes=nb,
                      site="host_pull")
+        if _obs.enabled():
+            _obs.inc("tpu_transfers", 1, direction="d2h")
+            _obs.inc("tpu_transfer_bytes", nb, direction="d2h")
     return out
 
 
@@ -100,6 +108,8 @@ def host_fence(arrays):
     if _events.enabled():
         _events.emit("transfer", direction="fence", bytes=0,
                      site="host_fence")
+    if _obs.enabled():
+        _obs.inc("tpu_transfers", 1, direction="fence")
     return out
 
 
@@ -182,6 +192,22 @@ def timed(metric: Optional[Metric], trace_name: str = "", trace: bool = False,
     if event_op is not None:
         _events.emit("op_span", op=event_op, section=event_section,
                      start=start, dur=dur, lane="host")
+
+
+@contextlib.contextmanager
+def _obs_timed(inner, op: str, section: str):
+    """op_timed's live-metrics wrapper (built ONLY while the obs plane is
+    on — the disabled fast path returns the plain timed() context): the
+    open-span table is what the watchdog samples for stall detection, so
+    registration must precede the body, not follow it."""
+    token = _obs.span_open(op, section)
+    start = time.perf_counter_ns()
+    try:
+        with inner:
+            yield
+    finally:
+        _obs.span_close(token)
+        _obs.add_op_time(op, "host", time.perf_counter_ns() - start)
 
 
 class TpuExec:
@@ -309,9 +335,14 @@ class TpuExec:
         name = self.node_name + ("." + section if section else "")
         # event args attach only while logging is on, so the disabled fast
         # path is byte-for-byte the pre-event-log behavior
-        return timed(self.metric(metric_name), name, self._trace,
-                     event_op=self.node_name if _events.enabled() else None,
-                     event_section=section)
+        ctx = timed(self.metric(metric_name), name, self._trace,
+                    event_op=self.node_name if _events.enabled() else None,
+                    event_section=section)
+        if _obs.enabled():
+            # live plane: per-op time counters + the open-span table the
+            # stall watchdog samples (wrapper only exists while obs is on)
+            return _obs_timed(ctx, self.node_name, section)
+        return ctx
 
     def record_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
         nr = batch.num_rows_lazy
@@ -324,6 +355,8 @@ class TpuExec:
             jax.block_until_ready(batch_arrays(batch))
             dt = time.perf_counter_ns() - t0
             self.metric(OP_TIME_DEVICE, "ns").add(dt)
+            if _obs.enabled():
+                _obs.add_op_time(self.node_name, "device", dt)
             if _events.enabled():
                 # the device lane: THIS op's isolated device wait (inputs
                 # were fenced by the child's record_batch under the
@@ -341,6 +374,11 @@ class TpuExec:
         if _events.enabled():
             _events.emit("op_batch", op=self.node_name,
                          rows=nr if isinstance(nr, int) else None, bytes=by)
+        if _obs.enabled():
+            # live counters + the per-query progress numerators /status
+            # divides into the analyzer's row/batch forecasts
+            _obs.note_op_batch(self.node_name,
+                               nr if isinstance(nr, int) else None, by)
         return batch
 
     def collect(self) -> List[tuple]:

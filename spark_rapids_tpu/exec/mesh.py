@@ -101,6 +101,13 @@ class _MeshStage(TpuExec):
         rows_per_shard = [
             sum(int(b.num_rows) for b in bs) for bs in per_shard
         ]
+        from .. import obs as _obs
+
+        if _obs.enabled():
+            # the per-chip lane of the live plane: how staging spread the
+            # input over the mesh (a skewed shard shows up immediately)
+            for s, r in enumerate(rows_per_shard):
+                _obs.inc("tpu_mesh_staged_rows", r, device=str(s))
         cap = bucket_rows(max(max(rows_per_shard), 1),
                           self.conf.shape_bucket_min)
         fields = schema.fields

@@ -146,6 +146,13 @@ def packed_upload(host_arrays: List[np.ndarray]):
     if _events.enabled():
         _events.emit("transfer", direction="h2d", bytes=int(pos),
                      site="packed_upload")
+    from .. import obs as _obs
+
+    if _obs.enabled():
+        # the dominant host-link direction: without it the live
+        # transfer counters would show only d2h/fence
+        _obs.inc("tpu_transfers", 1, direction="h2d")
+        _obs.inc("tpu_transfer_bytes", int(pos), direction="h2d")
 
     key = tuple(layout)
     fn = _UNPACK_CACHE.get(key)

@@ -21,6 +21,7 @@ from collections import OrderedDict
 from typing import Any, Dict, Optional, Tuple
 
 from .. import events as _events
+from .. import obs as _obs
 
 
 class DeviceScanCache:
@@ -62,6 +63,18 @@ class DeviceScanCache:
                 self.evictions += 1
                 if _events.enabled():
                     _events.emit("scan_cache", op="evict", bytes=sz)
+                if _obs.enabled():
+                    self._obs_note("evict", sz)
+
+    def _obs_note(self, op: str, nbytes: int) -> None:
+        """Mirror one cache op into the live registry (called under
+        self._lock; the registry lock is a leaf — no inversion)."""
+        _obs.inc("tpu_scan_cache_ops", 1, op=op)
+        if op in ("hit", "miss"):
+            seen = self.hits + self.misses
+            _obs.set_gauge("tpu_scan_cache_hit_ratio",
+                           self.hits / seen if seen else 0.0)
+        _obs.set_gauge("tpu_scan_cache_resident_bytes", self._bytes)
 
     def stats(self) -> Dict[str, int]:
         """Cache-effectiveness counters (previously unobservable): a hot
@@ -86,11 +99,15 @@ class DeviceScanCache:
                 self.misses += 1
                 if _events.enabled():
                     _events.emit("scan_cache", op="miss", bytes=0)
+                if _obs.enabled():
+                    self._obs_note("miss", 0)
                 return None
             self._entries.move_to_end(key)
             self.hits += 1
             if _events.enabled():
                 _events.emit("scan_cache", op="hit", bytes=hit[1])
+            if _obs.enabled():
+                self._obs_note("hit", hit[1])
             return hit[0]
 
     def put(self, key: tuple, value: Any, nbytes: int) -> None:
@@ -105,12 +122,16 @@ class DeviceScanCache:
             self._bytes += nbytes
             if _events.enabled():
                 _events.emit("scan_cache", op="put", bytes=nbytes)
+            if _obs.enabled():
+                self._obs_note("put", nbytes)
             while self._bytes > self.max_bytes and self._entries:
                 _, (_, sz) = self._entries.popitem(last=False)
                 self._bytes -= sz
                 self.evictions += 1
                 if _events.enabled():
                     _events.emit("scan_cache", op="evict", bytes=sz)
+                if _obs.enabled():
+                    self._obs_note("evict", sz)
 
     def invalidate_path(self, path: str) -> None:
         """Drop every entry of one file (the writers' commit protocol

@@ -21,6 +21,7 @@ import threading
 from typing import Dict, List, Optional
 
 from .. import events as _events
+from .. import obs as _obs
 from ..conf import (
     HBM_POOL_FRACTION,
     HBM_RESERVE,
@@ -126,6 +127,25 @@ class BufferCatalog:
     def _derive_budget(self) -> Optional[int]:
         return derive_hbm_budget(self.conf)
 
+    @property
+    def budget(self) -> Optional[int]:
+        """The live spill budget (None = unlimited) — read by the
+        watchdog's pressure rule and the /status HBM block so they can
+        never disagree with the spiller."""
+        return self._budget
+
+    def _obs_watermark(self) -> None:
+        """Mirror the device-byte watermark into the live registry (a
+        leaf-lock callee: safe under self._lock)."""
+        _obs.set_gauge("tpu_hbm_device_bytes", self._device_bytes)
+        _obs.set_gauge("tpu_hbm_peak_device_bytes",
+                       self.metrics.peak_device_bytes)
+        if self._budget is not None:
+            # keep the budget gauge tracking the LIVE catalog (a reset
+            # with new memory confs would otherwise leave the plane
+            # advertising the first session's stale derivation)
+            _obs.set_gauge("tpu_hbm_budget_bytes", self._budget)
+
     # -- registration ------------------------------------------------------
     def register(self, handle: "SpillableHandle") -> int:
         with self._lock:
@@ -138,6 +158,8 @@ class BufferCatalog:
             if self.conf.get(MEMORY_DEBUG):
                 log.info("register buffer %d (%d B, prio %d): device=%d B",
                          bid, handle.size, handle.priority, self._device_bytes)
+            if _obs.enabled():
+                self._obs_watermark()
         self.request(0)
         return bid
 
@@ -150,6 +172,8 @@ class BufferCatalog:
                 self._device_bytes -= h.size
             elif h.tier == TIER_HOST:
                 self._host_bytes -= h.size
+            if _obs.enabled():
+                self._obs_watermark()
 
     def on_unspill(self, h: "SpillableHandle", from_host: bool) -> None:
         with self._lock:
@@ -162,6 +186,10 @@ class BufferCatalog:
             if _events.enabled():
                 _events.emit("spill", kind="unspill", bytes=h.size,
                              device_bytes=self._device_bytes)
+            if _obs.enabled():
+                _obs.inc("tpu_spills", 1, kind="unspill")
+                _obs.inc("tpu_spill_bytes", h.size, kind="unspill")
+                self._obs_watermark()
         # the just-materialized buffer is the one in use: spill OTHERS to
         # make room (the reference pins via addReference during access)
         self.request(0, exclude=h)
@@ -199,6 +227,11 @@ class BufferCatalog:
                         _events.emit("spill", kind="device_to_host",
                                      bytes=freed,
                                      device_bytes=self._device_bytes)
+                    if _obs.enabled():
+                        _obs.inc("tpu_spills", 1, kind="device_to_host")
+                        _obs.inc("tpu_spill_bytes", freed,
+                                 kind="device_to_host")
+                        self._obs_watermark()
                 need -= freed
                 if self.conf.get(MEMORY_DEBUG):
                     log.info("spilled %d B to host (device=%d B)",
@@ -228,6 +261,10 @@ class BufferCatalog:
                         _events.emit("spill", kind="host_to_disk",
                                      bytes=freed,
                                      device_bytes=self._device_bytes)
+                    if _obs.enabled():
+                        _obs.inc("tpu_spills", 1, kind="host_to_disk")
+                        _obs.inc("tpu_spill_bytes", freed,
+                                 kind="host_to_disk")
 
     def _disk_dir(self) -> str:
         if self._spill_dir is None:

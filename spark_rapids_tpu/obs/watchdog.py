@@ -1,0 +1,294 @@
+"""Stall / pressure / recompile-storm watchdog over the live registry.
+
+Reference analog: the driver-side monitoring operators bolt onto the
+Spark UI (stuck-task speculation signals, memory alerts) — here a small
+sampler raises TYPED alerts from the observability plane:
+
+  * **stall** — an operator span has been OPEN longer than
+    ``watchdog.stallThresholdMs`` (a hung device dispatch, a wedged
+    host decode, a deadlocked semaphore);
+  * **hbm_pressure** — the BufferCatalog device-byte watermark is above
+    ``watchdog.hbmPressureFraction`` of the shared budget
+    (derive_hbm_budget — the SAME derivation the spiller and the plan
+    analyzer use, so all three agree on what "full" means);
+  * **recompile_storm** — at least ``sql.analysis.recompileStorm
+    .threshold`` compile misses hit ONE site within
+    ``watchdog.recompileStorm.windowMs`` (the LIVE twin of the
+    analyzer's static storm forecast and the profiler's post-hoc
+    footer).
+
+Every alert is surfaced three ways: a ``log.warning``, an ``alert``
+event in the PR-5 event log (so offline traces show when the watchdog
+fired), and the ``alerts`` list in ``/status``. An alert key stays
+ACTIVE while its condition holds — one alert per episode, not one per
+sample tick.
+
+:func:`replay_alerts` runs the same rules over a recorded event log
+(``tools/tpu_profile.py --alerts``) so thresholds can be tuned from
+production recordings without re-running anything.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import events as _events
+from .registry import MetricsRegistry
+
+log = logging.getLogger("spark_rapids_tpu.obs")
+
+STALL = "stall"
+HBM_PRESSURE = "hbm_pressure"
+RECOMPILE_STORM = "recompile_storm"
+
+
+def _default_storm_threshold() -> int:
+    # ONE home for the storm count: the conf entry's declared default
+    # (tests pin tools/tpu_profile.py's CLI default to the same value)
+    from ..conf import ANALYSIS_STORM_THRESHOLD
+
+    return ANALYSIS_STORM_THRESHOLD.default
+
+
+@dataclasses.dataclass(frozen=True)
+class WatchdogRules:
+    """Thresholds shared by the live sampler and the offline replay."""
+
+    stall_ns: int = 30_000 * 1_000_000
+    pressure_fraction: float = 0.85
+    storm_threshold: int = dataclasses.field(
+        default_factory=_default_storm_threshold)
+    storm_window_ns: int = 10_000 * 1_000_000
+
+    @classmethod
+    def from_conf(cls, conf_) -> "WatchdogRules":
+        from ..conf import (
+            ANALYSIS_STORM_THRESHOLD,
+            WATCHDOG_PRESSURE_FRACTION,
+            WATCHDOG_STALL_MS,
+            WATCHDOG_STORM_WINDOW_MS,
+        )
+
+        return cls(
+            stall_ns=int(conf_.get(WATCHDOG_STALL_MS)) * 1_000_000,
+            pressure_fraction=conf_.get(WATCHDOG_PRESSURE_FRACTION),
+            # ONE storm definition engine-wide: the live window reuses the
+            # static analyzer's per-site signature threshold
+            storm_threshold=conf_.get(ANALYSIS_STORM_THRESHOLD),
+            storm_window_ns=int(
+                conf_.get(WATCHDOG_STORM_WINDOW_MS)) * 1_000_000,
+        )
+
+
+@dataclasses.dataclass
+class Alert:
+    kind: str        # stall | hbm_pressure | recompile_storm
+    detail: str      # what tripped (op name, site, watermark source)
+    value: float     # the measured quantity (ns, bytes, miss count)
+    threshold: float  # the rule it crossed
+    ts: int          # perf_counter_ns at detection
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def describe(self) -> str:
+        if self.kind == STALL:
+            return (f"stall: {self.detail} span open "
+                    f"{self.value / 1e9:.1f}s "
+                    f"(threshold {self.threshold / 1e9:.1f}s)")
+        if self.kind == HBM_PRESSURE:
+            return (f"hbm_pressure: {self.detail} at "
+                    f"{self.value / 1e6:.1f}MB, over "
+                    f"{self.threshold / 1e6:.1f}MB")
+        return (f"recompile_storm: site {self.detail} compiled "
+                f"{self.value:g} times in window "
+                f"(threshold {self.threshold:g})")
+
+
+class Watchdog:
+    """Samples the registry (and the BufferCatalog) on an interval.
+
+    ``check_now()`` is the deterministic single-tick entry point the
+    tests (and the optional background thread) drive; it returns only
+    NEWLY raised alerts. The same condition re-alerts only after it
+    clears — a 60s stall is one alert, not sixty."""
+
+    def __init__(self, registry: MetricsRegistry, rules: WatchdogRules,
+                 interval_s: float = 1.0,
+                 budget: Optional[int] = None,
+                 conf_budget: Optional[int] = None, history: int = 64):
+        self.registry = registry
+        self.rules = rules
+        self.interval_s = interval_s
+        self._budget = budget  # hard override (tests / tooling)
+        # fallback when the LIVE catalog has no budget (e.g. it was
+        # lazily created under a default conf while the session that
+        # enabled the watchdog set memory.hbm.budgetBytes) — without it
+        # the pressure rule would silently never fire in that setup
+        self._conf_budget = conf_budget
+        self._alerts: deque = deque(maxlen=history)
+        self._active: Set[tuple] = set()
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- one sample tick ---------------------------------------------------
+    def check_now(self, now_ns: Optional[int] = None) -> List[Alert]:
+        now = now_ns if now_ns is not None else time.perf_counter_ns()
+        found: Dict[tuple, Alert] = {}
+
+        # stalls: operator spans still open past the threshold
+        for op, section, start in self.registry.open_spans():
+            age = now - start
+            if age >= self.rules.stall_ns:
+                name = op + ("." + section if section else "")
+                found[(STALL, op, start)] = Alert(
+                    STALL, name, age, self.rules.stall_ns, now)
+
+        # HBM pressure: live watermark vs the shared budget
+        from ..memory.catalog import BufferCatalog
+
+        cat = BufferCatalog.get()
+        # precedence: explicit override, then the budget the SPILLER
+        # actually enforces (the catalog's), then the watchdog conf's
+        # own derivation as a last resort
+        budget = self._budget
+        if budget is None:
+            budget = cat.budget if cat.budget else self._conf_budget
+        if budget:
+            limit = self.rules.pressure_fraction * budget
+            dev = cat.device_bytes
+            if dev >= limit:
+                found[(HBM_PRESSURE,)] = Alert(
+                    HBM_PRESSURE, "BufferCatalog device watermark",
+                    dev, limit, now)
+
+        # live recompile storm: misses per site inside the window
+        lo = now - self.rules.storm_window_ns
+        per_site: Dict[str, int] = {}
+        for ts, site in self.registry.recent_compile_misses():
+            if ts >= lo:
+                per_site[site] = per_site.get(site, 0) + 1
+        for site, n in per_site.items():
+            if n >= self.rules.storm_threshold:
+                found[(RECOMPILE_STORM, site)] = Alert(
+                    RECOMPILE_STORM, site, n,
+                    self.rules.storm_threshold, now)
+
+        new: List[Alert] = []
+        with self._lock:
+            for key, alert in found.items():
+                if key not in self._active:
+                    self._active.add(key)
+                    self._alerts.append(alert)
+                    new.append(alert)
+            # conditions that cleared may fire again as a fresh episode
+            self._active &= set(found)
+        for alert in new:
+            log.warning("watchdog %s", alert.describe())
+            self.registry.inc("tpu_watchdog_alerts", 1, kind=alert.kind)
+            if _events.enabled():
+                _events.emit("alert", kind=alert.kind, detail=alert.detail,
+                             value=alert.value, threshold=alert.threshold)
+        return new
+
+    def alerts(self) -> List[Alert]:
+        with self._lock:
+            return list(self._alerts)
+
+    # -- background thread -------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def run():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.check_now()
+                except Exception:  # pragma: no cover - never kill the host
+                    log.exception("watchdog tick failed")
+
+        self._thread = threading.Thread(
+            target=run, name="srtpu-watchdog", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# Offline replay: the same rules over a PR-5 event log, so thresholds are
+# tuned against recordings (tools/tpu_profile.py --alerts).
+# ---------------------------------------------------------------------------
+def replay_alerts(events: List[dict], rules: WatchdogRules,
+                  budget: Optional[int] = None) -> List[Alert]:
+    """Alerts the watchdog WOULD have raised over a recorded run.
+
+    Mapping from the live sampler (which sees open spans / live
+    watermarks) to the log (which records closed spans / spill events):
+
+      * stall            — any ``op_span`` whose dur >= stall_ns (the
+                           span was necessarily open that long);
+      * hbm_pressure     — any ``spill``/``unspill`` whose live
+                           ``device_bytes`` watermark crossed the
+                           pressure line (budget from the log's
+                           ``plan_analysis`` events unless overridden);
+      * recompile_storm  — per-site sliding window over
+                           ``compile_miss`` events; one alert per
+                           episode (the count must drop below the
+                           threshold before the same site alerts again).
+    """
+    out: List[Alert] = []
+    site_win: Dict[str, deque] = {}
+    site_storming: Dict[str, bool] = {}
+    pressure_active = False
+    for r in events:
+        ev = r.get("event")
+        ts = r.get("ts", 0)
+        if ev == "plan_analysis" and budget is None:
+            budget = r.get("budget")
+        elif ev == "op_span":
+            # host lane only, matching the live sampler (which watches
+            # op_timed's open-span table): a deviceSync log carries a
+            # device-wait twin of the same episode — counting both would
+            # replay one live stall as two alerts
+            if r.get("lane", "host") != "host":
+                continue
+            dur = r.get("dur") or 0
+            if dur >= rules.stall_ns:
+                name = r.get("op", "?") + (
+                    "." + r["section"] if r.get("section") else "")
+                out.append(Alert(STALL, name, dur, rules.stall_ns, ts))
+        elif ev == "spill" and budget:
+            limit = rules.pressure_fraction * budget
+            dev = r.get("device_bytes") or 0
+            if dev >= limit and not pressure_active:
+                out.append(Alert(
+                    HBM_PRESSURE, "BufferCatalog device watermark",
+                    dev, limit, ts))
+            pressure_active = dev >= limit
+        elif ev == "compile_miss":
+            site = r.get("site", "?")
+            win = site_win.setdefault(site, deque())
+            win.append(ts)
+            lo = ts - rules.storm_window_ns
+            while win and win[0] < lo:
+                win.popleft()
+            if len(win) >= rules.storm_threshold:
+                if not site_storming.get(site):
+                    out.append(Alert(
+                        RECOMPILE_STORM, site, len(win),
+                        rules.storm_threshold, ts))
+                site_storming[site] = True
+            else:
+                site_storming[site] = False
+    return out

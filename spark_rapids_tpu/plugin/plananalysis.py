@@ -352,6 +352,11 @@ class OpReport:
     exact: bool
     notes: List[str]
     children: List["OpReport"]
+    # the live-progress denominators (obs/progress.py): forecast output
+    # rows / batch count when statically known, set centrally by
+    # _Analyzer.analyze from the handler's batch states
+    out_rows: Optional[int] = None
+    out_batches: Optional[int] = None
 
     def lines(self, indent: int = 0) -> List[str]:
         pad = "  " * indent
@@ -394,6 +399,11 @@ class PlanAnalysis:
     budget: Optional[int]
     warnings: List[str]
     elided_columns: int
+    # forecast output rows / batch counts per exec name where statically
+    # known — the denominators the live progress plane (/status) divides
+    # record_batch's numerators into
+    rows_by_op: Dict[str, int] = dataclasses.field(default_factory=dict)
+    batches_by_op: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     def render_lines(self) -> List[str]:
         lines = ["== Static Plan Analysis =="]
@@ -434,6 +444,8 @@ class PlanAnalysis:
         return {"bounded": self.bounded,
                 "site_forecast": dict(self.site_forecast),
                 "bytes_by_op": dict(self.bytes_by_op),
+                "rows_by_op": dict(self.rows_by_op),
+                "batches_by_op": dict(self.batches_by_op),
                 "peak_hbm": self.peak_hbm, "budget": self.budget,
                 "warnings": list(self.warnings)}
 
@@ -566,11 +578,17 @@ class _Analyzer:
             C.CpuExpandExec: self._expand,
         }
         h = handlers.get(type(node))
-        if h is None:
-            return self._structural(node)
-        r = h(node)
+        r = self._structural(node) if h is None else h(node)
         if not r.exact:
             self.exact_all = False
+        if r.parts is not None:
+            # progress denominators: batch count is known whenever the
+            # shapes are; rows only when every batch's logical count is
+            # (a filter's post-predicate rows are not)
+            batches = [b for p in r.parts for b in p]
+            r.report.out_batches = len(batches)
+            if all(b.rows is not None for b in batches):
+                r.report.out_rows = sum(b.rows for b in batches)
         return r
 
     def _structural(self, node: C.CpuExec) -> _Result:
@@ -1241,12 +1259,19 @@ def analyze_plan(cpu_plan: C.CpuExec, conf: RapidsConf,
     # aggregate per-site and per-exec-name forecasts over the report tree
     site_forecast: Dict[str, int] = {}
     bytes_by_op: Dict[str, int] = {}
+    rows_by_op: Dict[str, int] = {}
+    batches_by_op: Dict[str, int] = {}
 
     def walk(r: OpReport):
         for k, v in r.sites.items():
             site_forecast[k] = site_forecast.get(k, 0) + v
         if r.out_bytes is not None:
             bytes_by_op[r.name] = bytes_by_op.get(r.name, 0) + r.out_bytes
+        if r.out_rows is not None:
+            rows_by_op[r.name] = rows_by_op.get(r.name, 0) + r.out_rows
+        if r.out_batches is not None:
+            batches_by_op[r.name] = (
+                batches_by_op.get(r.name, 0) + r.out_batches)
         for c in r.children:
             walk(c)
 
@@ -1287,6 +1312,8 @@ def analyze_plan(cpu_plan: C.CpuExec, conf: RapidsConf,
         budget=budget,
         warnings=warnings,
         elided_columns=an.elided,
+        rows_by_op=rows_by_op,
+        batches_by_op=batches_by_op,
     )
 
 

@@ -19,6 +19,7 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import events as _events
+from .. import obs as _obs
 from .. import types as T
 from ..expr.eval import Val
 
@@ -93,6 +94,11 @@ class DeviceShuffleTransport(ShuffleTransport):
             _events.emit("shuffle_write", shuffle_id=shuffle_id,
                          map_id=map_id, reduce_id=reduce_id, rows=piece.n,
                          bytes=sv.size_bytes, codec=self.codec)
+        if _obs.enabled():
+            _obs.inc("tpu_shuffle_pieces", 1, direction="write",
+                     codec=self.codec)
+            _obs.inc("tpu_shuffle_bytes", sv.size_bytes, direction="write",
+                     codec=self.codec)
 
     def fetch(self, shuffle_id, reduce_id):
         with self._lock:
@@ -112,6 +118,11 @@ class DeviceShuffleTransport(ShuffleTransport):
                          reduce_id=reduce_id, pieces=len(out),
                          rows=sum(p.n for p in out), bytes=nb,
                          codec=self.codec)
+        if _obs.enabled():
+            _obs.inc("tpu_shuffle_pieces", len(out), direction="fetch",
+                     codec=self.codec)
+            _obs.inc("tpu_shuffle_bytes", nb, direction="fetch",
+                     codec=self.codec)
         return out
 
     def bytes_written(self):
@@ -161,6 +172,12 @@ class SerializingTransportBase(ShuffleTransport):
             _events.emit("shuffle_write", shuffle_id=shuffle_id,
                          map_id=map_id, reduce_id=reduce_id, rows=piece.n,
                          bytes=len(data), codec=self.codec)
+        if _obs.enabled():
+            _obs.inc("tpu_shuffle_pieces", 1, direction="write",
+                     codec=self.codec)
+            _obs.inc("tpu_shuffle_bytes", len(data), direction="write",
+                     codec=self.codec)
+            _obs.inc("tpu_shuffle_codec_seconds", enc / 1e9, op="encode")
         return data
 
     def _decode_entries(self, entries: Sequence[Tuple[int, bytes]],
@@ -192,6 +209,12 @@ class SerializingTransportBase(ShuffleTransport):
                          reduce_id=reduce_id, pieces=len(out),
                          rows=sum(p.n for p in out), bytes=nb,
                          codec=self.codec)
+        if _obs.enabled():
+            _obs.inc("tpu_shuffle_pieces", len(out), direction="fetch",
+                     codec=self.codec)
+            _obs.inc("tpu_shuffle_bytes", nb, direction="fetch",
+                     codec=self.codec)
+            _obs.inc("tpu_shuffle_codec_seconds", dec / 1e9, op="decode")
         return out
 
     def bytes_written(self):

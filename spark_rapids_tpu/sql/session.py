@@ -12,6 +12,7 @@ import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from .. import events as _events  # registers the eventLog.* conf entries
+from .. import obs as _obs
 from ..conf import RapidsConf
 from ..cpu import plan as C
 from ..memory import catalog as _catalog  # noqa: F401 — registers the
@@ -223,6 +224,29 @@ class TpuSession:
         self.events = _events.EventLogger(self.conf)
         self._query_seq = 0
         self._active_query: Optional[int] = None
+        self._pending_obs: Optional[tuple] = None
+        # the live observability plane (obs/): registry + conf-gated
+        # /metrics + /status exporter thread + watchdog. ensure_started
+        # is a no-op returning None with the confs off (the default) —
+        # no registry, no threads, one boolean per emit site.
+        self._obs_plane = _obs.ensure_started(self.conf)
+
+    def close(self) -> None:
+        """Flush/close the session's event sink (atexit also covers a
+        forgotten close) and detach it from the process-global emit
+        path. The obs plane is process-wide and stays up for other
+        sessions; stop it explicitly with obs.shutdown()."""
+        if _events._ACTIVE is self.events:
+            _events.uninstall()
+        self.events.close()
+
+    @property
+    def obs_address(self) -> Optional[str]:
+        """Base URL of the live metrics exporter (None unless
+        spark.rapids.tpu.metrics.http.enabled): <url>/metrics is the
+        Prometheus scrape target, <url>/status feeds tools/tpu_top.py."""
+        return (self._obs_plane.address
+                if self._obs_plane is not None else None)
 
     @property
     def last_explain(self) -> str:
@@ -263,18 +287,22 @@ class TpuSession:
         self.last_cpu_plan = cpu
         from ..conf import ANALYSIS_CROSS_CHECK, ANALYSIS_ENABLED, SQL_ENABLED
 
+        obs_on = _obs.enabled()
         run_analysis = self.conf.get(SQL_ENABLED) and (
             self.conf.get(ANALYSIS_CROSS_CHECK)
             # with event logging on, the analyzer's forecasts ride in the
             # log so tpu_profile's forecast-vs-actual report has its
-            # bounds without a separate explain() run
-            or (self.events.enabled and self.conf.get(ANALYSIS_ENABLED)))
+            # bounds without a separate explain() run; the live plane
+            # needs them too — /status progress denominators
+            or ((self.events.enabled or obs_on)
+                and self.conf.get(ANALYSIS_ENABLED)))
+        analysis = None
         if run_analysis:
             # the static analyzer runs BEFORE conversion/execution — it
             # must never touch the device (plugin/plananalysis.py)
             from ..plugin.plananalysis import analyze_plan
 
-            self.last_analysis = analyze_plan(cpu, self.conf)
+            analysis = self.last_analysis = analyze_plan(cpu, self.conf)
         final, is_tpu = self.overrides.apply(cpu)
         if is_tpu:
             final = ColumnarToRowExec(self.conf, final)
@@ -282,12 +310,52 @@ class TpuSession:
         # snapshot BEFORE execution so explain_metrics reports only the
         # misses THIS plan's run compiled (the counter is process-global)
         self._compile_baseline = compile_snapshot()
-        if self.events.enabled:
-            self._emit_query_events(node, cpu, is_tpu)
+        if self.events.enabled or obs_on:
+            import hashlib
+
+            self._query_seq += 1
+            qid = self._active_query = self._query_seq
+            digest = hashlib.sha1(
+                cpu.tree_string().encode()).hexdigest()[:12]
+            if self.events.enabled:
+                self._emit_query_events(node, qid, digest, is_tpu)
+            if obs_on:
+                # progress registration is DEFERRED to the drain paths
+                # (_run_collect / the writer generator) whose finally
+                # guarantees a matching note_query_end — a direct
+                # _execute consumer (ml/columnar_rdd, bench device
+                # timing) must not strand a forever-"running" query in
+                # /status. THIS query's analysis only — last_analysis
+                # may hold a previous query's when the analyzer was
+                # skipped here.
+                self._pending_obs = (
+                    qid, digest,
+                    analysis.rows_by_op if analysis is not None else None,
+                    analysis.batches_by_op
+                    if analysis is not None else None)
         return final
 
+    def _obs_take_pending(self) -> Optional[tuple]:
+        """Claim the deferred progress registration for one drain path.
+        Callers take it EAGERLY (right after _execute) — the slot is
+        shared per session, so a later query must not be able to
+        overwrite a writer's registration before its sink drains."""
+        pending = self._pending_obs
+        self._pending_obs = None
+        return pending
+
+    @staticmethod
+    def _obs_begin(pending: Optional[tuple]) -> Optional[int]:
+        """Activate a claimed registration on the DRAINING thread
+        (attribution is by thread); returns the qid to close."""
+        if pending is None or not _obs.enabled():
+            return None
+        qid, digest, rows_by_op, batches_by_op = pending
+        _obs.note_query_start(qid, digest, rows_by_op, batches_by_op)
+        return qid
+
     # -- event log ---------------------------------------------------------
-    def _emit_query_events(self, node: LNode, cpu: C.CpuExec,
+    def _emit_query_events(self, node: LNode, qid: int, plan_digest: str,
                            is_tpu: bool) -> None:
         """query_start + plan_tagged + plan_analysis for one execution.
         The session's logger becomes the process-wide active sink, so
@@ -296,16 +364,9 @@ class TpuSession:
         import hashlib
 
         _events.install(self.events)
-        self._query_seq += 1
-        qid = self._query_seq
-        self._active_query = qid
-
-        def digest(s: str) -> str:
-            return hashlib.sha1(s.encode()).hexdigest()[:12]
-
-        _events.emit("query_start", query_id=qid,
-                     plan_digest=digest(cpu.tree_string()),
-                     sql_hash=digest(repr(node)))
+        _events.emit("query_start", query_id=qid, plan_digest=plan_digest,
+                     sql_hash=hashlib.sha1(
+                         repr(node).encode()).hexdigest()[:12])
         meta = self.overrides.last_meta
         if meta is not None:
             fallbacks = []
@@ -334,6 +395,7 @@ class TpuSession:
         import time as _time
 
         t0 = _time.perf_counter_ns()
+        obs_qid = self._obs_begin(self._obs_take_pending())
         rows: Optional[List[tuple]] = None
         try:
             rows = final.collect()
@@ -343,6 +405,11 @@ class TpuSession:
                 _events.emit(
                     "query_end", query_id=self._active_query,
                     dur=_time.perf_counter_ns() - t0,
+                    rows=len(rows) if rows is not None else None,
+                    error=rows is None)
+            if obs_qid is not None:
+                _obs.note_query_end(
+                    obs_qid,
                     rows=len(rows) if rows is not None else None,
                     error=rows is None)
 
@@ -431,13 +498,19 @@ class DataFrameWriter:
         final = sess._execute(df.node)
         schema = final.output_schema
         # capture NOW: by the time the generator drains, another query on
-        # this session may have replaced _active_query
+        # this session may have replaced _active_query (and, same race,
+        # overwritten the shared _pending_obs slot)
         qid = sess._active_query
+        obs_pending = sess._obs_take_pending()
 
         def gen():
             import time as _time
 
             t0 = _time.perf_counter_ns()
+            # activated here, on the draining thread, so note_batch
+            # attribution lands on this query (and the finally below
+            # guarantees the matching end)
+            obs_qid = sess._obs_begin(obs_pending)
             ok = False
             try:
                 if isinstance(final, ColumnarToRowExec):
@@ -466,6 +539,8 @@ class DataFrameWriter:
                     _events.emit("query_end", query_id=qid,
                                  dur=_time.perf_counter_ns() - t0,
                                  rows=None, error=not ok)
+                if obs_qid is not None:
+                    _obs.note_query_end(obs_qid, rows=None, error=not ok)
 
         return gen(), schema
 

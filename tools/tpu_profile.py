@@ -28,9 +28,17 @@ time and bytes) or two bench JSON result files (``BENCH_*.json`` — the
 ``per_shape`` block's tpu_ms/device_ms per shape). Regressions beyond
 ``--threshold`` (default 20%) are flagged and make the exit code nonzero.
 
+Alert replay (``--alerts``): run the LIVE watchdog's rules
+(obs/watchdog.py — stall, hbm_pressure, recompile_storm) over a recorded
+log, so thresholds are tuned against production recordings instead of
+guesses: lower ``--stall-ms`` until the known-slow op fires, check the
+pressure fraction against a run that actually spilled. The HBM budget
+comes from the log's plan_analysis events unless ``--budget`` overrides.
+
 Usage:
   python tools/tpu_profile.py LOG.jsonl [LOG2.jsonl ...] [--top N]
   python tools/tpu_profile.py --diff OLD NEW [--threshold 0.2]
+  python tools/tpu_profile.py LOG.jsonl --alerts [--stall-ms 30000]
 """
 from __future__ import annotations
 
@@ -329,6 +337,37 @@ def build_report(events: List[dict], top_n: int = 10,
 
 
 # ---------------------------------------------------------------------------
+# alert replay (--alerts): the live watchdog's rules over a recorded log
+# ---------------------------------------------------------------------------
+def run_alerts(events: List[dict], stall_ms: int, pressure_fraction: float,
+               storm_threshold: int, storm_window_ms: int,
+               budget: Optional[int]) -> Tuple[str, int]:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from spark_rapids_tpu.obs.watchdog import WatchdogRules, replay_alerts
+
+    rules = WatchdogRules(
+        stall_ns=stall_ms * 1_000_000,
+        pressure_fraction=pressure_fraction,
+        storm_threshold=storm_threshold,
+        storm_window_ns=storm_window_ms * 1_000_000,
+    )
+    alerts = replay_alerts(events, rules, budget=budget)
+    base = events[0].get("ts", 0) if events else 0
+    lines = ["== watchdog alert replay =="]
+    lines.append(
+        f"  rules: stall>={stall_ms}ms, "
+        f"pressure>={pressure_fraction:.2f}x budget, "
+        f"storm>={storm_threshold} misses/{storm_window_ms}ms")
+    if not alerts:
+        lines.append("  no alerts at these thresholds")
+    for a in alerts:
+        lines.append(f"  +{(a.ts - base) / 1e6:.1f}ms {a.describe()}")
+    lines.append(f"  {len(alerts)} alert(s)")
+    return "\n".join(lines), len(alerts)
+
+
+# ---------------------------------------------------------------------------
 # diff mode
 # ---------------------------------------------------------------------------
 def diff_bench(old: dict, new: dict, threshold: float
@@ -429,7 +468,35 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--storm-threshold", type=int,
                     default=DEFAULT_STORM_THRESHOLD,
                     help="compile misses per site that flag a storm")
+    ap.add_argument("--alerts", action="store_true",
+                    help="replay the live watchdog rules over the log(s) "
+                         "to tune thresholds offline (obs/watchdog.py)")
+    ap.add_argument("--stall-ms", type=int, default=30000,
+                    help="--alerts: op span duration that counts as a "
+                         "stall")
+    ap.add_argument("--pressure-fraction", type=float, default=0.85,
+                    help="--alerts: HBM watermark fraction of the budget "
+                         "that counts as pressure")
+    ap.add_argument("--storm-window-ms", type=int, default=10000,
+                    help="--alerts: sliding window for the per-site "
+                         "compile-miss storm (count: --storm-threshold)")
+    ap.add_argument("--budget", type=int, default=None,
+                    help="--alerts: HBM budget bytes override (default: "
+                         "the log's plan_analysis budget)")
     args = ap.parse_args(argv)
+
+    if args.alerts:
+        events = load_events(args.paths)
+        if not events:
+            print("no events found", file=sys.stderr)
+            return 1
+        text, _n = run_alerts(
+            events, args.stall_ms, args.pressure_fraction,
+            args.storm_threshold, args.storm_window_ms, args.budget)
+        print(text)
+        # a threshold-tuning tool, not a gate: alerts are the point, so
+        # finding some is success (exit 0)
+        return 0
 
     if args.diff:
         if len(args.paths) != 2:

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""The engine's htop: a refresh-loop terminal view over /status.
+
+Point it at a session started with
+``spark.rapids.tpu.metrics.http.enabled`` (the session prints its
+address via ``TpuSession.obs_address``) and it renders, once per
+interval:
+
+  * live + recent queries with per-op progress bars — numerators from
+    record_batch, denominators from the static plan analyzer's row/batch
+    forecasts (an unbounded op shows its counts without a bar);
+  * the HBM watermark vs the derived budget (the same derive_hbm_budget
+    the spiller and the plan analyzer use) and the spill story;
+  * watchdog alerts (stall / hbm_pressure / recompile_storm);
+  * a counter footer: compile misses, shuffle traffic, scan-cache hit
+    rate, host-link transfers.
+
+Usage:
+  python tools/tpu_top.py --url http://127.0.0.1:PORT [--interval 2]
+  python tools/tpu_top.py --url ... --once          # one frame, no clear
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+BAR_WIDTH = 24
+
+
+def fetch_status(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url.rstrip("/") + "/status",
+                                timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _bar(frac: Optional[float], width: int = BAR_WIDTH) -> str:
+    if frac is None:
+        return "·" * width + "   n/a"
+    frac = max(0.0, min(1.0, frac))
+    fill = int(round(frac * width))
+    return "#" * fill + "-" * (width - fill) + f" {frac * 100:5.1f}%"
+
+
+def _mb(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v / 1e6:.1f}MB"
+
+
+def _metric_total(metrics: dict, name: str, match: str = "") -> float:
+    """Sum one family's series, optionally filtered on a label substring."""
+    return sum(v for k, v in (metrics.get(name) or {}).items()
+               if match in k)
+
+
+def render_status(status: dict, clock: str = "") -> str:
+    """One frame of the display (pure function — tests feed canned
+    payloads; the loop only fetches and clears the screen)."""
+    lines: List[str] = []
+    live = status.get("queries_live", 0)
+    lines.append(f"tpu_top {clock}  queries live={live}")
+
+    hbm = status.get("hbm") or {}
+    budget = hbm.get("budget_bytes")
+    dev = hbm.get("device_bytes", 0)
+    frac = (dev / budget) if budget else None
+    lines.append(
+        f"HBM  [{_bar(frac)}]  {_mb(dev)} of "
+        f"{_mb(budget) if budget else 'unlimited'} "
+        f"(peak {_mb(hbm.get('peak_device_bytes', 0))}, "
+        f"spilled {_mb(hbm.get('spilled_bytes', 0))})")
+
+    alerts = status.get("alerts") or []
+    for a in alerts[-5:]:
+        lines.append(f"ALERT [{a.get('kind')}] {a.get('detail')} "
+                     f"value={a.get('value'):g} "
+                     f"threshold={a.get('threshold'):g}")
+
+    lines.append("")
+    queries = status.get("queries") or []
+    if not queries:
+        lines.append("no queries yet")
+    for q in queries:
+        state = q.get("state", "?")
+        mark = {"running": ">", "finished": " ", "failed": "!"}.get(
+            state, "?")
+        lines.append(
+            f"{mark} query {q.get('query_id')} [{state}] "
+            f"plan={q.get('plan_digest')} "
+            f"elapsed={q.get('elapsed_ms', 0):.0f}ms"
+            + (f" rows={q['rows_out']}"
+               if q.get("rows_out") is not None else ""))
+        for op in q.get("ops") or []:
+            rf = op.get("rows_forecast")
+            bf = op.get("batches_forecast")
+            # same fallback order as the progress fraction: a lazy row
+            # count (still a device scalar) shows its batch denominator
+            if rf and op.get("rows"):
+                detail = f"rows {op.get('rows', 0)}/{rf}"
+            elif bf:
+                detail = f"batches {op.get('batches', 0)}/{bf}"
+            else:
+                detail = (f"rows {op.get('rows', 0)} "
+                          f"batches {op.get('batches', 0)} (unbounded)")
+            lines.append(f"    {op.get('op', '?'):<24} "
+                         f"[{_bar(op.get('progress'))}] {detail}")
+
+    m = status.get("metrics") or {}
+    hits = _metric_total(m, "tpu_scan_cache_ops", "op=hit")
+    misses = _metric_total(m, "tpu_scan_cache_ops", "op=miss")
+    seen = hits + misses
+    lines.append("")
+    lines.append(
+        "compile misses: "
+        f"{_metric_total(m, 'tpu_compile_misses'):g}   "
+        "shuffle: "
+        f"{_mb(_metric_total(m, 'tpu_shuffle_bytes', 'direction=write'))} w"
+        f" / {_mb(_metric_total(m, 'tpu_shuffle_bytes', 'direction=fetch'))}"
+        " f   scan cache: "
+        + (f"{hits / seen * 100:.0f}% hit" if seen else "no activity")
+        + "   transfers: "
+        f"{_mb(_metric_total(m, 'tpu_transfer_bytes'))}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live terminal view over a spark_rapids_tpu /status "
+                    "endpoint (see module docstring)")
+    ap.add_argument("--url", required=True,
+                    help="exporter base URL (TpuSession.obs_address), "
+                         "e.g. http://127.0.0.1:9090")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no screen clearing)")
+    args = ap.parse_args(argv)
+
+    while True:
+        try:
+            status = fetch_status(args.url)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"cannot reach {args.url}: {e}", file=sys.stderr)
+            return 1
+        frame = render_status(status, clock=time.strftime("%H:%M:%S"))
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
