@@ -156,6 +156,25 @@ def _device_time(exec_, iters=4):
     return max((tn - t1) / (iters - 1), 1e-9)
 
 
+def _agg_strategy_of(exec_):
+    """The aggregation strategy the plan's aggregate exec(s) resolved at
+    execution (conf sql.agg.strategy; exec/aggregate.resolved_strategy) —
+    None for shapes without a grouped aggregate. Emitted per shape so a
+    BENCH diff shows not just THAT a shape regressed but which lowering
+    it was running."""
+    found = []
+
+    def walk(node):
+        c = getattr(node, "_strategy_choice", None)
+        if c is not None:
+            found.append(c[0])
+        for k in getattr(node, "children", ()):
+            walk(k)
+
+    walk(exec_)
+    return found[0] if found else None
+
+
 def _dev_stats(exec_, bytes_read, tpu_t):
     """Per-shape device_ms + HBM roofline block: ``bytes_read`` is what
     the query must stream from HBM at least once; wallclock includes the
@@ -173,7 +192,8 @@ def _dev_stats(exec_, bytes_read, tpu_t):
     out = {"hbm_gbps": round(gbps, 1),
            "hbm_frac": round(gbps / HBM_GBPS, 3),
            "device_ms": round(dev_t * 1e3, 3),
-           "predicted_hbm_bytes": predict_exec_hbm(exec_)}
+           "predicted_hbm_bytes": predict_exec_hbm(exec_),
+           "agg_strategy": _agg_strategy_of(exec_)}
     if dev_t >= 1e-4:
         dev_gbps = bytes_read / dev_t / 1e9
         out["hbm_gbps_device"] = round(dev_gbps, 1)
@@ -522,13 +542,15 @@ def main() -> None:
     # order-insensitive float aggregation, as the reference's own benchmark
     # runs enable (spark.rapids.sql.variableFloatAgg.enabled)
     conf_dict = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True}
+    bench_logger = None
     if args.event_log:
         # event-log the whole bench: the session-path shapes pick the dir
         # up from conf, the exec-direct shapes from the installed logger
         from spark_rapids_tpu import events as EV
 
         conf_dict["spark.rapids.tpu.eventLog.dir"] = args.event_log
-        EV.install(EV.EventLogger(RapidsConf(conf_dict)))
+        bench_logger = EV.EventLogger(RapidsConf(conf_dict))
+        EV.install(bench_logger)
     conf = RapidsConf(conf_dict)
 
     results = {}
@@ -551,6 +573,17 @@ def main() -> None:
             f"speedup={sp:.2f}x {extra or ''}",
             file=sys.stderr,
         )
+
+    if bench_logger is not None:
+        # keep the Perfetto trace as an artifact NEXT TO the JSONL log:
+        # "open the bench run with a trace on the agg and parquet shapes"
+        # is now one --event-log flag instead of a manual export ritual
+        from spark_rapids_tpu import events as EV
+
+        trace_path = os.path.join(
+            args.event_log, f"bench-trace-{os.getpid()}.json")
+        EV.export_chrome_trace(bench_logger.records(), trace_path)
+        print(f"perfetto trace: {trace_path}", file=sys.stderr)
 
     geomean = math.exp(sum(math.log(s) for s in results.values())
                        / len(results))

@@ -178,6 +178,20 @@ AGG_FUSED_PLAN = conf(
     "partials at capacity to stay sync-free, the right trade only over a "
     "high-latency device link; the CPU backend merges at real row counts "
     "instead).", valid_values=("AUTO", "ON", "OFF"))
+AGG_STRATEGY = conf(
+    "spark.rapids.tpu.sql.agg.strategy", "AUTO",
+    "Lowering strategy for grouped-aggregation reductions "
+    "(ops/bucket_reduce.py, ops/groupby.py). MATMUL prices sums/counts "
+    "as one-hot limb matmuls on the MXU over the hash-bucket tiers; "
+    "SCATTER uses native segment scatters over the same tiers; SORT "
+    "radix-sorts rows by the grouping keys and reduces each contiguous "
+    "segment as prefix-sum differences — sized to HBM bandwidth instead "
+    "of MXU flops or scatter latency. AUTO picks per plan from the "
+    "static layout (capacity, aggregated column count/widths, backend) "
+    "and records its choice — with the reason — in explain_metrics() and "
+    "the event log ('agg_strategy'), so a wrong prediction is visible in "
+    "tools/tpu_profile.py instead of only as wall-clock.",
+    valid_values=("AUTO", "MATMUL", "SCATTER", "SORT"))
 
 # ---------------------------------------------------------------------------
 # Memory (reference: RapidsConf.scala:200-340, GpuDeviceManager.scala:160-271)
@@ -291,6 +305,21 @@ STAGE_FUSION = conf(
     "Where dispatch is free (CPU backend) the separate decode program + "
     "HBM scan cache decode once and reuse, so AUTO prefers that.",
     valid_values=("AUTO", "ON", "OFF"))
+PARQUET_PIPELINE_MAX_IN_FLIGHT = conf(
+    "spark.rapids.tpu.sql.format.parquet.pipeline.maxInFlight", 8,
+    "Row groups the pipelined device-decode reader keeps in flight "
+    "(io/parquet_device.py): while row group N's staged transfer and "
+    "device unpack run, up to this many row groups (N included) are "
+    "host-decoding on the shared srtpu-pqdec pool, and within a row "
+    "group the first half of the column chunks to finish decoding "
+    "stages+uploads while the rest still decompress (double-buffered "
+    "staging). Bounds host memory at ~maxInFlight decoded row-group "
+    "payloads (ENCODED pages, typically 1-2 B/value); the default "
+    "matches the srtpu-pqdec pool width — measured 2.4x on a cold "
+    "16-row-group read vs 1 (the serial round-6 behavior, which this "
+    "setting restores). Reference analog: the coalescing multithreaded "
+    "reader's copy pipeline (GpuParquetScan.scala:880-900).",
+    check=_positive)
 PARQUET_DICT_STRINGS = conf(
     "spark.rapids.tpu.sql.format.parquet.dictStrings.enabled", True,
     "Keep dictionary-encoded BYTE_ARRAY columns ENCODED on the TPU "

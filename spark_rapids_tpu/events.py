@@ -91,6 +91,15 @@ EVENT_TYPES: Dict[str, tuple] = {
                       "codec"),
     # device scan-cache activity (io/scan_cache.py)
     "scan_cache": ("op", "bytes"),
+    # per-plan aggregation-strategy choice (exec/aggregate.py): the AUTO
+    # chooser's pick (or the forced conf value) with its cost-model
+    # reason — logged so tpu_profile can hold the chooser accountable
+    # against the measured op spans of the SAME run
+    "agg_strategy": ("op", "strategy", "reason", "cap"),
+    # pipelined parquet decode stages (io/parquet_device.py): host chunk
+    # decode, staged h2d upload, device unpack dispatch; ``dur`` is the
+    # stage's host wall-clock (ns) so the overlap is visible in Perfetto
+    "pq_pipeline": ("stage", "rg", "bytes", "dur"),
     # watchdog alerts (obs/watchdog.py): kind is stall / hbm_pressure /
     # recompile_storm; the same rules replay offline via
     # tools/tpu_profile.py --alerts
@@ -307,8 +316,18 @@ def chrome_trace(records: List[dict]) -> dict:
             out.append({"ph": "i", "pid": _PID, "tid": tid_of("watchdog"),
                         "name": f"{r['kind']}: {r.get('detail', '')}",
                         "ts": us(ts), "s": "t"})
-        # plan_tagged / plan_analysis / op_batch carry no timeline shape;
-        # the offline profiler reads them from the JSONL log instead
+        elif ev == "pq_pipeline":
+            # emitted at stage END with its duration: render the span so
+            # decode/upload overlap is visible as parallel tracks
+            out.append({"ph": "X", "pid": _PID,
+                        "tid": tid_of(f"pq {r['stage']}"),
+                        "name": f"{r['stage']} rg{r.get('rg')}",
+                        "ts": us(ts - (r.get("dur") or 0)),
+                        "dur": (r.get("dur") or 0) / 1e3,
+                        "args": {"bytes": r.get("bytes")}})
+        # plan_tagged / plan_analysis / op_batch / agg_strategy carry no
+        # timeline shape; the offline profiler reads them from the JSONL
+        # log instead
     out.sort(key=lambda e: e["ts"])
     return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
 

@@ -41,6 +41,105 @@ from .base import (
 _AGG_CACHE: dict = {}
 
 
+# ---------------------------------------------------------------------------
+# Aggregation strategy chooser (conf sql.agg.strategy). The cost model's
+# constants are CALIBRATED FROM THE r05 PROFILE, not chip peaks: the
+# profiled agg program ran the one-hot limb matmul at ~7e11 MAC/s (143 ms
+# for cap=2^26 x ~12 limbs x B=128, BENCH_r05 + tools/tpu_profile.py)
+# while touching HBM at 1.3% of roofline — far under MXU peak because the
+# one-hot compare-select feed, not the multiply, is the bottleneck. That
+# gap is exactly what makes a bandwidth-sized lowering competitive.
+# Re-check the constants on a TPU-backed round (axon tunnel down in r07).
+# ---------------------------------------------------------------------------
+#: measured effective one-hot limb-matmul throughput (MACs/s)
+_MATMUL_EFF_MACS = 7.2e11
+#: sustained streaming HBM bandwidth (v5e public 819 GB/s, derated)
+_HBM_EFF_BPS = 0.6 * 819e9
+#: near-serial TPU scatter cost per row (why min/max batch per family)
+_SCATTER_SEC_PER_ROW = 10e-9
+#: first hash tier (ops/groupby.py B0) — the optimistic common-case
+#: matmul price; wider key ranges escalate tiers and multiply it
+_FIRST_TIER_B = 128
+
+
+def choose_agg_strategy(
+    conf: RapidsConf,
+    cap: int,
+    update_ops: Sequence[str],
+    update_exprs: Sequence[Optional[E.Expression]],
+    key_dtypes: Sequence[T.DataType],
+    backend: Optional[str] = None,
+) -> Tuple[str, str]:
+    """Pick the grouped-aggregation lowering for ONE plan shape from its
+    STATIC layout — capacity bucket, aggregated column count/widths, key
+    widths — never from data (the choice must be a trace-time constant or
+    it would churn the compile cache). Returns ``(strategy, reason)``;
+    the reason rides into explain_metrics and the 'agg_strategy' event so
+    a wrong prediction is debuggable offline. AUTO resolves:
+
+      * CPU backend -> SCATTER (native segment scatters; both the
+        materialized one-hot and the bitonic sort lose there, measured in
+        round 1);
+      * otherwise the cheaper of MATMUL (cap x limbs x B MACs at the
+        measured effective rate) and SORT (bitonic radix-key sort passes
+        + one bandwidth pass per aggregated column), with the scatter
+        families that run under EITHER strategy (min/max/first/last,
+        exact float sums) cancelling out of the comparison.
+    """
+    from ..conf import AGG_STRATEGY, IMPROVED_FLOAT_OPS
+
+    mode = conf.get(AGG_STRATEGY)
+    if mode != "AUTO":
+        return mode, "forced by spark.rapids.tpu.sql.agg.strategy"
+    if backend is None:
+        backend = jax.default_backend()
+    if backend == "cpu":
+        return ("SCATTER",
+                "AUTO: CPU backend — native segment scatters beat both "
+                "the materialized one-hot and the bitonic sort")
+    approx = conf.get(IMPROVED_FLOAT_OPS)
+    n_int = n_cnt = n_fapprox = n_fexact = n_other = 0
+    for op, e in zip(update_ops, update_exprs):
+        floating = e is not None and getattr(e.dtype, "is_floating", False)
+        if op in ("count", "count_star"):
+            n_cnt += 1
+        elif op == "sum" and not floating:
+            n_int += 1
+            n_cnt += 1  # nullability count rides the same pass
+        elif op == "sum" and approx:
+            n_fapprox += 1
+            n_cnt += 1
+        elif op == "sum":
+            n_fexact += 1
+            n_cnt += 1
+        else:
+            n_other += 1  # min/max/first/last: scatter under either
+    limbs = 8 * n_int + n_cnt + 2 * n_fapprox
+    matmul_s = cap * limbs * _FIRST_TIER_B / _MATMUL_EFF_MACS
+    import math
+
+    lg = max(1, math.ceil(math.log2(max(2, cap))))
+    sort_passes = lg * (lg + 1) / 2  # bitonic compare-exchange rounds
+    from ..plugin.plananalysis import _storage_bytes
+
+    key_bytes = 0
+    for dt in key_dtypes:
+        try:
+            key_bytes += _storage_bytes(dt)
+        except Exception:  # strings etc: radix chunks, ~8B per pass
+            key_bytes += 8
+    key_bytes = key_bytes or 4
+    n_val_cols = n_int + n_fapprox + n_cnt
+    sort_s = (cap * (key_bytes + 4) * sort_passes
+              + cap * 8 * n_val_cols * 3) / _HBM_EFF_BPS
+    pick = "SORT" if sort_s < matmul_s else "MATMUL"
+    return (pick,
+            f"AUTO: est matmul {matmul_s * 1e3:.1f}ms "
+            f"({limbs} limbs x B={_FIRST_TIER_B}) vs sort "
+            f"{sort_s * 1e3:.1f}ms ({sort_passes:.0f} passes, "
+            f"{n_val_cols} col(s)) at cap={cap}")
+
+
 def _agg_pipeline(
     chain,  # fusable execs below this aggregate (fused into the update step)
     key_exprs: Tuple[E.Expression, ...],
@@ -54,19 +153,22 @@ def _agg_pipeline(
     sides: Sequence[tuple] = (),
     str_val_max_lens: Tuple[int, ...] = (),
     nonnull: Tuple[bool, ...] = (),
+    strategy: Optional[str] = None,
 ):
     """ONE fused program: child chain (filter/project/join probe...),
     key+input projection, groupby reduce — a whole query stage per
     dispatch. ``str_val_max_lens``: static byte bound per string-typed
     min/max input, in order (drives the rank sort's chunk count).
     ``nonnull``: the plan analyzer's validity-elision flags for the input
-    columns (ops/filter_gather.elide_validity)."""
+    columns (ops/filter_gather.elide_validity). ``strategy``: the
+    resolved aggregation lowering (part of the cache key — a strategy
+    flip is a different program)."""
     from .base import side_signature
 
     key = (
         tuple(e.fusion_key() for e in chain), key_exprs, key_dtypes,
         value_exprs, ops, sig, cap, str_max_lens, approx_float_sum,
-        side_signature(sides), str_val_max_lens, nonnull,
+        side_signature(sides), str_val_max_lens, nonnull, strategy,
     )
     fn = _AGG_CACHE.get(key)
     if fn is not None:
@@ -89,6 +191,7 @@ def _agg_pipeline(
                 keys, list(key_dtypes), vals, list(ops), live, str_max_lens,
                 approx_float_sum=approx_float_sum,
                 str_val_max_lens=str_val_max_lens,
+                strategy=strategy,
             )
         outs = groupby_ops.reduce_no_keys(
             vals, list(ops), live, str_val_max_lens=str_val_max_lens)
@@ -104,7 +207,8 @@ def _agg_pipeline(
 
 
 def _fused_agg_trace(key_exprs, key_dts, value_exprs, update_ops, merge_ops,
-                     eval_exprs, approx, bucket_min, chain_t):
+                     eval_exprs, approx, bucket_min, chain_t,
+                     strategy=None):
     """The shared in-trace core of BOTH fused aggregate programs (the
     scan→agg stage fusion and the whole-plan fusion): returns
     ``(update_batch, finish)`` closures. ``update_batch`` lowers one
@@ -119,7 +223,7 @@ def _fused_agg_trace(key_exprs, key_dts, value_exprs, update_ops, merge_ops,
         if key_exprs:
             k_, a_, nseg = groupby_ops.groupby_agg(
                 keys, list(key_dts), vals, list(ops_), live,
-                (), approx_float_sum=approx)
+                (), approx_float_sum=approx, strategy=strategy)
             return list(k_) + list(a_), nseg
         a_ = groupby_ops.reduce_no_keys(vals, list(ops_), live)
         return list(a_), jnp.int32(1)
@@ -282,6 +386,12 @@ class TpuHashAggregateExec(TpuExec):
 
         # the evaluate projection runs over [keys..., buffers...]
         self._buffer_schema = StructType(tuple(self._key_fields + self._buf_fields))
+        # aggregation strategy (conf sql.agg.strategy): resolved lazily
+        # per capacity bucket — the choice must see the real batch shape —
+        # and memoized so AUTO never flips mid-plan (the recompile guard
+        # in tests/test_metrics.py pins this)
+        self._strategy_by_cap: dict = {}
+        self._strategy_choice: Optional[Tuple[str, str]] = None
 
     @property
     def output_schema(self):
@@ -290,7 +400,36 @@ class TpuHashAggregateExec(TpuExec):
     def describe(self):
         keys = ", ".join(str(k) for k in self.group_exprs)
         aggs = ", ".join(a.resolved_name() for a in self.agg_exprs)
-        return f"TpuHashAggregateExec(mode={self.mode}, keys=[{keys}], aggs=[{aggs}])"
+        strat = (f", strategy={self._strategy_choice[0]}"
+                 if self._strategy_choice is not None else "")
+        return (f"TpuHashAggregateExec(mode={self.mode}, keys=[{keys}], "
+                f"aggs=[{aggs}]{strat})")
+
+    def resolved_strategy(self, cap: int) -> Optional[str]:
+        """Resolve (and memoize per capacity bucket) the aggregation
+        lowering for this plan. The choice lands in describe() — and thus
+        explain_metrics() — and emits ONE 'agg_strategy' event per
+        (exec, capacity), so tools/tpu_profile.py can hold the chooser
+        accountable against the measured op spans of the same log."""
+        if not self.group_exprs:
+            return None  # grand aggregates use the plain masked reduces
+        hit = self._strategy_by_cap.get(cap)
+        if hit is not None:
+            return hit
+        strategy, reason = choose_agg_strategy(
+            self.conf, cap, self._update_ops, self._update_exprs,
+            self._key_dtypes())
+        self._strategy_by_cap[cap] = strategy
+        self._strategy_choice = (strategy, reason)
+        from .. import events as _events
+        from .. import obs as _obs
+
+        if _events.enabled():
+            _events.emit("agg_strategy", op=self.node_name,
+                         strategy=strategy, reason=reason, cap=cap)
+        if _obs.enabled():
+            _obs.inc("tpu_agg_strategy", 1, strategy=strategy)
+        return strategy
 
     # -- helpers -----------------------------------------------------------
     def _key_dtypes(self) -> Tuple[T.DataType, ...]:
@@ -364,6 +503,7 @@ class TpuHashAggregateExec(TpuExec):
             tuple(value_exprs), tuple(ops), batch_signature(batch), cap, sml,
             approx_float_sum=self.conf.get(IMPROVED_FLOAT_OPS),
             sides=sides, str_val_max_lens=svml, nonnull=nonnull,
+            strategy=self.resolved_strategy(cap),
         )
         keys, aggs, nseg = fn(
             vals_of_batch(batch),
@@ -561,13 +701,18 @@ class TpuHashAggregateExec(TpuExec):
             all_runs.append([r for (_, _, r, _) in entries])
         eval_exprs = (tuple(self._eval_exprs())
                       if self.mode != A.PARTIAL else None)
+        # one strategy per fused program: resolve at the LARGEST row-group
+        # capacity — that is where the reduction cost sits, so a small
+        # leading row group must not dictate the lowering for the big ones
+        strategy = (self.resolved_strategy(max(c for (_, c, _) in stage))
+                    if stage else None)
         key = (
             "stage", tuple(rg_meta),
             tuple(e.fusion_key() for e in chain_t),
             tuple(self._bound_keys), self._key_dtypes(),
             tuple(self._update_exprs), tuple(self._update_ops),
             tuple(self._merge_ops), eval_exprs, self.mode, approx,
-            side_signature(sides), self.conf.shape_bucket_min,
+            side_signature(sides), self.conf.shape_bucket_min, strategy,
         )
         fn = _AGG_CACHE.get(key)
         if fn is None:
@@ -575,7 +720,7 @@ class TpuHashAggregateExec(TpuExec):
                 tuple(self._bound_keys), self._key_dtypes(),
                 tuple(self._update_exprs), tuple(self._update_ops),
                 tuple(self._merge_ops), eval_exprs, approx,
-                self.conf.shape_bucket_min, chain_t)
+                self.conf.shape_bucket_min, chain_t, strategy=strategy)
             metas = tuple(rg_meta)
             runs_t = tuple(tuple(r) for r in all_runs)
 
@@ -662,12 +807,16 @@ class TpuHashAggregateExec(TpuExec):
         )
         eval_exprs = (tuple(self._eval_exprs())
                       if self.mode != A.PARTIAL else None)
+        # one strategy per fused program, resolved at the LARGEST batch
+        # capacity (a small first batch must not pick the lowering for
+        # the big ones; see _run_fused_stage)
+        strategy = self.resolved_strategy(max(caps)) if caps else None
         key = (
             "plan", sigs, caps, tuple(e.fusion_key() for e in chain_t),
             tuple(self._bound_keys), self._key_dtypes(),
             tuple(self._update_exprs), tuple(self._update_ops),
             tuple(self._merge_ops), eval_exprs, self.mode, approx,
-            side_signature(sides), self.conf.shape_bucket_min,
+            side_signature(sides), self.conf.shape_bucket_min, strategy,
         )
         fn = _AGG_CACHE.get(key)
         if fn is None:
@@ -675,7 +824,7 @@ class TpuHashAggregateExec(TpuExec):
                 tuple(self._bound_keys), self._key_dtypes(),
                 tuple(self._update_exprs), tuple(self._update_ops),
                 tuple(self._merge_ops), eval_exprs, approx,
-                self.conf.shape_bucket_min, chain_t)
+                self.conf.shape_bucket_min, chain_t, strategy=strategy)
             caps_t = caps
 
             def run(all_cols, all_nr, side_args):

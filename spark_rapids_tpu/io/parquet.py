@@ -250,12 +250,21 @@ class ParquetScanner:
     def read_split_device(self, i: int):
         """Device-decode split i: (list of ColumnarBatch — one per row
         group — or None when no column takes the device path, partition
-        values). Reference analog: the GPU decode half of
-        GpuParquetScan.scala:1157; see io/parquet_device.py."""
+        values). Cache-missing row groups go through the PIPELINED
+        decode→upload reader (io/parquet_device.read_row_groups_pipelined):
+        row group N+1 host-decodes on the srtpu-pqdec pool while N's
+        staged transfer and device unpack run, bounded by
+        ...format.parquet.pipeline.maxInFlight. Reference analog: the GPU
+        decode half of GpuParquetScan.scala:1157 plus the coalescing
+        reader's copy pipeline (:880-900)."""
         import pyarrow.parquet as pq
 
-        from ..conf import PARQUET_DEVICE_DECODE, PARQUET_DICT_STRINGS
-        from .parquet_device import read_row_group_device
+        from ..conf import (
+            PARQUET_DEVICE_DECODE,
+            PARQUET_DICT_STRINGS,
+            PARQUET_PIPELINE_MAX_IN_FLIGHT,
+        )
+        from .parquet_device import read_row_groups_pipelined
 
         if not self.conf.get(PARQUET_DEVICE_DECODE):
             return None, ()
@@ -293,17 +302,20 @@ class ParquetScanner:
             file_bytes = b""
         finally:
             f.close()
-        for i, rg in enumerate(s.row_groups):
-            if batches[i] is not None:
-                continue
-            b = read_row_group_device(
-                s.path, pf, rg, file_cols, nfields, file_bytes,
-                dict_strings=dict_strings)
+        missing = [j for j, b in enumerate(batches) if b is None]
+        gen = read_row_groups_pipelined(
+            s.path, pf, [s.row_groups[j] for j in missing], file_cols,
+            nfields, file_bytes, dict_strings=dict_strings,
+            max_in_flight=self.conf.get(PARQUET_PIPELINE_MAX_IN_FLIGHT))
+        for j, (rg, b) in zip(missing, gen):
             if b is None:
+                # no device-decodable column in this row group: the whole
+                # split uses the plain reader (generator abandonment is
+                # safe — outstanding decode tasks drop their results)
                 return None, s.partition_values
             if cache is not None:
-                cache.put(keys[i], b, b.device_memory_size())
-            batches[i] = b
+                cache.put(keys[j], b, b.device_memory_size())
+            batches[j] = b
         return batches, s.partition_values
 
     def device_stage_plans(self, i: int):

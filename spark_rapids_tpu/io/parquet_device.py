@@ -787,6 +787,35 @@ def chunk_to_device_column(plan: ChunkPlan, dtype_tpu, cap: int,
 # ---------------------------------------------------------------------------
 # row group -> ColumnarBatch (with per-column host fallback)
 # ---------------------------------------------------------------------------
+import threading as _threading
+
+_PQDEC_POOL = None
+# created at import time: a lazily-created lock would itself need a lock
+_PQDEC_POOL_LOCK = _threading.Lock()
+
+
+def _decode_pool():
+    """The PROCESS-SHARED srtpu-pqdec host-decode pool. One pool instead
+    of one-per-call: the pipelined reader keeps tasks from several row
+    groups in flight at once, and per-call pools would serialize at the
+    row-group boundary (plus pay thread churn per row group). The native
+    hybrid-decode calls release the GIL, so the pool gets real
+    parallelism. IMPORTANT: tasks submitted here must never block on
+    other tasks of this pool (deadlock); both submitters — _plan_columns
+    and read_row_groups_pipelined — only submit leaf chunk-decode work."""
+    global _PQDEC_POOL
+    if _PQDEC_POOL is None:
+        with _PQDEC_POOL_LOCK:
+            if _PQDEC_POOL is None:
+                import os
+                from concurrent.futures import ThreadPoolExecutor
+
+                _PQDEC_POOL = ThreadPoolExecutor(
+                    max_workers=min(8, os.cpu_count() or 4),
+                    thread_name_prefix="srtpu-pqdec")
+    return _PQDEC_POOL
+
+
 def _plan_columns(path, pf, rgmd, pqschema, name_to_ci, columns, file_bytes):
     """Host-plan every requested column chunk of one row group.
     Returns (plans by name, fallback column names)."""
@@ -819,12 +848,7 @@ def _plan_columns(path, pf, rgmd, pqschema, name_to_ci, columns, file_bytes):
         # analog: the COALESCING reader's copy thread pool,
         # GpuParquetScan.scala:900)
         if len(candidates) > 1:
-            from concurrent.futures import ThreadPoolExecutor
-
-            with ThreadPoolExecutor(
-                    max_workers=min(8, len(candidates)),
-                    thread_name_prefix="srtpu-pqdec") as pool:
-                results = list(pool.map(plan_one, candidates))
+            results = list(_decode_pool().map(plan_one, candidates))
         else:
             results = [plan_one(candidates[0])]
         for name, plan in results:
@@ -835,11 +859,194 @@ def _plan_columns(path, pf, rgmd, pqschema, name_to_ci, columns, file_bytes):
     return plans, fallback_cols
 
 
+def read_row_groups_pipelined(
+    path: str, pf, rgs: Sequence[int], columns: Sequence[str], tpu_fields,
+    file_bytes: Optional[bytes] = None, dict_strings: bool = False,
+    max_in_flight: int = 3,
+):
+    """Pipelined decode→upload over many row groups: a generator yielding
+    ``(rg, ColumnarBatch-or-None)`` in row-group order (None = no column
+    took the device path; the caller falls back to the plain reader for
+    the split). ``max_in_flight=1`` reproduces the round-6 serial
+    decode→upload order exactly.
+
+    The round-6 reader host-decoded a WHOLE row group, then staged one
+    packed upload, then dispatched the device unpack — strictly serial,
+    so the host link and the decoder thread pool took turns idling
+    (parquet lost to pandas 0.94x in BENCH_r05 precisely here). Now:
+
+      * row groups N+1..N+maxInFlight-1 host-decode on the shared
+        srtpu-pqdec pool while row group N's staged transfer and device
+        unpack run on the consumer thread (the bounded window caps host
+        memory at ~maxInFlight decoded payloads);
+      * within one row group, the first half of the column chunks to
+        finish decoding stages+uploads immediately (double-buffered
+        staging: two alternating packed transfers per row group) while
+        the remaining chunks still decompress — decode of independent
+        chunks overlaps the upload of already-finished ones;
+      * columns the device decoder cannot take host-decode via pyarrow
+        per column, exactly as before.
+
+    Reference analog: the coalescing multithreaded reader's
+    decode-while-copy pipeline (GpuParquetScan.scala:880-900, :1299).
+    Abandoning the generator mid-flight is safe: outstanding pool tasks
+    finish and their results are dropped."""
+    import time as _time
+
+    from concurrent.futures import FIRST_COMPLETED, wait
+
+    from .. import events as _events
+    from .. import obs as _obs
+    from ..columnar.batch import ColumnarBatch
+    from ..columnar.column import choose_capacity
+    from ..types import StructType
+    from .arrow_convert import arrow_to_batch
+
+    md = pf.metadata
+    pqschema = pf.schema
+    pool = _decode_pool()
+    if file_bytes is None:
+        with open(path, "rb") as f:
+            file_bytes = f.read()
+    fields_by_name = {f.name: f for f in tpu_fields}
+
+    def plan_one(rg, rgmd, name, ci):
+        t0 = _time.perf_counter_ns()
+        if ci is None:
+            return name, None, 0
+        pqcol = pqschema.column(ci)
+        try:
+            plan = plan_chunk(
+                file_bytes, rgmd.column(ci),
+                pqcol.max_definition_level, pqcol.max_repetition_level)
+        except Exception:
+            return name, None, 0
+        if _events.enabled():
+            _events.emit(
+                "pq_pipeline", stage="decode", rg=rg,
+                bytes=int(rgmd.column(ci).total_uncompressed_size),
+                dur=_time.perf_counter_ns() - t0)
+        if _obs.enabled():
+            _obs.inc("tpu_pq_pipeline_stages", 1, stage="decode")
+            _obs.inc("tpu_pq_pipeline_bytes",
+                     int(rgmd.column(ci).total_uncompressed_size),
+                     stage="decode")
+        return name, plan, 0
+
+    pending: Dict[int, tuple] = {}  # pos -> (rg, rgmd, [futures])
+
+    def submit(pos):
+        rg = rgs[pos]
+        rgmd = md.row_group(rg)
+        name_to_ci = {
+            rgmd.column(i).path_in_schema: i
+            for i in range(rgmd.num_columns)
+        }
+        futs = [
+            pool.submit(plan_one, rg, rgmd, name, name_to_ci.get(name))
+            for name in columns
+        ]
+        pending[pos] = (rg, rgmd, futs)
+
+    window = max(1, int(max_in_flight))
+    for pos in range(min(window, len(rgs))):
+        submit(pos)
+
+    for pos in range(len(rgs)):
+        rg, rgmd, futs = pending.pop(pos)
+        n = rgmd.num_rows
+        cap = choose_capacity(max(1, n))
+        plans: Dict[str, ChunkPlan] = {}
+        decoded: Dict[str, tuple] = {}   # name -> (key, run)
+        dev_args: Dict[str, list] = {}
+        fallback_cols: List[str] = []
+        staged_names: List[str] = []
+        flushed = False
+
+        def flush(names):
+            if not names:
+                return
+            t0 = _time.perf_counter_ns()
+            staged = stage_decode_args([decoded[nm][0] for nm in names])
+            nbytes = sum(
+                a.size * a.dtype.itemsize
+                for nm in names for a in decoded[nm][0])
+            for nm, da in zip(names, staged):
+                dev_args[nm] = da
+            if _events.enabled():
+                _events.emit("pq_pipeline", stage="upload", rg=rg,
+                             bytes=int(nbytes),
+                             dur=_time.perf_counter_ns() - t0)
+            if _obs.enabled():
+                _obs.inc("tpu_pq_pipeline_stages", 1, stage="upload")
+                _obs.inc("tpu_pq_pipeline_bytes", int(nbytes),
+                         stage="upload")
+
+        remaining = set(futs)
+        while remaining:
+            done, remaining = wait(remaining, return_when=FIRST_COMPLETED)
+            for fut in done:
+                name, plan, _ = fut.result()
+                if plan is None:
+                    fallback_cols.append(name)
+                    continue
+                try:
+                    args, key_t, run = plan_decode(
+                        plan, fields_by_name[name].dataType, cap,
+                        dict_strings)
+                except _FallbackError:
+                    fallback_cols.append(name)
+                    continue
+                plans[name] = plan
+                decoded[name] = (args, key_t, run)
+                staged_names.append(name)
+            # double-buffered staging: once half the columns have decoded,
+            # cross the link with buffer A while the rest still decompress
+            if (not flushed and remaining
+                    and len(staged_names) >= (len(columns) + 1) // 2):
+                flush(staged_names)
+                staged_names = []
+                flushed = True
+        flush(staged_names)
+
+        if not plans:
+            yield rg, None
+            continue
+        host_table = (pf.read_row_groups([rg], columns=fallback_cols)
+                      if fallback_cols else None)
+
+        t0 = _time.perf_counter_ns()
+        cols = []
+        fields = []
+        for name, f in zip(columns, tpu_fields):
+            if name in plans:
+                _, key_t, run = decoded[name]
+                cols.append(_run_decode(
+                    plans[name], f.dataType, key_t, run, dev_args[name]))
+            else:
+                sub = host_table.select([name])
+                b = arrow_to_batch(sub, StructType((f,)))
+                cols.append(b.columns[0])
+            fields.append(f)
+        batch = ColumnarBatch(cols, StructType(tuple(fields)), n)
+        if _events.enabled():
+            _events.emit("pq_pipeline", stage="unpack", rg=rg, bytes=0,
+                         dur=_time.perf_counter_ns() - t0)
+        if _obs.enabled():
+            _obs.inc("tpu_pq_pipeline_stages", 1, stage="unpack")
+        # advance the window BEFORE yielding: the next row group's chunks
+        # decode while the consumer touches this batch
+        nxt = pos + window
+        if nxt < len(rgs):
+            submit(nxt)
+        yield rg, batch
+
+
 def row_group_device_plans(
     path: str, pf, rg: int, columns: Sequence[str], tpu_fields,
     file_bytes: Optional[bytes] = None, dict_strings: bool = False,
 ):
-    """Stage-fusion variant of read_row_group_device: host-plan ALL
+    """Stage-fusion variant of the row-group decode: host-plan ALL
     columns and return ``(num_rows, cap, entries)`` with entries =
     ``[(args, key, run, field), ...]`` — no device dispatch happens here
     beyond the argument uploads, so the consumer can splice ``run`` into
@@ -870,60 +1077,3 @@ def row_group_device_plans(
         (da, key, run, f) for da, (_, key, run, f) in zip(dev_args, staged)
     ]
     return n, cap, entries
-
-
-def read_row_group_device(
-    path: str, pf, rg: int, columns: Sequence[str], tpu_fields,
-    file_bytes: Optional[bytes] = None, dict_strings: bool = False,
-) -> Optional[Any]:
-    """Decode one row group into a ColumnarBatch, device-decoding every
-    supported column and host-decoding (pyarrow) the rest. Returns None
-    when NO column takes the device path (caller uses the plain reader)."""
-    from ..columnar.batch import ColumnarBatch
-    from ..types import StructType
-    from ..columnar.column import choose_capacity
-
-    md = pf.metadata
-    rgmd = md.row_group(rg)
-    pqschema = pf.schema  # parquet (physical) schema
-    name_to_ci = {
-        rgmd.column(i).path_in_schema: i for i in range(rgmd.num_columns)
-    }
-    n = rgmd.num_rows
-    cap = choose_capacity(max(1, n))
-
-    plans, fallback_cols = _plan_columns(
-        path, pf, rgmd, pqschema, name_to_ci, columns, file_bytes)
-    if not plans:
-        return None
-
-    host_table = None
-    if fallback_cols:
-        host_table = pf.read_row_groups([rg], columns=fallback_cols)
-
-    from .arrow_convert import arrow_to_batch
-
-    # decode-plan every device column first, then cross the host link in
-    # ONE staged transfer for the whole row group (stage_decode_args)
-    decoded: Dict[str, tuple] = {}
-    for name, f in zip(columns, tpu_fields):
-        if name in plans:
-            args, key_t, run = plan_decode(
-                plans[name], f.dataType, cap, dict_strings)
-            decoded[name] = (args, key_t, run, f)
-    dev_args = stage_decode_args([v[0] for v in decoded.values()])
-
-    cols = []
-    fields = []
-    dev_iter = iter(zip(decoded.values(), dev_args))
-    for name, f in zip(columns, tpu_fields):
-        if name in plans:
-            (_, key_t, run, _), da = next(dev_iter)
-            cols.append(_run_decode(plans[name], f.dataType, key_t, run, da))
-            fields.append(f)
-        else:
-            sub = host_table.select([name])
-            b = arrow_to_batch(sub, StructType((f,)))
-            cols.append(b.columns[0])
-            fields.append(f)
-    return ColumnarBatch(cols, StructType(tuple(fields)), n)

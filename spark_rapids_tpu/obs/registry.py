@@ -106,6 +106,15 @@ METRICS: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     "tpu_watchdog_alerts": (
         COUNTER, "Watchdog alerts raised, by kind "
         "(stall/hbm_pressure/recompile_storm)", ("kind",)),
+    "tpu_agg_strategy": (
+        COUNTER, "Aggregation lowering choices by resolved strategy "
+        "(MATMUL/SCATTER/SORT — conf sql.agg.strategy)", ("strategy",)),
+    "tpu_pq_pipeline_stages": (
+        COUNTER, "Pipelined parquet decode stages completed "
+        "(decode/upload/unpack)", ("stage",)),
+    "tpu_pq_pipeline_bytes": (
+        COUNTER, "Bytes through the pipelined parquet decode stages",
+        ("stage",)),
 }
 
 #: event type -> the live metric family that carries the same signal, so
@@ -126,6 +135,8 @@ EVENT_BACKED_METRICS: Dict[str, str] = {
     "shuffle_fetch": "tpu_shuffle_bytes",
     "scan_cache": "tpu_scan_cache_ops",
     "alert": "tpu_watchdog_alerts",
+    "agg_strategy": "tpu_agg_strategy",
+    "pq_pipeline": "tpu_pq_pipeline_stages",
 }
 
 

@@ -566,6 +566,7 @@ class _Analyzer:
     def analyze(self, node: C.CpuExec) -> _Result:
         handlers = {
             C.CpuScanExec: self._scan,
+            C.CpuFileScanExec: self._file_scan,
             C.CpuRangeExec: self._range,
             C.CpuProjectExec: self._project,
             C.CpuFilterExec: self._filter,
@@ -609,8 +610,6 @@ class _Analyzer:
             notes.append(
                 f"{node.join_type} join: output shapes depend on match "
                 "counts (not statically bounded)")
-        elif isinstance(node, C.CpuFileScanExec):
-            notes.append("file scan batch shapes come from file metadata")
         self.exact_all = False
         return _Result(
             parts=None, layout=layout,
@@ -691,6 +690,103 @@ class _Analyzer:
                      f"[{nparts} partition(s), rows={total_rows}]",
                      layout, out_bytes, {}, exact, [], []),
             exact)
+
+    def _file_scan(self, node: C.CpuFileScanExec) -> _Result:
+        """File scans stay structurally unbounded (row counts and string
+        pools are data, not schema) — but their HBM FOOTPRINT is readable
+        from the file footers alone, and round 6's forecast ignored it
+        entirely (file-scan plans reported no peak at all, so the
+        plan-time "will spill" warning could never fire for exactly the
+        scans most likely to spill). Parquet footers give per-row-group
+        row counts and chunk byte sizes, so the analyzer now charges:
+
+          * decoded batches — every selected row group's capacity bucket
+            x schema row width (+ string chunk pools at their
+            uncompressed size) stays RESIDENT for the plan (the scan
+            cache pins it, exactly like in-memory scan batches);
+          * the pipelined reader's device window — TWO staged uploads in
+            flight (double-buffered staging), each bounded by the largest
+            row group's selected-chunk uncompressed bytes;
+          * host staging — maxInFlight row groups of decoded payloads
+            (reported in the notes; host memory is not HBM, so it rides
+            outside the peak figure).
+        """
+        schema = node.output_schema
+        layout = [
+            ColState(f.name, f.dataType,
+                     NON_NULL if not f.nullable else MAYBE_NULL)
+            for f in schema.fields
+        ]
+        notes = ["file scan batch shapes come from file metadata"]
+        if getattr(node, "fmt", None) == "parquet":
+            try:
+                self._model_parquet_scan(node, schema, notes)
+            except Exception:  # missing files, exotic footers: stay quiet
+                pass
+        self.exact_all = False
+        return _Result(
+            parts=None, layout=layout,
+            report=OpReport(node.node_name, "", layout, None, {}, False,
+                            notes, []),
+            exact=False)
+
+    def _model_parquet_scan(self, node, schema: StructType,
+                            notes: List[str]) -> None:
+        import pyarrow.parquet as pq
+
+        from ..conf import PARQUET_PIPELINE_MAX_IN_FLIGHT
+
+        scanner = node.scanner
+        file_cols = set(getattr(scanner, "columns", ()) or ())
+        pcols = set(getattr(scanner, "partition_cols", ()) or ())
+        wanted = file_cols - pcols
+        fixed_row = 0
+        has_strings = False
+        for f in schema.fields:
+            if f.name in pcols or (wanted and f.name not in wanted):
+                continue
+            if isinstance(f.dataType, (T.StringType, T.BinaryType)):
+                fixed_row += 5  # offsets+validity; chars pool added below
+                has_strings = True
+            else:
+                fixed_row += _storage_bytes(f.dataType) + 1
+        decoded = 0
+        max_upload = 0
+        nrg = 0
+        pfs: Dict[str, object] = {}
+        for s in scanner.splits():
+            pf = pfs.get(s.path)
+            if pf is None:
+                pf = pfs[s.path] = pq.ParquetFile(s.path)
+            md = pf.metadata
+            for rg in s.row_groups:
+                rgmd = md.row_group(rg)
+                nrg += 1
+                upload = 0
+                chars = 0
+                for ci in range(rgmd.num_columns):
+                    col = rgmd.column(ci)
+                    if wanted and col.path_in_schema not in wanted:
+                        continue
+                    upload += int(col.total_uncompressed_size)
+                    if has_strings and col.physical_type == "BYTE_ARRAY":
+                        chars += int(col.total_uncompressed_size)
+                cap = self._bucket(max(1, rgmd.num_rows))
+                self.max_cap = max(self.max_cap, cap)
+                decoded += cap * fixed_row + chars
+                max_upload = max(max_upload, upload)
+        if not nrg:
+            return
+        window = 2 * max_upload  # double-buffered staged transfers
+        mif = self.conf.get(PARQUET_PIPELINE_MAX_IN_FLIGHT)
+        self.scan_resident += decoded
+        self._note_working(window)
+        notes.append(
+            f"pipelined device decode: {nrg} row group(s), decoded "
+            f"batches ~{_pretty_bytes(decoded)} resident (scan cache), "
+            f"double-buffered upload window <= {_pretty_bytes(window)} "
+            f"device, host staging <= "
+            f"{_pretty_bytes(mif * max_upload)} (maxInFlight={mif})")
 
     def _range(self, node: C.CpuRangeExec) -> _Result:
         schema = node.output_schema
@@ -978,6 +1074,32 @@ class _Analyzer:
                              NON_NULL if not f.nullable else MAYBE_NULL)
                     for f in child_schema.fields])
         in_cap = in_batches[0].cap if in_batches else 128
+        if node.group_exprs:
+            # strategy forecast: call the RUNTIME's own chooser over the
+            # statically-known capacity — the same "derive the decision
+            # from the engine's own eligibility code" rule the fusion
+            # notes follow, so a wrong forecast surfaces as a strategy
+            # mismatch between this note and the 'agg_strategy' event.
+            # AUTO's cost model is capacity-dependent, so with NO static
+            # capacity (file scans, exchanges) the note must not guess
+            # from the placeholder cap — that would manufacture exactly
+            # the spurious mismatch the note exists to expose. A forced
+            # conf value is capacity-independent and always forecastable.
+            from ..conf import AGG_STRATEGY
+            from ..exec.aggregate import choose_agg_strategy
+
+            if in_batches or self.conf.get(AGG_STRATEGY) != "AUTO":
+                cap_for_choice = (max(b.cap for b in in_batches)
+                                  if in_batches else in_cap)
+                strat, sreason = choose_agg_strategy(
+                    self.conf, cap_for_choice, agg._update_ops,
+                    agg._update_exprs, agg._key_dtypes())
+                report.notes.append(f"agg strategy: {strat} — {sreason}")
+            else:
+                report.notes.append(
+                    "agg strategy: AUTO — resolved per batch capacity at "
+                    "run time (input shapes not statically bounded); see "
+                    "the 'agg_strategy' event for the actual choice")
         layout = self._agg_result_layout(node, kid, in_cols)
         out_cap = in_cap if grouped else 1
         out_parts: Optional[List[List[BatchState]]] = None

@@ -36,12 +36,20 @@ from spark_rapids_tpu.sql import TpuSession
 
 
 # ---------------------------------------------------------------------------
-# fused vs per-column bucket reduce (both lowerings)
+# fused vs per-column bucket reduce (all three lowerings)
 # ---------------------------------------------------------------------------
-@pytest.fixture(params=["scatter", "matmul"])
+def _strategy_of(lowering):
+    """The explicit strategy to pass for a fixture param (sort is selected
+    via the strategy argument — the round-7 lowering; matmul still rides
+    the FORCE_MATMUL hook, which outranks any passed strategy)."""
+    return "SORT" if lowering == "sort" else None
+
+
+@pytest.fixture(params=["scatter", "matmul", "sort"])
 def lowering(request):
-    """Run the differential against BOTH backend lowerings: the CPU
-    scatter family and the forced MXU limb-matmul path."""
+    """Run the differential against ALL THREE lowerings: the CPU scatter
+    family, the forced MXU limb-matmul path, and the sort+prefix-diff
+    bandwidth path (round-7 sql.agg.strategy=SORT)."""
     prev = BR.FORCE_MATMUL
     BR.FORCE_MATMUL = request.param == "matmul"
     try:
@@ -50,12 +58,15 @@ def lowering(request):
         BR.FORCE_MATMUL = prev
 
 
-def _diff_bucket_reduce(seg, B, int_cols, count_cols, float_cols):
-    fused = BR.bucket_reduce(seg, B, int_cols, count_cols, float_cols)
+def _diff_bucket_reduce(seg, B, int_cols, count_cols, float_cols,
+                        strategy=None):
+    fused = BR.bucket_reduce(seg, B, int_cols, count_cols, float_cols,
+                             strategy=strategy)
     prev = BR.FORCE_PER_COLUMN
     BR.FORCE_PER_COLUMN = True
     try:
-        percol = BR.bucket_reduce(seg, B, int_cols, count_cols, float_cols)
+        percol = BR.bucket_reduce(seg, B, int_cols, count_cols, float_cols,
+                                  strategy=strategy)
     finally:
         BR.FORCE_PER_COLUMN = prev
     for fi, pi in zip(fused[0], percol[0]):
@@ -80,7 +91,7 @@ def test_fused_reduce_int64_wraparound(lowering):
     out = _diff_bucket_reduce(
         seg, 8,
         [(jnp.asarray(big), valid), (jnp.asarray(mixed), valid)],
-        [valid], [])
+        [valid], [], strategy=_strategy_of(lowering))
     # cross-check column 0 against numpy's wrapping sum per bucket
     segs = np.asarray(seg)
     for b in range(7):
@@ -102,7 +113,8 @@ def test_fused_reduce_all_null_columns(lowering):
         seg, 8,
         [(data_i, none_valid), (data_i, some_valid)],
         [none_valid, some_valid],
-        [(data_f, none_valid), (data_f, some_valid)])
+        [(data_f, none_valid), (data_f, some_valid)],
+        strategy=_strategy_of(lowering))
     assert np.all(np.asarray(out[0][0]) == 0)  # all-null sums to 0
     assert np.all(np.asarray(out[1][0]) == 0)  # all-null counts to 0
     assert np.all(np.asarray(out[2][0]) == 0.0)
@@ -120,7 +132,8 @@ def test_fused_reduce_float_hilo_split(lowering):
     valid = jnp.asarray(rng.random(n) < 0.9)
     _diff_bucket_reduce(
         seg, 4, [], [],
-        [(jnp.asarray(precise), valid), (jnp.asarray(huge), valid)])
+        [(jnp.asarray(precise), valid), (jnp.asarray(huge), valid)],
+        strategy=_strategy_of(lowering))
 
 
 def test_fused_minmax_family_matches_per_column(lowering):
@@ -182,11 +195,16 @@ def _cmp_rows(lhs, rhs):
 def test_mixed_plan_fused_vs_per_column(lowering):
     """Exec-level differential for a mixed sum/count/min/max plan: the
     fused multi-column kernel vs the per-column baseline, same results on
-    both lowerings (and fused single-program plan vs per-batch paths)."""
+    all three lowerings (and fused single-program plan vs per-batch
+    paths). The sort lowering is selected the way users select it — the
+    sql.agg.strategy conf."""
     schema = schema_of(k=T.INT, a=T.LONG, b=T.DOUBLE)
     batches = _mk_batches(schema)
-    on = RapidsConf({"spark.rapids.tpu.sql.agg.fusedPlan": "ON"})
-    off = RapidsConf({"spark.rapids.tpu.sql.agg.fusedPlan": "OFF"})
+    strategy = "SORT" if lowering == "sort" else "AUTO"
+    on = RapidsConf({"spark.rapids.tpu.sql.agg.fusedPlan": "ON",
+                     "spark.rapids.tpu.sql.agg.strategy": strategy})
+    off = RapidsConf({"spark.rapids.tpu.sql.agg.fusedPlan": "OFF",
+                      "spark.rapids.tpu.sql.agg.strategy": strategy})
     fused_rows = _mixed_plan_exec(on, batches, schema).collect()
     prev = BR.FORCE_PER_COLUMN
     BR.FORCE_PER_COLUMN = True
@@ -195,6 +213,146 @@ def test_mixed_plan_fused_vs_per_column(lowering):
     finally:
         BR.FORCE_PER_COLUMN = prev
     _cmp_rows(fused_rows, percol_rows)
+
+
+def test_sort_lowering_dead_and_out_of_range_rows(lowering):
+    """Out-of-range segment ids — padding rows at id B, dead rows past it,
+    and NEGATIVE ids — must drop out of every reduction under all three
+    lowerings (the sort lowering's boundary search must exclude both
+    tails)."""
+    n = 257  # off the block/tile sizes on purpose
+    rng = np.random.default_rng(31)
+    seg_np = rng.integers(-3, 12, n).astype(np.int32)  # B=8: both tails
+    seg = jnp.asarray(seg_np)
+    data = rng.integers(-(2**62), 2**62, n).astype(np.int64)
+    valid = jnp.asarray(rng.random(n) < 0.7)
+    out = _diff_bucket_reduce(
+        seg, 8, [(jnp.asarray(data), valid)], [valid], [],
+        strategy=_strategy_of(lowering))
+    v = np.asarray(valid)
+    for b in range(8):
+        m = (seg_np == b) & v
+        want = np.int64(0)
+        with np.errstate(over="ignore"):
+            for x in data[m]:
+                want = np.int64(want + x)
+        assert int(np.asarray(out[0][0])[b]) == int(want)
+        assert int(np.asarray(out[1][0])[b]) == int(m.sum())
+
+
+def test_three_lowerings_bit_identical_int_sums():
+    """Acceptance pin: MATMUL, SCATTER and SORT produce BIT-identical
+    integer sums and counts over the same inputs (incl. wraparound)."""
+    n = 600
+    rng = np.random.default_rng(43)
+    seg = jnp.asarray(rng.integers(0, 16, n).astype(np.int32))
+    cols = [(jnp.asarray(rng.integers(-(2**62), 2**62, n).astype(np.int64)),
+             jnp.asarray(rng.random(n) < 0.8)) for _ in range(3)]
+    cnts = [v for _, v in cols]
+    outs = {}
+    for strat in ("SCATTER", "SORT"):
+        outs[strat] = BR.bucket_reduce(seg, 16, cols, cnts, [],
+                                       strategy=strat)
+    prev = BR.FORCE_MATMUL
+    BR.FORCE_MATMUL = True
+    try:
+        outs["MATMUL"] = BR.bucket_reduce(seg, 16, cols, cnts, [])
+    finally:
+        BR.FORCE_MATMUL = prev
+    for strat in ("SORT", "MATMUL"):
+        for i in range(3):
+            np.testing.assert_array_equal(
+                np.asarray(outs["SCATTER"][0][i]),
+                np.asarray(outs[strat][0][i]))
+            np.testing.assert_array_equal(
+                np.asarray(outs["SCATTER"][1][i]),
+                np.asarray(outs[strat][1][i]))
+
+
+# ---------------------------------------------------------------------------
+# strategy chooser: conf plumbing, visibility, cost-model branches
+# ---------------------------------------------------------------------------
+def test_strategy_chooser_forced_and_auto_branches():
+    from spark_rapids_tpu.exec.aggregate import choose_agg_strategy
+
+    ops = ("sum", "count", "count_star")
+    exprs = (E.BoundReference(1, T.LONG, True),
+             E.BoundReference(1, T.LONG, True), None)
+    keys = (T.INT,)
+    forced = RapidsConf({"spark.rapids.tpu.sql.agg.strategy": "SORT"})
+    s, why = choose_agg_strategy(forced, 1 << 20, ops, exprs, keys)
+    assert s == "SORT" and "forced" in why
+    auto = RapidsConf({})
+    s, why = choose_agg_strategy(auto, 1 << 20, ops, exprs, keys,
+                                 backend="cpu")
+    assert s == "SCATTER" and "CPU backend" in why
+    # on an accelerator backend AUTO compares the measured-rate models;
+    # a wide aggregate (many limb columns) pushes the matmul cost up
+    # until the bandwidth-sized sort wins
+    wide_ops = tuple(["sum"] * 40)
+    wide_exprs = tuple(E.BoundReference(i, T.LONG, True) for i in range(40))
+    s_wide, why_wide = choose_agg_strategy(
+        auto, 1 << 24, wide_ops, wide_exprs, keys, backend="tpu")
+    s_narrow, _ = choose_agg_strategy(
+        auto, 1 << 24, ("count_star",), (None,), keys, backend="tpu")
+    assert s_wide == "SORT", why_wide
+    assert s_narrow == "MATMUL"
+    assert "est matmul" in why_wide and "sort" in why_wide
+
+
+def test_strategy_visible_in_events_and_explain_metrics():
+    sess = TpuSession({"spark.rapids.tpu.eventLog.enabled": True,
+                       "spark.rapids.tpu.sql.agg.strategy": "SORT"})
+    n = 64
+    data = {"k": [i % 4 for i in range(n)], "v": list(range(n))}
+    schema = schema_of(k=T.INT, v=T.LONG)
+    rows = sess.create_dataframe(data, schema).group_by("k").agg(
+        A.agg(A.Sum(col("v")), "s")).collect()
+    assert sorted(rows) == sorted(
+        (k, sum(v for i, v in enumerate(range(n)) if i % 4 == k))
+        for k in range(4))
+    evs = [r for r in sess.events.records()
+           if r["event"] == "agg_strategy"]
+    assert evs and evs[0]["strategy"] == "SORT"
+    assert "forced" in evs[0]["reason"]
+    assert "strategy=SORT" in sess.explain_metrics()
+    # the analyzer's forecast note names the same strategy (explain)
+    df = sess.create_dataframe(data, schema).group_by("k").agg(
+        A.agg(A.Sum(col("v")), "s"))
+    assert "agg strategy: SORT" in df.explain()
+    sess.close()
+
+
+def test_auto_strategy_resolution_does_not_double_compile():
+    """Recompile guard for the chooser: AUTO resolves to ONE fixed
+    strategy per plan shape, so the fused aggregate still compiles
+    exactly once across batches and a rerun compiles nothing — the
+    strategy is memoized per capacity, part of the cache key, and never
+    data-dependent."""
+    schema = schema_of(k=T.INT, a=T.LONG, b=T.DOUBLE)
+    # a capacity bucket (256) no other test's plan uses: the guard below
+    # must observe ITS OWN compile, not another test's warm cache
+    batches = _mk_batches(schema, nb=4, n=200)
+    conf = RapidsConf({"spark.rapids.tpu.sql.agg.fusedPlan": "ON",
+                       "spark.rapids.tpu.sql.agg.strategy": "AUTO"})
+    agg = _mixed_plan_exec(conf, batches, schema)
+    before = exec_base.compile_miss_count()
+    rows1 = agg.collect()
+    assert exec_base.compile_miss_count() - before == 1
+    again = _mixed_plan_exec(conf, batches, schema)
+    before2 = exec_base.compile_miss_count()
+    rows2 = again.collect()
+    assert exec_base.compile_miss_count() == before2
+    _cmp_rows(rows1, rows2)
+    # and a SORT-forced plan is a DIFFERENT program (one fresh compile),
+    # not a silent reuse of the scatter executable
+    forced = RapidsConf({"spark.rapids.tpu.sql.agg.fusedPlan": "ON",
+                         "spark.rapids.tpu.sql.agg.strategy": "SORT"})
+    sorted_agg = _mixed_plan_exec(forced, batches, schema)
+    before3 = exec_base.compile_miss_count()
+    rows3 = sorted_agg.collect()
+    assert exec_base.compile_miss_count() - before3 == 1
+    _cmp_rows(rows1, rows3)
 
 
 # ---------------------------------------------------------------------------

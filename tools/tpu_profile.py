@@ -330,6 +330,37 @@ def build_report(events: List[dict], top_n: int = 10,
     for op, (n, b) in sorted(sc.items()):
         lines.append(f"  {op}: {n} ({_mb(b)})")
 
+    # aggregation strategy choices (one 'agg_strategy' event per exec per
+    # capacity): the chooser on the record — compare against the top-ops
+    # table above to see whether the pick was right
+    strat: Dict[Tuple[str, str, int], Tuple[int, str]] = {}
+    for r in events:
+        if r.get("event") == "agg_strategy":
+            k = (r.get("op"), r.get("strategy"), r.get("cap"))
+            n, _ = strat.get(k, (0, ""))
+            strat[k] = (n + 1, r.get("reason", ""))
+    lines.append("== agg strategy ==")
+    if not strat:
+        lines.append("  none recorded (no grouped aggregates ran)")
+    for (op, s, cap), (n, reason) in sorted(strat.items()):
+        times = f" x{n}" if n > 1 else ""
+        lines.append(f"  {op}[cap={cap}]: {s}{times} — {reason}")
+
+    # pipelined parquet decode stages: per-stage totals; overlapping
+    # decode/upload spans are visible in the Perfetto export
+    pipe: Dict[str, List[int]] = defaultdict(lambda: [0, 0, 0])
+    for r in events:
+        if r.get("event") == "pq_pipeline":
+            t = pipe[r["stage"]]
+            t[0] += 1
+            t[1] += r.get("bytes") or 0
+            t[2] += r.get("dur") or 0
+    lines.append("== parquet pipeline ==")
+    if not pipe:
+        lines.append("  no activity")
+    for stage, (n, b, dur) in sorted(pipe.items()):
+        lines.append(f"  {stage}: {n} ({_mb(b)}, {_ms(dur)} host)")
+
     lines.append("== forecast vs actual ==")
     fa_lines, violations = forecast_vs_actual(queries)
     lines.extend(fa_lines)
@@ -372,6 +403,10 @@ def run_alerts(events: List[dict], stall_ms: int, pressure_fraction: float,
 # ---------------------------------------------------------------------------
 def diff_bench(old: dict, new: dict, threshold: float
                ) -> Tuple[str, int]:
+    # driver-captured BENCH_*.json files wrap the bench line in a
+    # {"parsed": {...}} envelope; unwrap so rounds diff either layout
+    old = old.get("parsed", old) if "per_shape" not in old else old
+    new = new.get("parsed", new) if "per_shape" not in new else new
     lines: List[str] = []
     regressions = 0
     shapes = sorted(set(old.get("per_shape") or {})
@@ -383,6 +418,15 @@ def diff_bench(old: dict, new: dict, threshold: float
             lines.append(f"  {shape}: only in "
                          f"{'new' if a is None else 'old'} run")
             continue
+        if not isinstance(a, dict) or not isinstance(b, dict):
+            # pre-round-6 layout: bare speedup floats — no timed fields
+            lines.append(f"  {shape}: no comparable timing fields "
+                         "(legacy bench layout)")
+            continue
+        sa, sb = a.get("agg_strategy"), b.get("agg_strategy")
+        if sa != sb and (sa or sb):
+            lines.append(f"  {shape}.agg_strategy: {sa} -> {sb} "
+                         "(lowering changed — compare device_ms)")
         for field in ("tpu_ms", "device_ms"):
             va, vb = a.get(field), b.get(field)
             if va is None or vb is None or va <= 0:
