@@ -507,11 +507,426 @@ SHAPES = {
 }
 
 
+# ---------------------------------------------------------------------------
+# mesh lane (--mesh N): the six shapes as SPMD plans over an N-device mesh,
+# measured against the SAME plan on a 1-device mesh. Writes real per-shape
+# numbers (tpu_ms incl. sharded ingestion, the SPMD program's dispatch->
+# ready time, per-chip completion lanes) plus scaling efficiency and the
+# per-shard plananalysis forecast cross-check — the MULTICHIP_*.json
+# payload, replacing the old dry-run ok flag.
+#
+# Scaling efficiency definitions (both reported; docs/tuning.md):
+#   scaling_efficiency_raw = (t_1dev / t_Ndev) / N        — the textbook
+#     strong-scaling number. On the XLA-CPU host-device fallback, N
+#     virtual devices timeshare os.cpu_count() cores, so raw efficiency
+#     is bounded by cores/N no matter how good the program is.
+#   scaling_efficiency     = (t_1dev / t_Ndev) / min(N, host_parallelism)
+#     — normalizes out the emulation: how much of the parallelism the
+#     backend ACTUALLY has does the SPMD program capture. On a real
+#     N-chip TPU host_parallelism >= N and the two definitions coincide.
+# ---------------------------------------------------------------------------
+def _stage_mesh_env(n: int) -> None:
+    """Force an n-device virtual CPU mesh BEFORE jax initializes (same
+    contract as the dryrun/conftest: the flag only works pre-backend)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+def _mesh_stages_of(root):
+    from spark_rapids_tpu.plugin.plananalysis import _mesh_stages_of as f
+
+    return f(root)
+
+
+def _np_shard_parts(arrays, masks, n, n_parts):
+    """Split columns into n_parts contiguous chunks of (data, valid)."""
+    per = (n + n_parts - 1) // n_parts
+    parts = []
+    for p in range(n_parts):
+        lo, hi = p * per, min((p + 1) * per, n)
+        cols = []
+        for x, m in zip(arrays, masks):
+            d = x[lo:hi]
+            v = (np.ones(hi - lo, bool) if m is None else m[lo:hi])
+            cols.append((d, v))
+        parts.append((cols, hi - lo))
+    return parts
+
+
+def _run_mesh_plan(root, iters):
+    """Materialize the plan ``iters`` times (stages reset between runs so
+    staging + SPMD execution both re-happen; compiled programs stay
+    cached). Returns (median wall s, median max-per-chip ns, per-chip ns
+    of the median run, stages)."""
+    stages = _mesh_stages_of(root)
+
+    def once():
+        for st in stages:
+            st.reset_for_rerun()
+        t0 = time.perf_counter()
+        for p in range(root.num_partitions):
+            for _ in root.execute_partition(p):
+                pass
+        wall = time.perf_counter() - t0
+        chips = []
+        for st in stages:
+            chips = st.mesh_actuals.get("per_chip_ns") or chips
+        return wall, chips
+
+    once()  # warm: compile
+    runs = [once() for _ in range(max(iters, 3))]
+    runs.sort(key=lambda r: r[0])
+    wall, chips = runs[len(runs) // 2]
+    exec_ns = max(chips) if chips else 0
+    return wall, exec_ns, chips, stages
+
+
+def _mesh_shape_result(build, conf_n, conf_1, n_dev, iters):
+    """Measure one mesh shape at N devices and 1 device; cross-check the
+    per-shard forecast on the N-device plan."""
+    from spark_rapids_tpu.plugin.plananalysis import (
+        cross_check_mesh,
+        forecast_mesh,
+    )
+
+    root_n = build(conf_n)
+    wall_n, exec_n, chips, stages = _run_mesh_plan(root_n, iters)
+    fc = forecast_mesh(root_n)
+    violations = cross_check_mesh(root_n)
+    root_1 = build(conf_1)
+    wall_1, exec_1, _, _ = _run_mesh_plan(root_1, iters)
+    host_par = min(n_dev, os.cpu_count() or 1)
+    speedup = (exec_1 / exec_n) if exec_n else None
+    out = {
+        "tpu_ms": round(wall_n * 1e3, 1),
+        "tpu_ms_1dev": round(wall_1 * 1e3, 1),
+        "device_ms": round(exec_n / 1e6, 3),
+        "device_ms_1dev": round(exec_1 / 1e6, 3),
+        "per_chip_device_ms": [round(c / 1e6, 3) for c in chips],
+        "speedup_vs_1dev": round(speedup, 3) if speedup else None,
+        "scaling_efficiency": (
+            round(speedup / host_par, 3) if speedup else None),
+        "scaling_efficiency_raw": (
+            round(speedup / n_dev, 3) if speedup else None),
+        "mesh_lowered": bool(stages),
+        "mesh_stages": [s.node_name for s in stages],
+        "sharded_scan": any(
+            (s.mesh_actuals.get("staging") or {}).get("source")
+            == "sharded_scan"
+            or (s.mesh_actuals.get("staging_left") or {}).get("source")
+            == "sharded_scan"
+            for s in stages),
+        "forecast_violations": violations,
+        "forecast": fc,
+    }
+    return out
+
+
+def mesh_shape_agg(scale, conf, n_dev, T, E, A, X):
+    from spark_rapids_tpu.columnar.batch import schema_of
+    from spark_rapids_tpu.exec.mesh import TpuMeshAggregateExec
+    from spark_rapids_tpu.exec.scan import MeshShardedScanExec
+    from spark_rapids_tpu.expr.expressions import col, lit
+
+    n = int((1 << 26) * scale)
+    rng = np.random.default_rng(42)
+    k = rng.integers(0, 64, n).astype(np.int32)
+    a = rng.integers(-(10**6), 10**6, n).astype(np.int64)
+    b = rng.normal(size=n)
+    b_null = rng.random(n) < 0.05
+    schema = schema_of(k=T.INT, a=T.LONG, b=T.DOUBLE)
+    parts = _np_shard_parts(
+        [k, a, np.where(b_null, 0.0, b)], [None, None, ~b_null], n, n_dev)
+
+    def build(conf):
+        scan = MeshShardedScanExec(conf, parts, schema)
+        filt = X.TpuFilterExec(
+            conf, E.GreaterThanOrEqual(col("a"), lit(0)), scan)
+        proj = X.TpuProjectExec(
+            conf,
+            [col("k"), E.Alias(E.Multiply(col("a"), lit(2)), "a2"),
+             col("b")], filt)
+        return TpuMeshAggregateExec(
+            conf, [col("k")],
+            [A.agg(A.Sum(col("a2")), "s"), A.agg(A.Average(col("b")), "m"),
+             A.agg(A.Count(col("b")), "c")], proj)
+
+    return build
+
+
+def mesh_shape_sort(scale, conf, n_dev, T, E, A, X):
+    from spark_rapids_tpu.columnar.batch import schema_of
+    from spark_rapids_tpu.exec.mesh import TpuMeshSortExec
+    from spark_rapids_tpu.exec.scan import MeshShardedScanExec
+
+    n = int((1 << 23) * scale)
+    rng = np.random.default_rng(7)
+    key = rng.integers(-(2**40), 2**40, n)
+    pay = rng.integers(0, 1000, n).astype(np.int32)
+    schema = schema_of(key=T.LONG, pay=T.INT)
+    parts = _np_shard_parts([key, pay], [None, None], n, n_dev)
+
+    def build(conf):
+        scan = MeshShardedScanExec(conf, parts, schema)
+        return TpuMeshSortExec(conf, [0], [(True, True)], scan)
+
+    return build
+
+
+def mesh_shape_join(scale, conf, n_dev, T, E, A, X):
+    from spark_rapids_tpu.columnar.batch import schema_of
+    from spark_rapids_tpu.exec.mesh import TpuMeshHashJoinExec
+    from spark_rapids_tpu.exec.scan import MeshShardedScanExec
+
+    n = int((1 << 23) * scale)
+    d = 100_000
+    rng = np.random.default_rng(11)
+    fk = rng.integers(0, d, n).astype(np.int64)
+    fv = rng.integers(0, 100, n).astype(np.int64)
+    dk = np.arange(d, dtype=np.int64)
+    dv = rng.integers(0, 10**6, d).astype(np.int64)
+    fs = schema_of(fk=T.LONG, fv=T.LONG)
+    ds = schema_of(dk=T.LONG, dv=T.LONG)
+    fparts = _np_shard_parts([fk, fv], [None, None], n, n_dev)
+    dparts = _np_shard_parts([dk, dv], [None, None], d, n_dev)
+
+    def build(conf):
+        return TpuMeshHashJoinExec(
+            conf, MeshShardedScanExec(conf, fparts, fs),
+            MeshShardedScanExec(conf, dparts, ds), [0], [0])
+
+    return build
+
+
+def mesh_shape_window(scale, conf, n_dev, T, E, A, X):
+    from spark_rapids_tpu.columnar.batch import schema_of
+    from spark_rapids_tpu.exec.mesh import TpuMeshWindowExec
+    from spark_rapids_tpu.exec.scan import MeshShardedScanExec
+    from spark_rapids_tpu.expr import windows as W
+    from spark_rapids_tpu.expr.expressions import col
+
+    n = int((1 << 23) * scale)
+    rng = np.random.default_rng(13)
+    k = rng.integers(0, 64, n).astype(np.int32)
+    ts = rng.permutation(n).astype(np.int64)
+    v = rng.integers(0, 1000, n).astype(np.int64)
+    schema = schema_of(k=T.INT, ts=T.LONG, v=T.LONG)
+    parts = _np_shard_parts([k, ts, v], [None] * 3, n, n_dev)
+    spec = W.WindowSpec(
+        partition_by=(col("k"),), order_by=(col("ts"),),
+        orders=((True, True),))
+    wexprs = [
+        W.WindowExpression(A.Sum(col("v")), spec, "rs"),
+        W.WindowExpression(W.RowNumber(), spec, "rn"),
+    ]
+
+    def build(conf):
+        scan = MeshShardedScanExec(conf, parts, schema)
+        return TpuMeshWindowExec(conf, wexprs, scan)
+
+    return build
+
+
+def mesh_shape_string(scale, conf, n_dev, T, E, A, X):
+    """String group-by over the mesh: the byte-plane exchange carries the
+    string GROUP KEY (dict columns materialize at staging). The string
+    kernels run in the chain below the stage (host-fed: strings gate the
+    sharded scan), the aggregate + exchange are the SPMD program."""
+    from spark_rapids_tpu.columnar import ColumnarBatch
+    from spark_rapids_tpu.columnar.batch import schema_of
+    from spark_rapids_tpu.exec.mesh import TpuMeshAggregateExec
+    from spark_rapids_tpu.expr.expressions import col, lit
+
+    n = int((1 << 22) * scale)
+    rng = np.random.default_rng(17)
+    pool = [
+        "alpha-001", "beta-smallX", "gamma", "delta-verylongvalue-0042",
+        "epsilon-X", "zeta", "eta-middling", "theta-X-suffix", "iota",
+        "kappa-longish-string", "", "lambda-Xx", "mu-0", "nu-tail",
+    ] * 4
+    idx = rng.integers(0, len(pool), n)
+    v = rng.integers(0, 1000, n).astype(np.int64)
+    schema = schema_of(s=T.STRING, v=T.LONG)
+    per = (n + n_dev - 1) // n_dev
+    partitions = []
+    for p in range(n_dev):
+        lo, hi = p * per, min((p + 1) * per, n)
+        if lo >= hi:
+            partitions.append([])
+            continue
+        scol = _dev_string_col(pool, idx[lo:hi], hi - lo, T.STRING)
+        vb = _dev_batch([v[lo:hi]], schema_of(v=T.LONG), hi - lo)
+        partitions.append(
+            [ColumnarBatch([scol, vb.columns[0]], schema, hi - lo)])
+
+    def build(conf):
+        scan = X.InMemoryScanExec(conf, partitions, schema)
+        filt = X.TpuFilterExec(conf, E.Contains(col("s"), lit("X")), scan)
+        return TpuMeshAggregateExec(
+            conf, [col("s")],
+            [A.agg(A.Count(None), "c"), A.agg(A.Sum(col("v")), "sv")],
+            filt)
+
+    return build
+
+
+def mesh_shape_parquet(scale, conf, n_dev, T, E, A, X):
+    """The full product path: session-planned parquet scan -> filter ->
+    grouped aggregate lowering to ONE SPMD program fed by the sharded
+    parquet scan (row groups round-robined across shards, host decode
+    overlapping per-shard staged uploads)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    n = int((1 << 23) * scale)
+    rng = np.random.default_rng(19)
+    tmpd = tempfile.mkdtemp(prefix="srtpu_meshbench_")
+    prices = np.round(rng.uniform(1.0, 100.0, 9750), 2)
+    t = pa.table({
+        "ss_item_sk": pa.array(rng.integers(1, 18_001, n).astype(np.int32)),
+        "ss_quantity": pa.array(rng.integers(1, 101, n).astype(np.int32)),
+        "ss_wholesale_cost": pa.array(prices[rng.integers(0, 9750, n)]),
+        "ss_sold_date_sk": pa.array(
+            (2_450_815 + rng.integers(0, 2400, n)).astype(np.int32)),
+    })
+    path = os.path.join(tmpd, "t.parquet")
+    # 2 row groups per shard so the round-robin has real work to spread
+    pq.write_table(t, path, row_group_size=max(n // (2 * n_dev), 1))
+
+    from spark_rapids_tpu.expr.expressions import col, lit
+    from spark_rapids_tpu.sql import TpuSession
+
+    def build(conf):
+        # one scan split per row group: the default coalescing byte
+        # target would pack the whole file into a single partition and
+        # the planner would never see a mesh-eligible multi-split scan
+        sess = TpuSession({
+            **conf._values,
+            "spark.rapids.tpu.sql.reader.batchSizeBytes": 1,
+        })
+        df = (
+            sess.read.parquet(tmpd)
+            .where(E.GreaterThanOrEqual(col("ss_sold_date_sk"),
+                                        lit(2_452_015)))
+            .group_by("ss_quantity")
+            .agg(A.agg(A.Sum(col("ss_wholesale_cost")), "s"),
+                 A.agg(A.Count(col("ss_item_sk")), "c")))
+        plan = sess._execute(df.node)
+        return getattr(plan, "tpu_child", plan)
+
+    return build
+
+
+MESH_SHAPES = {
+    "agg": mesh_shape_agg,
+    "sort": mesh_shape_sort,
+    "join": mesh_shape_join,
+    "window": mesh_shape_window,
+    "string": mesh_shape_string,
+    "parquet": mesh_shape_parquet,
+}
+
+
+def run_mesh_lane(args) -> None:
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.exec import (
+        InMemoryScanExec,
+        TpuFilterExec,
+        TpuHashAggregateExec,
+        TpuProjectExec,
+    )
+    from spark_rapids_tpu.expr import aggregates as A
+    from spark_rapids_tpu.expr import expressions as E
+
+    class X:
+        pass
+
+    X.InMemoryScanExec = InMemoryScanExec
+    X.TpuFilterExec = TpuFilterExec
+    X.TpuProjectExec = TpuProjectExec
+    X.TpuHashAggregateExec = TpuHashAggregateExec
+
+    n_dev = args.mesh
+    import jax
+
+    avail = len(jax.devices())
+    if avail < n_dev:
+        print(json.dumps({"metric": "mesh_scaling", "ok": False,
+                          "error": f"need {n_dev} devices, have {avail}"}))
+        sys.exit(1)
+    base = {
+        "spark.rapids.tpu.shuffle.mode": "ici",
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+    }
+    bench_logger = None
+    if args.event_log:
+        # same contract as the normal lane: exec-direct shapes emit
+        # through the installed logger (per-chip '[chip k]' lanes ride
+        # in it), the session-path shape picks the dir up from conf
+        from spark_rapids_tpu import events as EV
+
+        base["spark.rapids.tpu.eventLog.dir"] = args.event_log
+        bench_logger = EV.EventLogger(RapidsConf(base))
+        EV.install(bench_logger)
+    conf_n = RapidsConf({**base, "spark.rapids.tpu.mesh.devices": n_dev})
+    conf_1 = RapidsConf({**base, "spark.rapids.tpu.mesh.devices": 1})
+    per_shape = {}
+    total_violations = []
+    for name in (s.strip() for s in args.shapes.split(",")):
+        build = MESH_SHAPES[name](args.scale, conf_n, n_dev, T, E, A, X)
+        r = _mesh_shape_result(build, conf_n, conf_1, n_dev, args.iters)
+        per_shape[name] = r
+        total_violations.extend(r["forecast_violations"])
+        print(f"{name}: tpu={r['tpu_ms']}ms (1dev {r['tpu_ms_1dev']}ms) "
+              f"spmd={r['device_ms']}ms (1dev {r['device_ms_1dev']}ms) "
+              f"eff={r['scaling_efficiency']} "
+              f"(raw {r['scaling_efficiency_raw']}) "
+              f"violations={len(r['forecast_violations'])}",
+              file=sys.stderr)
+    if bench_logger is not None:
+        from spark_rapids_tpu import events as EV
+
+        trace_path = os.path.join(
+            args.event_log, f"mesh-trace-{os.getpid()}.json")
+        EV.export_chrome_trace(bench_logger.records(), trace_path)
+        print(f"perfetto trace: {trace_path}", file=sys.stderr)
+    speeds = [r["speedup_vs_1dev"] for r in per_shape.values()
+              if r["speedup_vs_1dev"]]
+    geo = (math.exp(sum(math.log(s) for s in speeds) / len(speeds))
+           if speeds else None)
+    host_par = min(n_dev, os.cpu_count() or 1)
+    backend = jax.devices()[0].platform
+    print(json.dumps({
+        "metric": "mesh_scaling",
+        "n_devices": n_dev,
+        "backend": backend + (
+            "-host-fallback" if backend == "cpu" else ""),
+        "host_parallelism": host_par,
+        "scale": args.scale,
+        "per_shape": per_shape,
+        "agg_scaling_efficiency": (per_shape.get("agg") or {}).get(
+            "scaling_efficiency"),
+        "geomean_speedup_vs_1dev": round(geo, 3) if geo else None,
+        "forecast_violations": total_violations,
+        "ok": not total_violations,
+    }))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--shapes", type=str, default=",".join(SHAPES))
+    ap.add_argument(
+        "--mesh", type=int, default=0,
+        help="run the six shapes as SPMD plans over an N-device mesh and "
+             "report per-chip times + scaling efficiency vs a 1-device "
+             "mesh (the MULTICHIP_*.json payload); forces an N-device "
+             "virtual CPU mesh when no multi-chip accelerator is up")
     ap.add_argument(
         "--event-log", type=str, default="",
         help="directory for a structured JSONL event log of the bench run "
@@ -519,6 +934,12 @@ def main() -> None:
              "tools/tpu_profile.py, or --diff the emitted BENCH json "
              "against a previous round's")
     args = ap.parse_args()
+
+    if args.mesh:
+        # device-count flag must land before jax creates its CPU backend
+        _stage_mesh_env(args.mesh)
+        run_mesh_lane(args)
+        return
 
     from spark_rapids_tpu import types as T
     from spark_rapids_tpu.conf import RapidsConf

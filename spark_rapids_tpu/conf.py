@@ -218,7 +218,43 @@ MEMORY_DEBUG = conf(
 # ---------------------------------------------------------------------------
 SHUFFLE_MESH_SIZE = conf(
     "spark.rapids.tpu.shuffle.meshSize", 0,
-    "Number of devices in the exchange mesh (0 = all local devices).")
+    "Number of devices in the exchange mesh (0 = all local devices). "
+    "Superseded by spark.rapids.tpu.mesh.devices when both are set.")
+MESH_DEVICES = conf(
+    "spark.rapids.tpu.mesh.devices", 0,
+    "Shard count for SPMD mesh execution (parallel/mesh.get_mesh): caps "
+    "or forces how many local devices the mesh spans (0 = all). A value "
+    "above the visible device count raises at mesh construction instead "
+    "of silently truncating; meshes are memoized per count so every "
+    "stage at one width shares a single jax.sharding.Mesh.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+MESH_WHOLE_PLAN = conf(
+    "spark.rapids.tpu.shuffle.mesh.wholePlan.enabled", True,
+    "Absorb fixed-width filter/project chains between a mesh stage and "
+    "its source INTO the stage's SPMD program (the execs' lower_batch "
+    "hooks run per shard), and feed the program from a sharded scan "
+    "(io/mesh_stage.py) when the source supports it — the whole "
+    "scan->partial->all_to_all->final plan compiles to ONE jitted "
+    "program. Off restores the round-5 behavior: children execute on "
+    "the default device and staging gathers through the host.")
+MESH_EXCHANGE_BUCKET_FACTOR = conf(
+    "spark.rapids.tpu.shuffle.mesh.exchangeBucketFactor", 2.0,
+    "Mesh SORT exchange granule as a multiple of the fair per-target "
+    "share (cap / n_shards): sampled range bounds spread rows roughly "
+    "evenly, so a ~2x granule keeps the all_to_all receive surface "
+    "O(cap) instead of O(n_shards x cap); a skewed distribution "
+    "overflows the block and the stage retries with the granule "
+    "doubled. 0 disables (always-fits full-capacity granule).",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+MESH_AGG_EXCHANGE_CAP = conf(
+    "spark.rapids.tpu.shuffle.mesh.aggExchangeCapacity", 4096,
+    "Starting per-shard row capacity for the mesh aggregate's post-PARTIAL "
+    "all_to_all: partial aggregates are compacted and sliced to this many "
+    "groups per shard before crossing ICI, so the exchange surface is "
+    "sized to the GROUP cardinality, not the input row capacity (which "
+    "made the naive exchange O(shards x rows)). A shard with more groups "
+    "than the cap reports overflow and the stage retries with the cap "
+    "doubled (recompiling once per doubling).", check=_positive)
 AQE_ENABLED = conf(
     "spark.rapids.tpu.sql.adaptive.enabled", True,
     "Re-plan exchange reads from materialized per-partition stats: "

@@ -106,6 +106,18 @@ EVENT_TYPES: Dict[str, tuple] = {
     "alert": ("kind", "detail", "value", "threshold"),
 }
 
+#: OPTIONAL fields per event type — emitted only in specific contexts,
+#: absent otherwise (consumers must .get()). ``shard``: mesh SPMD stages
+#: stamp per-chip staging transfers and per-chip completion spans with
+#: the shard index; the Perfetto export renders those on '<op> [chip k]'
+#: tracks (chrome_trace below). Declared here so the schema registry
+#: stays the single source of truth for emitters AND consumers — a new
+#: optional field lands in this map, not as silent drift.
+EVENT_OPTIONAL_FIELDS: Dict[str, tuple] = {
+    "op_span": ("shard",),
+    "transfer": ("shard",),
+}
+
 
 class EventLogger:
     """Thread-safe typed event sink: ring buffer + optional JSONL file."""
@@ -273,13 +285,24 @@ def chrome_trace(records: List[dict]) -> dict:
         ev = r.get("event")
         ts = r["ts"]
         if ev == "op_span":
-            track = r["op"] + (" [device]" if r.get("lane") == "device"
-                               else "")
+            # a span with a ``shard`` gets its own per-chip track, so a
+            # mesh SPMD stage renders one lane per device (all 8 chips
+            # visible side by side); shard-less spans keep the host /
+            # [device] pair of tracks
+            shard = r.get("shard")
+            if shard is not None:
+                track = f"{r['op']} [chip {shard}]"
+            else:
+                track = r["op"] + (" [device]" if r.get("lane") == "device"
+                                   else "")
             name = r["op"] + (("." + r["section"]) if r.get("section")
                               else "")
+            args = {"lane": r["lane"]}
+            if shard is not None:
+                args["shard"] = shard
             out.append({"ph": "X", "pid": _PID, "tid": tid_of(track),
                         "name": name, "ts": us(r["start"]),
-                        "dur": r["dur"] / 1e3, "args": {"lane": r["lane"]}})
+                        "dur": r["dur"] / 1e3, "args": args})
         elif ev == "query_start":
             open_queries[r.get("query_id")] = r
         elif ev == "query_end":
@@ -301,7 +324,12 @@ def chrome_trace(records: List[dict]) -> dict:
                         "name": f"{r['kind']} {r['bytes']}B", "ts": us(ts),
                         "s": "t"})
         elif ev == "transfer":
-            out.append({"ph": "i", "pid": _PID, "tid": tid_of("transfers"),
+            # per-shard staging uploads land on their chip's transfer
+            # track so the sharded scan's upload pipeline is visible
+            shard = r.get("shard")
+            track = ("transfers" if shard is None
+                     else f"transfers [chip {shard}]")
+            out.append({"ph": "i", "pid": _PID, "tid": tid_of(track),
                         "name": f"{r['direction']} {r['bytes']}B "
                                 f"({r['site']})",
                         "ts": us(ts), "s": "t"})

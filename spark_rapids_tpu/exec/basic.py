@@ -71,6 +71,13 @@ class InMemoryScanExec(TpuExec):
         for b in self._partitions[index]:
             yield self.record_batch(b)
 
+    def partition_rows(self):
+        """Static per-partition row counts (batch num_rows are host ints)
+        — the plananalysis mesh forecast's input for host-staged sources."""
+        return [
+            sum(int(b.num_rows) for b in p) for p in self._partitions
+        ]
+
     @staticmethod
     def from_pydict(conf: RapidsConf, data, schema: StructType,
                     num_partitions: int = 1) -> "InMemoryScanExec":

@@ -1,5 +1,5 @@
-"""Mesh-lowered exchange stages: whole shuffle-bounded plan fragments as ONE
-shard_map program over the device mesh.
+"""Mesh-lowered SPMD stages: whole plan fragments as ONE shard_map program
+over the device mesh, fed by a sharded scan.
 
 Reference analog: the accelerated shuffle path the planner actually selects
 (RapidsShuffleInternalManager.scala:58-150 + the UCX transport): there, a
@@ -21,6 +21,18 @@ type-agnostic contract as the reference's UCX transport
 length per string column, so string GROUP KEYS must be direct column
 references (computed string keys have no staged bound and stay on the
 single-host exchange, as do binary columns).
+
+Whole-plan SPMD (round 6): a fixed-width filter/project chain between the
+stage and its source is ABSORBED into the shard_map program (the execs'
+own ``lower_batch`` hooks run per shard, exactly the single-device fused
+chain's seam), and a source exposing ``stage_mesh_planes`` (sharded scans:
+io/mesh_stage.py — in-memory shard sources, round-robined parquet row
+groups) feeds the program with per-shard committed device batches instead
+of the host-gathered staging path. The post-PARTIAL aggregate exchange is
+sliced to the group cardinality (``shuffle.mesh.aggExchangeCapacity`` +
+overflow retry) and the sort exchange granule to ~2x the fair share
+(``shuffle.mesh.exchangeBucketFactor``), so the all_to_all surface scales
+with what actually crosses the wire, not n_shards x input capacity.
 """
 from __future__ import annotations
 
@@ -30,17 +42,8 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-try:
-    from jax import shard_map as _shard_map_impl  # jax >= 0.6
-    _SM_KW = {"check_vma": False}
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map as _shard_map_impl
-    _SM_KW = {"check_rep": False}
 
-
-def shard_map(f, mesh, in_specs, out_specs, **_ignored):
-    return _shard_map_impl(
-        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **_SM_KW)
+from ..parallel.mesh import shard_map
 
 from .. import types as T
 from ..columnar import ColumnarBatch, DeviceColumn
@@ -65,24 +68,130 @@ def _np_of(arr) -> np.ndarray:
     return np.asarray(host_pull(arr))
 
 
+class StagedChild:
+    """What a mesh stage consumes: flat global planes + counts + layout,
+    the absorbed in-program chain steps, and the staging telemetry the
+    plananalysis cross-check compares against its forecast."""
+
+    __slots__ = ("cols", "counts", "cap", "layout", "smls", "steps",
+                 "staged_bytes", "source")
+
+    def __init__(self, cols, counts, cap, layout, smls, steps=(),
+                 staged_bytes=(), source="host"):
+        self.cols = cols
+        self.counts = counts
+        self.cap = cap
+        self.layout = layout
+        self.smls = smls
+        self.steps = tuple(steps)
+        self.staged_bytes = tuple(staged_bytes)
+        self.source = source
+
+    def steps_sig(self) -> tuple:
+        return tuple(s.fusion_key() for s in self.steps)
+
+
 class _MeshStage(TpuExec):
     """Base: stage child partitions onto the mesh, run one SPMD program,
     emit one output partition per shard."""
 
     def __init__(self, conf: RapidsConf, children: Sequence[TpuExec]):
         super().__init__(conf, children)
-        from ..conf import SHUFFLE_MESH_SIZE
-
-        self.mesh = get_mesh(conf.get(SHUFFLE_MESH_SIZE) or None)
+        self.mesh = get_mesh(conf=conf)
         self.n_shards = int(self.mesh.devices.size)
         self._outputs: Optional[List[Optional[ColumnarBatch]]] = None
+        #: staging/execution actuals per materialized child, keyed like the
+        #: plananalysis mesh forecast ("cap", "per_shard_rows",
+        #: "staged_bytes", "source") + "per_chip_ns"/"programs" run-wide —
+        #: the cross-check's measured side
+        self.mesh_actuals: dict = {}
 
     @property
     def num_partitions(self) -> int:
         return self.n_shards
 
+    def reset_for_rerun(self) -> None:
+        """Drop materialized outputs so the stage re-stages and re-runs
+        (the bench mesh lane times staging+execution per iteration; the
+        compiled SPMD program stays cached)."""
+        self._outputs = None
+
+    # -- whole-plan absorption --------------------------------------------
+    def _absorb_chain(self, child: TpuExec):
+        """Peel fixed-width filter/project execs off ``child`` so they run
+        INSIDE the shard_map program (their own ``lower_batch`` hooks —
+        the same seam the single-device fused chain uses). Absorption is
+        conservative: every schema the chain touches must be fixed-width
+        (string/dict columns keep the host-fed path, whose staging knows
+        their byte bounds) and each exec must be fusable (partition-
+        context expressions pin their project at the exec boundary).
+        Returns (base child, steps bottom-up)."""
+        from ..conf import MESH_WHOLE_PLAN
+        from .basic import TpuFilterExec, TpuProjectExec
+
+        if not self.conf.get(MESH_WHOLE_PLAN):
+            return child, ()
+        steps: List[TpuExec] = []
+        node = child
+        while isinstance(node, (TpuFilterExec, TpuProjectExec)):
+            if not getattr(node, "fusable", False):
+                break
+            below = node.children[0].output_schema
+            if not all(T.is_fixed_width(f.dataType)
+                       for f in node.output_schema.fields):
+                break
+            if not all(T.is_fixed_width(f.dataType) for f in below.fields):
+                break
+            steps.append(node)
+            node = node.children[0]
+        steps.reverse()
+        return node, tuple(steps)
+
+    @staticmethod
+    def _apply_steps(steps, cols, live, cap):
+        """Run absorbed chain steps per shard (trace-time). Returns
+        (cols, live-mask) — filters sparsify via the mask (the distributed
+        kernels take a mask as their row count), projects rewrite cols."""
+        for st in steps:
+            cols, live = st.lower_batch(cols, live, cap)
+        return cols, live
+
     # -- staging -----------------------------------------------------------
-    def _stage_child(self, child: TpuExec):
+    def _on_shard_staged(self, s: int, rows: int, nbytes: int,
+                         secs: float) -> None:
+        """Per-shard staging telemetry: the transfer event gains a shard
+        lane (Perfetto shows one upload track per chip) and the live
+        plane counts rows per device."""
+        from .. import events as EV
+        from .. import obs as _obs
+
+        if EV.enabled():
+            EV.emit("transfer", direction="h2d", bytes=nbytes,
+                    site="mesh_stage", shard=s)
+        if _obs.enabled():
+            _obs.inc("tpu_mesh_staged_rows", rows, device=str(s))
+            _obs.inc("tpu_transfer_bytes", nbytes, direction="h2d")
+
+    def _stage_child(self, child: TpuExec) -> StagedChild:
+        """Stage ``child`` onto the mesh: absorb the fixed-width chain,
+        then either the child's own sharded-scan path (no host gather) or
+        the generic host-gather staging."""
+        base, steps = self._absorb_chain(child)
+        fast = getattr(base, "stage_mesh_planes", None)
+        if fast is not None:
+            staged = fast(self.mesh, self.n_shards, self.conf,
+                          on_shard=self._on_shard_staged)
+            if staged is not None:
+                return StagedChild(
+                    list(staged.cols), staged.counts, staged.cap,
+                    staged.layout, staged.smls, steps,
+                    staged.staged_bytes, source="sharded_scan")
+        cols, counts, cap, layout, smls, staged_bytes = \
+            self._stage_host(base)
+        return StagedChild(cols, counts, cap, layout, smls, steps,
+                           staged_bytes, source="host")
+
+    def _stage_host(self, child: TpuExec):
         """Materialize every child partition and lay rows onto the mesh:
         returns (flat global arrays, per-shard counts, per-shard cap,
         layout, str_max_lens). Child partition p maps to shard p % n.
@@ -101,13 +210,6 @@ class _MeshStage(TpuExec):
         rows_per_shard = [
             sum(int(b.num_rows) for b in bs) for bs in per_shard
         ]
-        from .. import obs as _obs
-
-        if _obs.enabled():
-            # the per-chip lane of the live plane: how staging spread the
-            # input over the mesh (a skewed shard shows up immediately)
-            for s, r in enumerate(rows_per_shard):
-                _obs.inc("tpu_mesh_staged_rows", r, device=str(s))
         cap = bucket_rows(max(max(rows_per_shard), 1),
                           self.conf.shape_bucket_min)
         fields = schema.fields
@@ -188,7 +290,14 @@ class _MeshStage(TpuExec):
                 planes.extend([o, ch, v])
         sh = row_sharding(self.mesh)
         out = [jax.device_put(a.reshape(-1), sh) for a in planes]
-        return out, counts, cap, tuple(layout), tuple(smls)
+        # host-staged planes are uniform by construction: every shard's
+        # slice is the same 1/n_shards of each global plane
+        per_shard_bytes = sum(a.nbytes for a in planes) // self.n_shards
+        staged_bytes = (per_shard_bytes,) * self.n_shards
+        for s, r in enumerate(rows_per_shard):
+            # per-chip staging lane (a skewed shard shows up immediately)
+            self._on_shard_staged(s, r, staged_bytes[s], 0.0)
+        return out, counts, cap, tuple(layout), tuple(smls), staged_bytes
 
     @staticmethod
     def _cols_of_flat(colflat: Sequence[jax.Array], layout) -> List:
@@ -260,6 +369,108 @@ class _MeshStage(TpuExec):
             outs.append(ColumnarBatch(cols, schema, n))
         return outs
 
+    def forecast_mesh_staging(self, child: TpuExec) -> Optional[dict]:
+        """The plananalysis per-shard forecast for staging ``child``:
+        cap / per-shard rows / staged bytes, computed with the SAME
+        helpers the runtime staging paths use (io/mesh_stage) over the
+        same chain absorption and item→shard placement — so forecast and
+        actual can only diverge through a code change both sides see.
+        None when the source's row counts aren't statically known."""
+        from ..io import mesh_stage as MS
+
+        base, steps = self._absorb_chain(child)
+        items = None
+        fn = getattr(base, "mesh_stage_items", None)
+        if fn is not None:
+            items = fn()
+        source = "sharded_scan" if items is not None else "host"
+        if items is None:
+            pr = getattr(base, "partition_rows", None)
+            if pr is None:
+                return None
+            items = pr()
+            if items is None:
+                return None
+        assign = MS.round_robin(len(items), self.n_shards)
+        per_shard = [sum(items[i] for i in idxs) for idxs in assign]
+        cap = MS.mesh_shard_cap(per_shard, self.conf.shape_bucket_min)
+        fields = base.output_schema.fields
+        fixed = all(T.is_fixed_width(f.dataType) for f in fields)
+        return {
+            "source": source,
+            "n_shards": self.n_shards,
+            "cap": cap,
+            "per_shard_rows": per_shard,
+            "staged_bytes": (
+                [MS.shard_plane_bytes(cap, fields)] * self.n_shards
+                if fixed else None),
+            "absorbed_steps": [s.node_name for s in steps],
+            "columns": [
+                (f.name, f.dataType.simpleString) for f in fields
+            ],
+        }
+
+    def _record_staging(self, staged: StagedChild, which: str = "") -> None:
+        key = f"staging{('_' + which) if which else ''}"
+        self.mesh_actuals[key] = {
+            "cap": staged.cap,
+            "per_shard_rows": [int(c) for c in staged.counts],
+            "staged_bytes": list(staged.staged_bytes),
+            "source": staged.source,
+        }
+
+    def _record_run(self, outs, dispatch_ns: int) -> None:
+        """Per-chip completion lanes: block on each shard's output buffers
+        in shard order and emit one device-lane op_span per chip (track
+        '<op> [chip k]' in Perfetto). Polling is sequential, so each value
+        is an UPPER bound on that chip's completion — exact per-chip
+        device occupancy needs the device profiler; these lanes show skew
+        and make all n chips visible on the timeline."""
+        import time as _time
+
+        from .. import events as EV
+        from .. import obs as _obs
+
+        per_chip: List[int] = []
+        for s in range(self.n_shards):
+            for a in outs:
+                shards = getattr(a, "addressable_shards", None)
+                if shards is not None and s < len(shards):
+                    jax.block_until_ready(shards[s].data)
+            per_chip.append(_time.perf_counter_ns() - dispatch_ns)
+        self.mesh_actuals["per_chip_ns"] = per_chip
+        if EV.enabled():
+            for s, dur in enumerate(per_chip):
+                EV.emit("op_span", op=self.node_name, section="spmd",
+                        start=dispatch_ns, dur=dur, lane="device", shard=s)
+        if _obs.enabled():
+            for s, dur in enumerate(per_chip):
+                _obs.inc("tpu_mesh_shard_seconds", dur / 1e9,
+                         device=str(s))
+
+    def _note_program_miss(self) -> None:
+        self.mesh_actuals["programs"] = (
+            self.mesh_actuals.get("programs", 0) + 1)
+
+    # -- forecast hooks (plugin/plananalysis.forecast_mesh) ----------------
+    mesh_site = "mesh"
+
+    def mesh_program_bound(self, cap: int) -> int:
+        """Upper bound on compiled SPMD programs for one materialization
+        (1 + capacity-overflow retries). Subclasses with retry loops
+        override with the same doubling arithmetic the loop runs."""
+        return 1
+
+    @staticmethod
+    def _doubling_bound(start: int, cap: int) -> int:
+        """Programs a double-until-cap retry loop can compile: the first
+        attempt plus one per doubling until the cap disables slicing."""
+        n, g = 1, start
+        while 0 < g < cap:
+            g = min(g * 2, cap)
+            n += 1
+        return n
+
     def _materialize(self) -> None:
         raise NotImplementedError
 
@@ -278,11 +489,18 @@ class _MeshStage(TpuExec):
 _PROGRAM_CACHE: dict = {}
 
 
-def _cached_program(key, builder):
+def _cached_program(key, builder, site: Optional[str] = None,
+                    on_miss=None):
     fn = _PROGRAM_CACHE.get(key)
     if fn is None:
         if len(_PROGRAM_CACHE) > 256:
             _PROGRAM_CACHE.clear()
+        if site is not None:
+            from .base import note_compile_miss
+
+            note_compile_miss(site)
+        if on_miss is not None:
+            on_miss()
         fn = _PROGRAM_CACHE[key] = builder()
     return fn
 
@@ -325,9 +543,23 @@ class TpuMeshAggregateExec(_MeshStage):
         keys = ", ".join(str(k) for k in self.group_exprs)
         return f"TpuMeshAggregateExec(mesh={self.n_shards}, keys=[{keys}])"
 
+    mesh_site = "mesh_agg"
+
+    def mesh_program_bound(self, cap: int) -> int:
+        from ..conf import MESH_AGG_EXCHANGE_CAP
+
+        g = min(bucket_rows(self.conf.get(MESH_AGG_EXCHANGE_CAP),
+                            self.conf.shape_bucket_min), cap)
+        return self._doubling_bound(g, cap)
+
     def _materialize(self) -> None:
+        import time as _time
+
         child = self.children[0]
-        global_cols, counts, cap, layout, smls = self._stage_child(child)
+        staged = self._stage_child(child)
+        self._record_staging(staged)
+        global_cols, counts, cap = staged.cols, staged.counts, staged.cap
+        layout, smls, steps = staged.layout, staged.smls, staged.steps
         nk = len(self._key_fields)
         key_dtypes = list(self._key_dtypes())
         bound_keys = tuple(self._bound_keys)
@@ -340,62 +572,92 @@ class TpuMeshAggregateExec(_MeshStage):
         n_shards = self.n_shards
         mesh = self.mesh
         # static byte bound per STRING group key: the referenced source
-        # column's staged max (planner gates string keys to direct refs)
+        # column's staged max (planner gates string keys to direct refs;
+        # absorbed chains are fixed-width so smls stay aligned)
         key_smls = tuple(
             smls[b.ordinal]
             for b in bound_keys
             if isinstance(b, E.BoundReference) and T.is_string(b.dtype)
+            and not steps and b.ordinal < len(smls)
         )
-        out_layouts: dict = {}
+        # post-PARTIAL exchange capacity: slice the partial output to the
+        # group cardinality before it crosses ICI (overflow retries with
+        # the cap doubled; string keys disable slicing inside dist_groupby)
+        from ..conf import MESH_AGG_EXCHANGE_CAP
 
-        def build():
-            def shard_fn(*flat):
-                *colflat, cnt = flat
-                cols = self._cols_of_flat(colflat, layout)
-                n = cnt[0]
-                keys = [lower(b, cols, cap) for b in bound_keys]
-                vals = [
-                    None if e is None else lower(e, cols, cap)
-                    for e in update_exprs
-                ]
-                rkeys, raggs, rn = D.dist_groupby(
-                    keys, key_dtypes, vals, list(update_ops),
-                    list(merge_ops), n, AXIS, n_shards,
-                    str_max_lens=key_smls)
-                # result projection over [keys..., buffers...], per shard
-                allv = list(rkeys) + list(raggs)
-                rcap = allv[0].validity.shape[0] if allv else 1
-                exprs: List[E.Expression] = [
-                    E.BoundReference(i, f.dataType, f.nullable)
-                    for i, f in enumerate(self._key_fields)
-                ]
-                for f, (s, e) in zip(bound_funcs, buf_slices):
-                    refs = tuple(
-                        E.BoundReference(nk + j, buf_fields[j].dataType, True)
-                        for j in range(s, e)
-                    )
-                    exprs.append(f.evaluate(refs))
-                outs = [lower(x, allv, rcap) for x in exprs]
-                flat_out, out_lay = self._flatten_vals(outs)
-                out_layouts["lay"] = out_lay
-                flat_out.append(rn.reshape(1))
-                return tuple(flat_out)
+        gcap = min(
+            bucket_rows(self.conf.get(MESH_AGG_EXCHANGE_CAP),
+                        self.conf.shape_bucket_min),
+            cap)
+        if key_smls or any(lay[0] != "f" for lay in layout):
+            gcap = 0  # strings cross at full capacity (no slicing)
 
-            nin = len(global_cols)
-            fn = shard_map(
-                shard_fn, mesh=mesh,
-                in_specs=tuple([P(AXIS)] * nin + [P(AXIS)]),
-                out_specs=P(AXIS),
-            )
-            return jax.jit(fn), out_layouts
+        while True:
+            out_layouts: dict = {}
+            group_cap = 0 if gcap >= cap else gcap
 
-        sig = tuple((str(a.dtype), a.shape) for a in global_cols)
-        fn, out_layouts = _cached_program(
-            ("agg", self.fusion_sig(), sig, cap, n_shards, key_smls), build)
-        cnt_in = jax.device_put(
-            np.asarray(counts, np.int32), row_sharding(mesh))
-        res = fn(*global_cols, cnt_in)
-        *out_cols, out_counts = res
+            def build(group_cap=group_cap, out_layouts=out_layouts):
+                def shard_fn(*flat):
+                    *colflat, cnt = flat
+                    cols = self._cols_of_flat(colflat, layout)
+                    n = cnt[0]
+                    live = jnp.arange(cap, dtype=jnp.int32) < n
+                    cols, live = self._apply_steps(steps, cols, live, cap)
+                    keys = [lower(b, cols, cap) for b in bound_keys]
+                    vals = [
+                        None if e is None else lower(e, cols, cap)
+                        for e in update_exprs
+                    ]
+                    rkeys, raggs, rn, ok = D.dist_groupby(
+                        keys, key_dtypes, vals, list(update_ops),
+                        list(merge_ops), live, AXIS, n_shards,
+                        str_max_lens=key_smls, group_cap=group_cap)
+                    # result projection over [keys..., buffers...] per shard
+                    allv = list(rkeys) + list(raggs)
+                    rcap = allv[0].validity.shape[0] if allv else 1
+                    exprs: List[E.Expression] = [
+                        E.BoundReference(i, f.dataType, f.nullable)
+                        for i, f in enumerate(self._key_fields)
+                    ]
+                    for f, (s, e) in zip(bound_funcs, buf_slices):
+                        refs = tuple(
+                            E.BoundReference(
+                                nk + j, buf_fields[j].dataType, True)
+                            for j in range(s, e)
+                        )
+                        exprs.append(f.evaluate(refs))
+                    outs = [lower(x, allv, rcap) for x in exprs]
+                    flat_out, out_lay = self._flatten_vals(outs)
+                    out_layouts["lay"] = out_lay
+                    flat_out.append(rn.reshape(1))
+                    flat_out.append(ok.reshape(1))
+                    return tuple(flat_out)
+
+                nin = len(global_cols)
+                fn = shard_map(
+                    shard_fn, mesh=mesh,
+                    in_specs=tuple([P(AXIS)] * nin + [P(AXIS)]),
+                    out_specs=P(AXIS),
+                )
+                return jax.jit(fn), out_layouts
+
+            sig = tuple((str(a.dtype), a.shape) for a in global_cols)
+            fn, out_layouts = _cached_program(
+                ("agg", self.fusion_sig(), staged.steps_sig(), sig, cap,
+                 n_shards, key_smls, group_cap),
+                build, site="mesh_agg", on_miss=self._note_program_miss)
+            cnt_in = jax.device_put(
+                np.asarray(counts, np.int32), row_sharding(mesh))
+            t0 = _time.perf_counter_ns()
+            res = fn(*global_cols, cnt_in)
+            *out_cols, out_counts, oks = res
+            if group_cap == 0 or bool(np.all(_np_of(oks))):
+                self._record_run(list(out_cols) + [out_counts], t0)
+                self.mesh_actuals["exchange_cap"] = group_cap or cap
+                break
+            # a shard had more groups than the exchange cap: double it
+            # (the aggregate analog of the join's output-capacity retry)
+            gcap = min(gcap * 2, cap)
         out_lay = out_layouts.get("lay") or tuple(
             ("s",) if T.is_string(f.dataType) else ("f",)
             for f in self._schema.fields)
@@ -426,9 +688,26 @@ class TpuMeshSortExec(_MeshStage):
     def output_schema(self):
         return self._schema
 
+    mesh_site = "mesh_sort"
+
+    def mesh_program_bound(self, cap: int) -> int:
+        from ..conf import MESH_EXCHANGE_BUCKET_FACTOR
+
+        factor = self.conf.get(MESH_EXCHANGE_BUCKET_FACTOR)
+        if factor <= 0 or self.n_shards <= 1:
+            return 1
+        b = min(bucket_rows(max(int(cap * factor / self.n_shards), 1),
+                            self.conf.shape_bucket_min), cap)
+        return self._doubling_bound(b, cap)
+
     def _materialize(self) -> None:
+        import time as _time
+
         child = self.children[0]
-        global_cols, counts, cap, layout, smls = self._stage_child(child)
+        staged = self._stage_child(child)
+        self._record_staging(staged)
+        global_cols, counts, cap = staged.cols, staged.counts, staged.cap
+        layout, smls, steps = staged.layout, staged.smls, staged.steps
         key_dtypes = [
             self._schema.fields[i].dataType for i in self.key_indices
         ]
@@ -436,36 +715,188 @@ class TpuMeshSortExec(_MeshStage):
         key_ix, orders = list(self.key_indices), list(self.orders)
         key_smls = tuple(
             smls[i] for i in key_ix
-            if T.is_string(self._schema.fields[i].dataType))
-        out_layouts: dict = {}
+            if T.is_string(self._schema.fields[i].dataType) and not steps
+            and i < len(smls))
+        # exchange granule: the sampled range bounds spread rows roughly
+        # evenly, so ~factor x fair share per target keeps the receive
+        # surface O(cap) instead of O(n_shards x cap); skew overflows the
+        # block and retries with the granule doubled
+        from ..conf import MESH_EXCHANGE_BUCKET_FACTOR
 
-        def build():
-            def shard_fn(*flat):
-                *colflat, cnt = flat
-                cols = self._cols_of_flat(colflat, layout)
-                out, rn = D.dist_sort(
-                    cols, key_ix, key_dtypes, orders, cnt[0], AXIS, n_shards,
-                    str_max_lens=key_smls)
-                flat_out, out_lay = self._flatten_vals(out)
-                out_layouts["lay"] = out_lay
-                flat_out.append(rn.reshape(1))
-                return tuple(flat_out)
+        factor = self.conf.get(MESH_EXCHANGE_BUCKET_FACTOR)
+        bcap = 0
+        if factor > 0 and n_shards > 1 and all(
+                lay[0] == "f" for lay in layout):
+            bcap = min(
+                bucket_rows(max(int(cap * factor / n_shards), 1),
+                            self.conf.shape_bucket_min),
+                cap)
 
-            nin = len(global_cols)
-            return jax.jit(shard_map(
-                shard_fn, mesh=mesh,
-                in_specs=tuple([P(AXIS)] * (nin + 1)),
-                out_specs=P(AXIS))), out_layouts
+        while True:
+            out_layouts: dict = {}
+            bucket_cap = 0 if bcap >= cap else bcap
 
-        sig = tuple((str(a.dtype), a.shape) for a in global_cols)
-        fn, out_layouts = _cached_program(
-            ("sort", tuple(key_ix), tuple((o.ascending, o.nulls_first)
-                                          for o in orders), sig, n_shards,
-             key_smls),
-            build)
-        cnt_in = jax.device_put(np.asarray(counts, np.int32), row_sharding(mesh))
-        res = fn(*global_cols, cnt_in)
-        *out_cols, out_counts = res
+            def build(bucket_cap=bucket_cap, out_layouts=out_layouts):
+                def shard_fn(*flat):
+                    *colflat, cnt = flat
+                    cols = self._cols_of_flat(colflat, layout)
+                    live = jnp.arange(cap, dtype=jnp.int32) < cnt[0]
+                    cols, live = self._apply_steps(steps, cols, live, cap)
+                    out, rn, ok = D.dist_sort(
+                        cols, key_ix, key_dtypes, orders, live, AXIS,
+                        n_shards, str_max_lens=key_smls,
+                        bucket_cap=bucket_cap)
+                    flat_out, out_lay = self._flatten_vals(out)
+                    out_layouts["lay"] = out_lay
+                    flat_out.append(rn.reshape(1))
+                    flat_out.append(ok.reshape(1))
+                    return tuple(flat_out)
+
+                nin = len(global_cols)
+                return jax.jit(shard_map(
+                    shard_fn, mesh=mesh,
+                    in_specs=tuple([P(AXIS)] * (nin + 1)),
+                    out_specs=P(AXIS))), out_layouts
+
+            sig = tuple((str(a.dtype), a.shape) for a in global_cols)
+            fn, out_layouts = _cached_program(
+                ("sort", tuple(key_ix),
+                 tuple((o.ascending, o.nulls_first) for o in orders),
+                 staged.steps_sig(), sig, n_shards, key_smls, bucket_cap),
+                build, site="mesh_sort", on_miss=self._note_program_miss)
+            cnt_in = jax.device_put(
+                np.asarray(counts, np.int32), row_sharding(mesh))
+            t0 = _time.perf_counter_ns()
+            res = fn(*global_cols, cnt_in)
+            *out_cols, out_counts, oks = res
+            if bucket_cap == 0 or bool(np.all(_np_of(oks))):
+                self._record_run(list(out_cols) + [out_counts], t0)
+                self.mesh_actuals["exchange_cap"] = bucket_cap or cap
+                break
+            bcap = min(bcap * 2, cap)
+        out_lay = out_layouts.get("lay") or tuple(
+            ("s",) if T.is_string(f.dataType) else ("f",)
+            for f in self._schema.fields)
+        self._outputs = self._emit(
+            self._schema, list(out_cols), _np_of(out_counts), 0,
+            layout=out_lay)
+
+
+class TpuMeshWindowExec(_MeshStage):
+    """hash all_to_all on the PARTITION keys -> per-shard window, one SPMD
+    program (reference plan: GpuShuffleExchangeExec(HashPartitioning)
+    feeding GpuWindowExec). Window partitions are independent, so placing
+    every row of a partition key on one shard preserves exact semantics;
+    the per-shard body is the SAME traceable window kernel the
+    single-device exec jits (exec/window.TpuWindowExec.window_fn — one
+    radix sort + O(n) scans). Fixed-width columns with direct
+    partition-key references only (the planner gates)."""
+
+    def __init__(self, conf, window_exprs, child):
+        _MeshStage.__init__(self, conf, [child])
+        from .window import TpuWindowExec
+
+        self._plan = TpuWindowExec(conf, window_exprs, child)
+        self._schema = self._plan.output_schema
+        self._part_ords = [b.ordinal for b in self._plan._part_keys]
+        self._part_dtypes = [b.dtype for b in self._plan._part_keys]
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def describe(self):
+        names = ", ".join(
+            we.resolved_name() for we in self._plan.window_exprs)
+        return f"TpuMeshWindowExec(mesh={self.n_shards}, [{names}])"
+
+    mesh_site = "mesh_window"
+
+    def mesh_program_bound(self, cap: int) -> int:
+        from ..conf import MESH_EXCHANGE_BUCKET_FACTOR
+
+        factor = self.conf.get(MESH_EXCHANGE_BUCKET_FACTOR)
+        if factor <= 0 or self.n_shards <= 1:
+            return 1
+        b = min(bucket_rows(max(int(cap * factor / self.n_shards), 1),
+                            self.conf.shape_bucket_min), cap)
+        return self._doubling_bound(b, cap)
+
+    def _materialize(self) -> None:
+        import time as _time
+
+        from ..ops import hashing
+        from ..parallel.collective import all_to_all_exchange
+
+        child = self.children[0]
+        staged = self._stage_child(child)
+        self._record_staging(staged)
+        global_cols, counts, cap = staged.cols, staged.counts, staged.cap
+        layout, steps = staged.layout, staged.steps
+        n_shards, mesh = self.n_shards, self.mesh
+        part_ords = list(self._part_ords)
+        part_dtypes = list(self._part_dtypes)
+        window_fn = self._plan.window_fn
+        from ..conf import MESH_EXCHANGE_BUCKET_FACTOR
+
+        factor = self.conf.get(MESH_EXCHANGE_BUCKET_FACTOR)
+        bcap = 0
+        if factor > 0 and n_shards > 1 and all(
+                lay[0] == "f" for lay in layout):
+            bcap = min(
+                bucket_rows(max(int(cap * factor / n_shards), 1),
+                            self.conf.shape_bucket_min),
+                cap)
+
+        while True:
+            out_layouts: dict = {}
+            bucket_cap = 0 if bcap >= cap else bcap
+
+            def build(bucket_cap=bucket_cap, out_layouts=out_layouts):
+                def shard_fn(*flat):
+                    *colflat, cnt = flat
+                    cols = self._cols_of_flat(colflat, layout)
+                    live = jnp.arange(cap, dtype=jnp.int32) < cnt[0]
+                    cols, live = self._apply_steps(steps, cols, live, cap)
+                    kc = [cols[i] for i in part_ords]
+                    h = hashing.murmur3(kc, part_dtypes)
+                    pids = hashing.partition_ids(h, n_shards)
+                    recvd, rn, ok = all_to_all_exchange(
+                        cols, pids, live, AXIS, n_shards,
+                        bucket_cap=bucket_cap)
+                    rcap = recvd[0].validity.shape[0]
+                    out = window_fn(rcap, ())(recvd, rn)
+                    flat_out, out_lay = self._flatten_vals(out)
+                    out_layouts["lay"] = out_lay
+                    flat_out.append(rn.reshape(1))
+                    flat_out.append(ok.reshape(1))
+                    return tuple(flat_out)
+
+                nin = len(global_cols)
+                return jax.jit(shard_map(
+                    shard_fn, mesh=mesh,
+                    in_specs=tuple([P(AXIS)] * (nin + 1)),
+                    out_specs=P(AXIS))), out_layouts
+
+            sig = tuple((str(a.dtype), a.shape) for a in global_cols)
+            fn, out_layouts = _cached_program(
+                ("window", tuple(part_ords),
+                 repr(tuple(self._plan._bound_funcs)),
+                 repr(tuple(self._plan._order_keys)),
+                 tuple((o.ascending, o.nulls_first)
+                       for o in self._plan._orders),
+                 staged.steps_sig(), sig, n_shards, bucket_cap),
+                build, site="mesh_window", on_miss=self._note_program_miss)
+            cnt_in = jax.device_put(
+                np.asarray(counts, np.int32), row_sharding(mesh))
+            t0 = _time.perf_counter_ns()
+            res = fn(*global_cols, cnt_in)
+            *out_cols, out_counts, oks = res
+            if bucket_cap == 0 or bool(np.all(_np_of(oks))):
+                self._record_run(list(out_cols) + [out_counts], t0)
+                self.mesh_actuals["exchange_cap"] = bucket_cap or cap
+                break
+            bcap = min(bcap * 2, cap)
         out_lay = out_layouts.get("lay") or tuple(
             ("s",) if T.is_string(f.dataType) else ("f",)
             for f in self._schema.fields)
@@ -495,10 +926,26 @@ class TpuMeshHashJoinExec(_MeshStage):
     def output_schema(self):
         return self._schema
 
+    mesh_site = "mesh_join"
+
+    def mesh_program_bound(self, cap: int) -> int:
+        return 8  # the output-capacity retry limit of _materialize
+
     def _materialize(self) -> None:
+        import time as _time
+
         left, right = self.children
-        l_cols, l_counts, lcap, llay, lsml = self._stage_child(left)
-        r_cols, r_counts, rcap, rlay, rsml = self._stage_child(right)
+        lstaged = self._stage_child(left)
+        rstaged = self._stage_child(right)
+        self._record_staging(lstaged, "left")
+        self._record_staging(rstaged, "right")
+        l_cols, l_counts, lcap = lstaged.cols, lstaged.counts, lstaged.cap
+        llay, lsml, lsteps = lstaged.layout, lstaged.smls, lstaged.steps
+        r_cols, r_counts, rcap = rstaged.cols, rstaged.counts, rstaged.cap
+        rlay, rsml, rsteps = rstaged.layout, rstaged.smls, rstaged.steps
+        if lsteps or rsteps:
+            lsml = tuple(0 for _ in left.output_schema.fields)
+            rsml = tuple(0 for _ in right.output_schema.fields)
         n_shards, mesh = self.n_shards, self.mesh
         l_ix, r_ix, kd = list(self.left_ix), list(self.right_ix), list(
             self._key_dtypes)
@@ -520,12 +967,33 @@ class TpuMeshHashJoinExec(_MeshStage):
             [lay[1] * n_shards for lay in llay if lay[0] == "s"]
             + [lay[1] * n_shards for lay in rlay if lay[0] == "s"])
         ccap_scale = 1
+        # per-side exchange granule (~factor x fair share): hash
+        # partitioning spreads keys evenly, so the receive surface stays
+        # O(cap); a skewed side overflows and the retry below doubles the
+        # granule along with the output capacity
+        from ..conf import MESH_EXCHANGE_BUCKET_FACTOR
+
+        factor = self.conf.get(MESH_EXCHANGE_BUCKET_FACTOR)
+
+        def bcap_of(cap_side, lay):
+            if factor <= 0 or n_shards <= 1 or any(
+                    L[0] != "f" for L in lay):
+                return 0
+            return min(
+                bucket_rows(max(int(cap_side * factor / n_shards), 1),
+                            self.conf.shape_bucket_min),
+                cap_side)
+
+        l_bcap = bcap_of(lcap, llay)
+        r_bcap = bcap_of(rcap, rlay)
 
         for attempt in range(8):
+            xcaps = (0 if l_bcap >= lcap else l_bcap,
+                     0 if r_bcap >= rcap else r_bcap)
             out_ccaps = tuple(
                 bucket_rows(c * ccap_scale, 128) for c in base_ccaps)
 
-            def build(out_cap=out_cap, out_ccaps=out_ccaps):
+            def build(out_cap=out_cap, out_ccaps=out_ccaps, xcaps=xcaps):
                 def shard_fn(*flat):
                     nlp = sum(2 if lay[0] == "f" else 3 for lay in llay)
                     lflat = flat[:nlp]
@@ -533,11 +1001,25 @@ class TpuMeshHashJoinExec(_MeshStage):
                     lcnt, rcnt = flat[-2], flat[-1]
                     lc = self._cols_of_flat(lflat, llay)
                     rc = self._cols_of_flat(rflat, rlay)
+                    ln_, rn_ = lcnt[0], rcnt[0]
+                    if lsteps:
+                        from ..ops.filter_gather import filter_cols
+
+                        live = jnp.arange(lcap, dtype=jnp.int32) < ln_
+                        lc, live = self._apply_steps(lsteps, lc, live, lcap)
+                        lc, ln_ = filter_cols(lc, live, None)
+                    if rsteps:
+                        from ..ops.filter_gather import filter_cols
+
+                        live = jnp.arange(rcap, dtype=jnp.int32) < rn_
+                        rc, live = self._apply_steps(rsteps, rc, live, rcap)
+                        rc, rn_ = filter_cols(rc, live, None)
                     out, cnt, ok = D.dist_hash_join(
-                        lc, l_ix, rc, r_ix, kd, lcnt[0], rcnt[0],
+                        lc, l_ix, rc, r_ix, kd, ln_, rn_,
                         AXIS, n_shards, out_cap,
                         key_str_max_lens=key_smls,
-                        out_char_caps=out_ccaps)
+                        out_char_caps=out_ccaps,
+                        exchange_bucket_caps=xcaps)
                     flat_out, out_lay = self._flatten_vals(out)
                     out_layouts["lay"] = out_lay
                     flat_out.append(cnt.reshape(1))
@@ -556,15 +1038,18 @@ class TpuMeshHashJoinExec(_MeshStage):
                 tuple((str(a.dtype), a.shape) for a in r_cols),
             )
             fn, out_layouts = _cached_program(
-                ("join", tuple(l_ix), tuple(r_ix), sig, out_cap, n_shards,
-                 key_smls, out_ccaps),
-                build)
+                ("join", tuple(l_ix), tuple(r_ix),
+                 lstaged.steps_sig(), rstaged.steps_sig(), sig, out_cap,
+                 n_shards, key_smls, out_ccaps, xcaps),
+                build, site="mesh_join", on_miss=self._note_program_miss)
             sh = row_sharding(mesh)
+            t0 = _time.perf_counter_ns()
             res = fn(*l_cols, *r_cols,
                      jax.device_put(np.asarray(l_counts, np.int32), sh),
                      jax.device_put(np.asarray(r_counts, np.int32), sh))
             *out_cols, out_counts, oks = res
             if bool(np.all(_np_of(oks))):
+                self._record_run(list(out_cols) + [out_counts], t0)
                 out_lay = out_layouts.get("lay") or tuple(
                     ("s",) if T.is_string(f.dataType) else ("f",)
                     for f in self._schema.fields)
@@ -572,10 +1057,16 @@ class TpuMeshHashJoinExec(_MeshStage):
                     self._schema, list(out_cols), _np_of(out_counts), 0,
                     layout=out_lay)
                 return
-            # overflow: double the per-shard output capacity and recompile
+            # overflow: double the per-shard output capacity AND the
+            # exchange granules and recompile — the ok flag does not say
+            # which surface overflowed, so every capacity grows together
             # (the reference's bounce-buffer windowing retries similarly)
             out_cap *= 2
             ccap_scale *= 2
+            if l_bcap:
+                l_bcap = min(l_bcap * 2, lcap)
+            if r_bcap:
+                r_bcap = min(r_bcap * 2, rcap)
         raise RuntimeError("mesh join output capacity retry limit exceeded")
 
 

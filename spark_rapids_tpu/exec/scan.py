@@ -48,6 +48,102 @@ def constant_string_column(value, n: int, cap: int) -> DeviceColumn:
         offsets=jnp.asarray(offsets), chars=jnp.asarray(chars))
 
 
+class MeshShardedScanExec(TpuExec):
+    """Leaf over PER-SHARD host column arrays — the decoded form a
+    data-parallel scan hands the mesh. Partition ``i`` is shard ``i``'s
+    data: ``stage_mesh_planes`` uploads it straight to mesh device
+    ``i % n`` as that device's slice of a NamedSharding-committed global
+    array (io/mesh_stage.stage_sharded — no host gather, decode of shard
+    k+1 overlapping the upload of shard k). Off-mesh execution builds
+    ordinary device batches, so the same exec drives the 1-device
+    baseline of the bench mesh lane.
+
+    ``parts``: one entry per partition — a list of (data, validity)
+    numpy pairs (schema order) plus the live row count."""
+
+    def __init__(self, conf: RapidsConf, parts, schema: StructType):
+        super().__init__(conf)
+        self._parts = [
+            (list(arrays), int(rows)) for arrays, rows in parts
+        ]
+        self._schema = schema
+
+    @property
+    def output_schema(self) -> StructType:
+        return self._schema
+
+    @property
+    def num_partitions(self) -> int:
+        return max(1, len(self._parts))
+
+    def describe(self):
+        return f"MeshShardedScanExec({len(self._parts)} shard parts)"
+
+    def partition_rows(self):
+        """Static per-partition row counts (the plananalysis mesh
+        forecast's input)."""
+        return [rows for _, rows in self._parts]
+
+    def mesh_stage_items(self):
+        """Per-item row counts the sharded-scan staging will round-robin
+        (None = the fast path would decline; forecast mirrors runtime)."""
+        from ..io import mesh_stage as MS
+
+        if not MS.stageable_schema(self._schema):
+            return None
+        return self.partition_rows()
+
+    def stage_mesh_planes(self, mesh, n_shards: int, conf, on_shard=None):
+        from ..io import mesh_stage as MS
+
+        if not MS.stageable_schema(self._schema):
+            return None
+        assign = MS.round_robin(len(self._parts), n_shards)
+        rows_per_shard = [
+            sum(self._parts[i][1] for i in idxs) for idxs in assign
+        ]
+
+        def decode_shard(s: int) -> "MS.ShardPayload":
+            arrays = []
+            total = rows_per_shard[s]
+            for j, f in enumerate(self._schema.fields):
+                dt = f.dataType.to_numpy()
+                d = np.empty(total, dt)
+                v = np.empty(total, bool)
+                pos = 0
+                for i in assign[s]:
+                    part, rows = self._parts[i]
+                    data, valid = part[j]
+                    d[pos:pos + rows] = data[:rows]
+                    v[pos:pos + rows] = valid[:rows]
+                    pos += rows
+                arrays.append((d, v))
+            return MS.ShardPayload(arrays, total)
+
+        return MS.stage_sharded(
+            mesh, n_shards, self._schema, decode_shard, rows_per_shard,
+            self.conf.shape_bucket_min, on_shard=on_shard)
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        import jax.numpy as jnp
+
+        if index >= len(self._parts):
+            return
+        arrays, n = self._parts[index]
+        if n == 0:
+            return
+        cap = choose_capacity(max(1, n))
+        cols = []
+        for f, (data, valid) in zip(self._schema.fields, arrays):
+            d = np.zeros(cap, f.dataType.to_numpy())
+            v = np.zeros(cap, bool)
+            d[:n] = data[:n]
+            v[:n] = valid[:n]
+            cols.append(DeviceColumn(
+                f.dataType, n, jnp.asarray(d), jnp.asarray(v)))
+        yield self.record_batch(ColumnarBatch(cols, self._schema, n))
+
+
 class TpuFileSourceScanExec(TpuExec):
     """Columnar scan over a file scanner's splits (one split = one
     partition; the MULTITHREADED reader prefetches neighbors)."""
@@ -108,6 +204,118 @@ class TpuFileSourceScanExec(TpuExec):
         for k in pkeys:
             cols.append(constant_string_column(pmap.get(k), n, cap))
         return ColumnarBatch(cols, schema, n)
+
+    def _mesh_row_groups(self):
+        """Flat (path, row_group, rows) list for mesh round-robin — the
+        sharded scan places row group i on shard i % n. None when the
+        scanner's splits don't expose row groups (csv) or a row group's
+        metadata is unreadable."""
+        splits = getattr(self.scanner, "splits", None)
+        if splits is None:
+            return None
+        try:
+            import pyarrow.parquet as pq
+
+            out = []
+            mds = {}
+            for sp in splits():
+                rgs = getattr(sp, "row_groups", None)
+                if rgs is None:
+                    return None
+                md = mds.get(sp.path)
+                if md is None:
+                    md = mds[sp.path] = pq.ParquetFile(sp.path).metadata
+                for rg in rgs:
+                    out.append((sp.path, rg, md.row_group(rg).num_rows))
+            return out
+        except Exception:
+            return None
+
+    def stage_mesh_planes(self, mesh, n_shards: int, conf, on_shard=None):
+        """Data-parallel parquet ingestion: row groups round-robined
+        across mesh shards, each shard's groups host-decoded on a worker
+        thread while the previous shard's padded planes upload to ITS
+        device (io/mesh_stage.stage_sharded) — PR 7's decode→upload
+        pipeline extended across devices. Fixed-width file columns only
+        (partition-value columns are strings and keep the generic path).
+        Reads bypass the device scan cache: the cache holds default-
+        device batches, which would have to cross devices again."""
+        from ..io import mesh_stage as MS
+
+        if getattr(self.scanner, "partition_cols", None):
+            return None
+        schema = self.output_schema
+        if not MS.stageable_schema(schema):
+            return None
+        rgs = self._mesh_row_groups()
+        if rgs is None:
+            return None
+        assign = MS.round_robin(len(rgs), n_shards)
+        rows_per_shard = [
+            sum(rgs[i][2] for i in idxs) for idxs in assign
+        ]
+        columns = [f.name for f in schema.fields]
+
+        def decode_shard(s: int) -> "MS.ShardPayload":
+            import pyarrow.parquet as pq
+
+            from ..io.arrow_convert import _np_from_arrow_array
+
+            with self.op_timed("mesh_decode", DECODE_TIME):
+                by_path = {}
+                for i in assign[s]:
+                    path, rg, _ = rgs[i]
+                    by_path.setdefault(path, []).append(rg)
+                tables = [
+                    pq.ParquetFile(p).read_row_groups(g, columns=columns)
+                    for p, g in by_path.items()
+                ]
+                total = rows_per_shard[s]
+                arrays = []
+                for j, f in enumerate(schema.fields):
+                    d = np.empty(total, f.dataType.to_numpy())
+                    v = np.empty(total, bool)
+                    pos = 0
+                    for t in tables:
+                        arr = t.column(j).combine_chunks()
+                        data, valid = _np_from_arrow_array(arr, f.dataType)
+                        n = len(t)
+                        d[pos:pos + n] = data[:n]
+                        v[pos:pos + n] = valid[:n]
+                        pos += n
+                    arrays.append((d, v))
+            return MS.ShardPayload(arrays, total)
+
+        return MS.stage_sharded(
+            mesh, n_shards, schema, decode_shard, rows_per_shard,
+            self.conf.shape_bucket_min, on_shard=on_shard)
+
+    def partition_rows(self):
+        """Static per-split row counts from parquet metadata (None when
+        unknowable) — the plananalysis mesh forecast's input."""
+        rgs = self._mesh_row_groups()
+        if rgs is None:
+            return None
+        per = [0] * self.scanner.num_splits()
+        for i, sp in enumerate(self.scanner.splits()):
+            per[i] = sum(r for p, rg, r in rgs
+                         if p == sp.path and rg in sp.row_groups)
+        return per
+
+    def mesh_stage_items(self):
+        """Per-ROW-GROUP rows the sharded scan round-robins (the mesh
+        forecast's mirror of stage_mesh_planes' eligibility + placement;
+        None = the fast path would decline)."""
+        from ..io import mesh_stage as MS
+
+        if getattr(self.scanner, "partition_cols", None):
+            return None
+        if not MS.stageable_schema(self.output_schema):
+            return None
+        rgs = self._mesh_row_groups()
+        if rgs is None:
+            return None
+        return [r for _, _, r in rgs]
 
     def fused_stage_plans(self, index: int):
         """Stage fusion: hand the consumer exec the traced per-row-group

@@ -116,6 +116,29 @@ class TpuWindowExec(TpuExec):
         cap = batch.capacity
         all_keys = self._part_keys + self._order_keys
         sml = self._str_lens(batch, all_keys)
+        run = self.window_fn(cap, sml)
+        key = (batch_signature(batch), cap, sml)
+        if key not in self._jits:
+            from .base import note_compile_miss
+
+            note_compile_miss("window")
+            self._jits[key] = jax.jit(run)
+        with self.op_timed():
+            vals = self._jits[key](
+                vals_of_batch(batch), count_scalar(batch.num_rows_lazy))
+        yield self.record_batch(
+            batch_from_vals(vals, self._schema, batch.num_rows_lazy))
+
+    def window_fn(self, cap: int, sml: Tuple[int, ...]):
+        """The pure, trace-safe window body over (cols, num_rows) at
+        capacity ``cap``: ONE radix sort by (partition, order) keys plus
+        O(n) scan kernels, returning sorted child cols + one value column
+        per window expression. Shared seam: the single-device path jits
+        it directly; the mesh window stage (exec/mesh.TpuMeshWindowExec)
+        runs the SAME body per shard after a hash exchange on the
+        partition keys (window partitions are independent, so exchanging
+        whole partitions onto shards preserves exact semantics)."""
+        all_keys = self._part_keys + self._order_keys
         frame = self.spec.resolved_frame()
         range_frame = frame.frame_type == W.RANGE
         whole = frame.is_whole_partition or not self._order_keys
@@ -234,14 +257,4 @@ class TpuWindowExec(TpuExec):
                     raise ValueError(f"unsupported window function {f}")
             return out
 
-        key = (batch_signature(batch), cap, sml)
-        if key not in self._jits:
-            from .base import note_compile_miss
-
-            note_compile_miss("window")
-            self._jits[key] = jax.jit(run)
-        with self.op_timed():
-            vals = self._jits[key](
-                vals_of_batch(batch), count_scalar(batch.num_rows_lazy))
-        yield self.record_batch(
-            batch_from_vals(vals, self._schema, batch.num_rows_lazy))
+        return run

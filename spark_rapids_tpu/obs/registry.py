@@ -103,6 +103,11 @@ METRICS: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     "tpu_mesh_staged_rows": (
         COUNTER, "Rows staged onto each mesh shard (per-chip lane of the "
         "multichip SPMD path)", ("device",)),
+    "tpu_mesh_shard_seconds": (
+        COUNTER, "Per-chip completion time of mesh SPMD programs "
+        "(dispatch to that shard's outputs ready — upper bound, polled "
+        "in shard order; the live twin of the per-chip op_span lanes)",
+        ("device",)),
     "tpu_watchdog_alerts": (
         COUNTER, "Watchdog alerts raised, by kind "
         "(stall/hbm_pressure/recompile_storm)", ("kind",)),

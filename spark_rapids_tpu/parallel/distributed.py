@@ -43,7 +43,8 @@ def dist_groupby(
     axis_name: str,
     n_shards: int,
     str_max_lens: Sequence[int] = (),
-) -> Tuple[List[ColV], List[ColV], jax.Array]:
+    group_cap: int = 0,
+) -> Tuple[List[ColV], List[ColV], jax.Array, jax.Array]:
     """PARTIAL local aggregate -> key-hash all_to_all -> FINAL merge.
 
     ``update_ops`` aggregate raw inputs into per-shard partials;
@@ -51,26 +52,57 @@ def dist_groupby(
     update/merge split, AggregateFunctions.scala:531). Group keys end up
     shard-disjoint, so results are the concatenation of every shard's
     output (each shard returns its own groups + count).
+
+    ``group_cap`` sizes the exchange to the GROUP cardinality instead of
+    the input row capacity: the PARTIAL output (groups compacted to the
+    front) is sliced to ``group_cap`` rows per shard before crossing the
+    wire, shrinking the all_to_all surface from O(n_shards x cap) to
+    O(n_shards x group_cap) — the difference between a mesh aggregate
+    that scales and one that drowns in its own receive buffers. A shard
+    whose partial produced more than ``group_cap`` groups reports
+    ``ok`` = False (results are then truncated garbage; callers retry
+    with a doubled cap, the same contract as the join's output-capacity
+    retry). 0 disables slicing, ``ok`` is then always True. Fixed-width
+    columns only (string group keys keep the full-capacity exchange).
+
+    Returns (keys, aggs, count, ok) — ``ok`` is globally reduced.
     """
     # PARTIAL: local groupby shrinks rows before they cross the wire
     pkeys, paggs, pn = groupby_ops.groupby_agg(
         key_cols, key_dtypes, value_cols, list(update_ops), num_rows,
         str_max_lens)
 
+    all_cols = list(pkeys) + list(paggs)
+    cap = all_cols[0].validity.shape[0] if all_cols else 0
+    sliceable = (
+        0 < group_cap < cap
+        and all(type(c) is ColV for c in all_cols))
+    ok_local = jnp.bool_(True)
+    if sliceable:
+        ok_local = pn <= group_cap
+        all_cols = [
+            ColV(c.data[:group_cap], c.validity[:group_cap])
+            for c in all_cols
+        ]
+        pkeys = all_cols[: len(pkeys)]
+        pn = jnp.minimum(pn, group_cap)
+
     # exchange by key hash (same murmur3+pmod as the single-host exchange);
     # string keys cross via the byte plane of the collective
     h = hashing.murmur3(list(pkeys), list(key_dtypes),
                         str_max_lens=str_max_lens)
     pids = hashing.partition_ids(h, n_shards)
-    all_cols = list(pkeys) + list(paggs)
-    recvd, rn, _ok = all_to_all_exchange(
+    recvd, rn, x_ok = all_to_all_exchange(
         all_cols, pids, pn, axis_name, n_shards)
+    ok = x_ok & (
+        lax.psum(ok_local.astype(jnp.int32), axis_name) == n_shards)
     rkeys = recvd[: len(pkeys)]
     raggs = recvd[len(pkeys):]
 
     # FINAL: merge partial buffers locally (keys now shard-disjoint)
-    return groupby_ops.groupby_agg(
+    fkeys, faggs, fn_ = groupby_ops.groupby_agg(
         rkeys, key_dtypes, list(raggs), list(merge_ops), rn, str_max_lens)
+    return fkeys, faggs, fn_, ok
 
 
 def _sample_bounds(
@@ -121,9 +153,18 @@ def dist_sort(
     axis_name: str,
     n_shards: int,
     str_max_lens: Sequence[int] = (),
-) -> Tuple[List[ColV], jax.Array]:
+    bucket_cap: int = 0,
+) -> Tuple[List[ColV], jax.Array, jax.Array]:
     """Sample-range exchange + local sort: shard i's rows all precede
-    shard i+1's in the requested order (the global sort contract)."""
+    shard i+1's in the requested order (the global sort contract).
+
+    ``bucket_cap`` is the per-target exchange granule (the receive surface
+    is n_shards x bucket_cap per shard): the sampled range bounds spread
+    rows roughly evenly, so a granule of ~2x the fair share keeps the
+    exchange O(cap) instead of the default O(n_shards x cap). A skewed
+    key distribution overflows a block and reports ``ok`` = False
+    (callers retry with a bigger granule); 0 keeps the always-fits
+    default. Returns (cols, count, ok) — ``ok`` globally reduced."""
     cap = cols[0].validity.shape[0]
     live = live_of(num_rows, cap)
     key_cols = [cols[i] for i in key_indices]
@@ -140,8 +181,9 @@ def dist_sort(
     # pid = number of bounds <= row (lexicographic over radix words)
     pid = count_bounds_le(sorted_radix, bounds, n_shards - 1)
 
-    recvd, rn, _ok = all_to_all_exchange(
-        sorted_cols, pid, live_sorted, axis_name, n_shards)
+    recvd, rn, ok = all_to_all_exchange(
+        sorted_cols, pid, live_sorted, axis_name, n_shards,
+        bucket_cap=bucket_cap)
 
     rkeys = [recvd[i] for i in key_indices]
     perm2, _ = sort_with_radix_keys(rkeys, key_dtypes, orders, rn,
@@ -149,7 +191,7 @@ def dist_sort(
     rcap = recvd[0].validity.shape[0]
     live2 = jnp.arange(rcap, dtype=jnp.int32) < rn
     live2_sorted = jnp.take(live2, perm2, mode="clip")
-    return gather(recvd, perm2, live2_sorted), rn
+    return gather(recvd, perm2, live2_sorted), rn, ok
 
 
 def dist_hash_join(
@@ -165,6 +207,7 @@ def dist_hash_join(
     out_cap: int,
     key_str_max_lens: Sequence[int] = (),
     out_char_caps: Sequence[int] = (),
+    exchange_bucket_caps: Tuple[int, int] = (0, 0),
 ) -> Tuple[List[ColV], jax.Array, jax.Array]:
     """Inner equi-join: hash-exchange both sides, join locally.
 
@@ -174,20 +217,27 @@ def dist_hash_join(
     ``key_str_max_lens`` must be the SHARED byte bound per string key.
     ``out_char_caps`` sizes the output byte pools per string column of the
     combined (left..right) output; byte overflow also reports ok=False so
-    callers can retry with bigger pools. Returns
-    (cols = left..right, match count, ok).
+    callers can retry with bigger pools. ``exchange_bucket_caps`` are the
+    per-side exchange granules (left, right) — hash partitioning spreads
+    keys roughly evenly, so ~2x the fair share keeps each side's receive
+    surface O(cap) instead of O(n_shards x cap); a skewed key overflows
+    the block and ok=False triggers the caller's retry (0 = always-fits
+    full granule). Returns (cols = left..right, match count, ok).
     """
     from ..expr.eval import StrV
 
-    def exchange_side(cols, key_ix, rows):
+    def exchange_side(cols, key_ix, rows, bucket_cap):
         kc = [cols[i] for i in key_ix]
         h = hashing.murmur3(
             kc, list(key_dtypes), str_max_lens=list(key_str_max_lens))
         pids = hashing.partition_ids(h, n_shards)
-        return all_to_all_exchange(cols, pids, rows, axis_name, n_shards)
+        return all_to_all_exchange(cols, pids, rows, axis_name, n_shards,
+                                   bucket_cap=bucket_cap)
 
-    l_cols, ln, ok1 = exchange_side(left_cols, left_keys, left_rows)
-    r_cols, rn, ok2 = exchange_side(right_cols, right_keys, right_rows)
+    l_cols, ln, ok1 = exchange_side(
+        left_cols, left_keys, left_rows, exchange_bucket_caps[0])
+    r_cols, rn, ok2 = exchange_side(
+        right_cols, right_keys, right_rows, exchange_bucket_caps[1])
 
     def cap_of(cols):
         c0 = cols[0]

@@ -5,6 +5,11 @@ Reference analog: GpuShuffleEnv / the UCX transport bring-up
 "transport" is the mesh itself: one jax.sharding.Mesh over the local
 devices, collectives riding ICI. There is no connection establishment, no
 management port, no bounce-buffer pool to size; XLA owns the wire.
+
+This module is also the ONE home of the jax version shim for
+``shard_map`` (moved between jax releases, and the replication-check
+kwarg was renamed) — every caller (exec/mesh.py, the tests, the dryrun)
+imports it from here instead of guessing the jax API.
 """
 from __future__ import annotations
 
@@ -13,19 +18,63 @@ from typing import Optional
 import jax
 import numpy as np
 
+try:  # jax >= 0.6: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map_impl  # type: ignore[attr-defined]
+    _SM_KW = {"check_vma": False}
+except ImportError:  # older jax: experimental home, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+    _SM_KW = {"check_rep": False}
+
 AXIS = "shards"
 
 _MESH_CACHE: dict = {}
+
+
+def shard_map(f, mesh, in_specs, out_specs, **_ignored):
+    """Version-portable ``shard_map`` with the replication check off (row
+    counts vary per shard; the static check can't see through the
+    sort/segment kernels). Extra kwargs from either API era are ignored."""
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **_SM_KW)
 
 
 def device_count() -> int:
     return jax.local_device_count()
 
 
-def get_mesh(n: Optional[int] = None) -> "jax.sharding.Mesh":
-    """A 1-D mesh over the first ``n`` local devices (default: all)."""
+def configured_mesh_devices(conf) -> int:
+    """The shard count the conf asks for: ``mesh.devices`` caps/forces the
+    global mesh width, ``shuffle.meshSize`` (the legacy per-exchange knob)
+    still applies when mesh.devices is unset. 0 = all local devices."""
+    from ..conf import MESH_DEVICES, SHUFFLE_MESH_SIZE
+
+    n = conf.get(MESH_DEVICES)
+    if n == 0:
+        n = conf.get(SHUFFLE_MESH_SIZE)
+    return n
+
+
+def get_mesh(n: Optional[int] = None, conf=None) -> "jax.sharding.Mesh":
+    """A 1-D mesh over the first ``n`` local devices.
+
+    ``n`` = None/0 consults ``conf`` (``spark.rapids.tpu.mesh.devices``,
+    falling back to ``shuffle.meshSize``); still unset means all local
+    devices. A request exceeding the visible device count is a conf error
+    named after the key, not a silent truncation. Meshes are memoized per
+    (count, device identity) so every stage at the same width shares one
+    Mesh object (jit caches key on mesh identity)."""
     devs = jax.devices()
+    if not n and conf is not None:
+        n = configured_mesh_devices(conf)
     n = n or len(devs)
+    if n > len(devs):
+        raise ValueError(
+            f"spark.rapids.tpu.mesh.devices={n} but only {len(devs)} "
+            "device(s) are visible (set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n} before jax "
+            "initializes for a virtual CPU mesh)")
+    if n < 1:
+        raise ValueError(f"mesh of {n} devices makes no sense")
     key = (n, tuple(id(d) for d in devs[:n]))
     m = _MESH_CACHE.get(key)
     if m is None:

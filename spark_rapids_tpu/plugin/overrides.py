@@ -944,6 +944,32 @@ def _tag_window(meta: "PlanMeta") -> None:
 def _convert_window(cpu: C.CpuWindowExec, conf, children):
     from ..exec.window import TpuWindowExec
 
+    child = children[0]
+    # mesh path (round 6): hash-exchange rows by the PARTITION keys, then
+    # the per-shard window body — window partitions are independent, so
+    # the exchange preserves exact semantics. Gated to direct fixed-width
+    # partition-key references over an all-fixed-width child (strings
+    # keep the single-partition gather path).
+    spec = cpu.window_exprs[0].spec if cpu.window_exprs else None
+    if (
+        spec is not None and spec.partition_by
+        and child.num_partitions > 1
+        and _mesh_eligible(conf, child.output_schema)
+        and all(T.is_fixed_width(f.dataType)
+                for f in child.output_schema.fields)
+    ):
+        try:
+            bound = [E.bind_references(k, child.output_schema)
+                     for k in spec.partition_by]
+        except (ValueError, KeyError):
+            bound = None
+        if bound is not None and all(
+            isinstance(b, E.BoundReference) and T.is_fixed_width(b.dtype)
+            for b in bound
+        ):
+            from ..exec.mesh import TpuMeshWindowExec
+
+            return TpuMeshWindowExec(conf, cpu.window_exprs, child)
     return TpuWindowExec(conf, cpu.window_exprs, children[0])
 
 

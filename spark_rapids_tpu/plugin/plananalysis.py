@@ -1471,3 +1471,130 @@ def predict_exec_hbm(exec_) -> Optional[int]:
 
     ok = walk(exec_)
     return total * 2 if ok and total else None
+
+
+# ---------------------------------------------------------------------------
+# Per-shard mesh forecasts (round 6): what a mesh SPMD stage will stage
+# and compile, per shard, BEFORE it runs — derived by calling the runtime
+# exec's OWN sizing helpers (exec/mesh.forecast_mesh_staging wraps
+# io/mesh_stage.mesh_shard_cap / shard_plane_bytes, the exact code the
+# staging paths execute), so forecast and actual share one implementation
+# and the cross-check below can demand EQUALITY, not just bounds.
+# ---------------------------------------------------------------------------
+def _mesh_stages_of(exec_) -> List:
+    """Mesh stages in a live plan, traversing both TpuExec ``children``
+    and the row-boundary ``tpu_child`` link (session roots are
+    ColumnarToRowExec)."""
+    from ..exec.mesh import _MeshStage
+
+    stages: List = []
+
+    def walk(node) -> None:
+        if isinstance(node, _MeshStage):
+            stages.append(node)
+        tc = getattr(node, "tpu_child", None)
+        if tc is not None:
+            walk(tc)
+        for c in getattr(node, "children", ()):
+            walk(c)
+
+    walk(exec_)
+    return stages
+
+
+def forecast_mesh(exec_) -> Optional[dict]:
+    """Per-shard forecast for every mesh SPMD stage in a LIVE TpuExec
+    tree: staging layout (common per-shard capacity, per-shard rows after
+    the round-robin placement, staged plane bytes), the compile site and
+    an upper bound on programs (1 + capacity-overflow retries), and a
+    static per-shard HBM lower bound (staged planes + output surface).
+    None when the plan has no mesh stages. Sources whose row counts are
+    not statically known (csv scans) yield ``staging: None`` — reported,
+    not cross-checked."""
+    stages = _mesh_stages_of(exec_)
+    if not stages:
+        return None
+    out = []
+    for st in stages:
+        entry: Dict[str, Any] = {
+            "op": st.node_name,
+            "site": st.mesh_site,
+            "n_shards": st.n_shards,
+        }
+        caps = []
+        if len(st.children) == 1:
+            s = st.forecast_mesh_staging(st.children[0])
+            entry["staging"] = s
+            if s:
+                caps.append(s["cap"])
+        else:
+            for which, child in zip(("left", "right"), st.children):
+                s = st.forecast_mesh_staging(child)
+                entry[f"staging_{which}"] = s
+                if s:
+                    caps.append(s["cap"])
+        entry["programs_bound"] = (
+            st.mesh_program_bound(max(caps)) if caps else None)
+        # static per-shard HBM lower bound: the staged input planes must
+        # be resident while the program runs; outputs add one more
+        # surface of the same shape (XLA temporaries are the compiler's
+        # business and not bounded here)
+        staged = [
+            v for k, v in entry.items()
+            if k.startswith("staging") and v and v.get("staged_bytes")
+        ]
+        if staged:
+            entry["peak_hbm_per_shard_lower"] = sum(
+                s["staged_bytes"][0] for s in staged) * 2
+        out.append(entry)
+    return {"n_stages": len(out), "stages": out}
+
+
+def cross_check_mesh(exec_) -> List[str]:
+    """Diff every mesh stage's recorded actuals (exec/mesh
+    ``mesh_actuals``: staging cap/rows/bytes/source, compiled program
+    count) against :func:`forecast_mesh`. Returns violation strings —
+    empty means the per-shard forecast held exactly. Staging entries the
+    forecast could not bound (``staging: None``) are skipped; a stage
+    that never materialized has no actuals and is skipped too."""
+    fc = forecast_mesh(exec_)
+    if fc is None:
+        return []
+    stages = _mesh_stages_of(exec_)
+    bad: List[str] = []
+    for st, entry in zip(stages, fc["stages"]):
+        actual = st.mesh_actuals
+        if not actual:
+            continue
+        pairs = []
+        if "staging" in entry:
+            pairs.append((entry["staging"], actual.get("staging"), ""))
+        else:
+            pairs.append((entry.get("staging_left"),
+                          actual.get("staging_left"), "left"))
+            pairs.append((entry.get("staging_right"),
+                          actual.get("staging_right"), "right"))
+        name = entry["op"]
+        for fcast, act, which in pairs:
+            if fcast is None or act is None:
+                continue
+            tag = f"{name}{('.' + which) if which else ''}"
+            if fcast["cap"] != act["cap"]:
+                bad.append(f"{tag}: staged cap {act['cap']} != "
+                           f"forecast {fcast['cap']}")
+            if list(fcast["per_shard_rows"]) != list(act["per_shard_rows"]):
+                bad.append(f"{tag}: per-shard rows {act['per_shard_rows']}"
+                           f" != forecast {fcast['per_shard_rows']}")
+            if fcast.get("staged_bytes") is not None and \
+                    list(fcast["staged_bytes"]) != list(act["staged_bytes"]):
+                bad.append(f"{tag}: staged bytes {act['staged_bytes']} != "
+                           f"forecast {fcast['staged_bytes']}")
+            if fcast["source"] != act.get("source"):
+                bad.append(f"{tag}: staging source {act.get('source')} != "
+                           f"forecast {fcast['source']}")
+        bound = entry.get("programs_bound")
+        progs = actual.get("programs", 0)
+        if bound is not None and progs > bound:
+            bad.append(f"{name}: {progs} compiled program(s) > "
+                       f"forecast bound {bound}")
+    return bad

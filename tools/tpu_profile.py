@@ -96,6 +96,20 @@ def _is_bench_json(path: str) -> bool:
         return False
 
 
+def _is_multichip_json(path: str) -> bool:
+    """A MULTICHIP_*.json (bench.py --mesh payload): mesh_scaling metric,
+    or the legacy dry-run {n_devices, ok} format."""
+    try:
+        with open(path) as f:
+            head = f.read(1 << 20)
+    except OSError:
+        return False
+    if not path.endswith(".json"):
+        return False
+    return "mesh_scaling" in head or (
+        "n_devices" in head and "per_shape" not in head)
+
+
 def _ms(ns: Optional[float]) -> str:
     return "-" if ns is None else f"{ns / 1e6:.1f}ms"
 
@@ -445,6 +459,96 @@ def diff_bench(old: dict, new: dict, threshold: float
     return "\n".join(lines), regressions
 
 
+#: absolute scaling-efficiency drop per shape that flags a regression in
+#: the MULTICHIP diff (efficiency is already a 0..1 normalized quantity,
+#: so a relative threshold would over-trigger near zero)
+MULTICHIP_EFF_DROP = 0.1
+
+
+def diff_multichip(old: dict, new: dict, threshold: float,
+                   eff_drop: float = MULTICHIP_EFF_DROP
+                   ) -> Tuple[str, int]:
+    """Diff two MULTICHIP json payloads (bench.py --mesh). Structural
+    gates always apply: every old shape present, mesh-lowered shapes stay
+    mesh-lowered, zero forecast violations in the new run. Per-shape
+    scaling-efficiency regression (absolute drop > ``eff_drop``) and
+    device_ms regressions (relative ``threshold``) are compared only when
+    both runs measured the same scale AND device count — a reduced-scale
+    smoke against a committed full-scale round checks structure, not
+    noise."""
+    old = old.get("parsed", old) if "per_shape" not in old else old
+    new = new.get("parsed", new) if "per_shape" not in new else new
+    lines: List[str] = []
+    regressions = 0
+    if "per_shape" not in old:
+        # legacy dry-run format: only the ok flag existed
+        lines.append("  old run is the legacy dry-run format; structural "
+                     "gate on the new run only")
+        old = {"per_shape": {}}
+    if new.get("forecast_violations"):
+        regressions += 1
+        lines.append(
+            f"  REGRESSION: {len(new['forecast_violations'])} per-shard "
+            "forecast violation(s) in new run")
+    comparable = (
+        old.get("scale") == new.get("scale")
+        and old.get("n_devices") == new.get("n_devices")
+        and old.get("host_parallelism") == new.get("host_parallelism"))
+    if not comparable and old.get("per_shape"):
+        lines.append(
+            f"  scale/devices differ (old scale={old.get('scale')} "
+            f"n={old.get('n_devices')}, new scale={new.get('scale')} "
+            f"n={new.get('n_devices')}): structural checks only")
+    shapes = sorted(set(old.get("per_shape") or {})
+                    | set(new.get("per_shape") or {}))
+    for shape in shapes:
+        a = (old.get("per_shape") or {}).get(shape)
+        b = (new.get("per_shape") or {}).get(shape)
+        if b is None:
+            regressions += 1
+            lines.append(f"  {shape}: REGRESSION shape missing from new "
+                         "run")
+            continue
+        if a is None:
+            lines.append(f"  {shape}: new shape (no baseline)")
+            continue
+        if a.get("mesh_lowered") and not b.get("mesh_lowered"):
+            regressions += 1
+            lines.append(f"  {shape}: REGRESSION no longer lowers to the "
+                         "mesh")
+        if a.get("sharded_scan") and not b.get("sharded_scan"):
+            regressions += 1
+            lines.append(f"  {shape}: REGRESSION sharded scan fell back "
+                         "to host staging")
+        if not comparable:
+            continue
+        ea, eb = a.get("scaling_efficiency"), b.get("scaling_efficiency")
+        if ea is not None and eb is not None:
+            if ea - eb > eff_drop:
+                regressions += 1
+                lines.append(
+                    f"  {shape}.scaling_efficiency: REGRESSION "
+                    f"{ea:.3f} -> {eb:.3f} (drop > {eff_drop})")
+            else:
+                lines.append(f"  {shape}.scaling_efficiency: ok "
+                             f"{ea:.3f} -> {eb:.3f}")
+        for field in ("tpu_ms", "device_ms"):
+            va, vb = a.get(field), b.get(field)
+            if va is None or vb is None or va <= 0:
+                continue
+            ratio = vb / va
+            if ratio > 1.0 + threshold and vb - va > DIFF_MIN_MS:
+                regressions += 1
+                lines.append(
+                    f"  {shape}.{field}: REGRESSION {va:.1f} -> {vb:.1f} "
+                    f"({ratio:.2f}x)")
+            else:
+                lines.append(f"  {shape}.{field}: ok {va:.1f} -> "
+                             f"{vb:.1f} ({ratio:.2f}x)")
+    lines.append(f"  {regressions} regression(s)")
+    return "\n".join(lines), regressions
+
+
 def diff_logs(old_events: List[dict], new_events: List[dict],
               threshold: float) -> Tuple[str, int]:
     lines: List[str] = []
@@ -480,7 +584,14 @@ def diff_logs(old_events: List[dict], new_events: List[dict],
 
 def run_diff(old_path: str, new_path: str, threshold: float
              ) -> Tuple[str, int]:
-    if _is_bench_json(old_path) or _is_bench_json(new_path):
+    if _is_multichip_json(old_path) or _is_multichip_json(new_path):
+        with open(old_path) as f:
+            old = json.load(f)
+        with open(new_path) as f:
+            new = json.load(f)
+        head = [f"== diff (multichip) {old_path} -> {new_path} =="]
+        body, n = diff_multichip(old, new, threshold)
+    elif _is_bench_json(old_path) or _is_bench_json(new_path):
         with open(old_path) as f:
             old = json.load(f)
         with open(new_path) as f:
