@@ -916,6 +916,171 @@ def run_mesh_lane(args) -> None:
     }))
 
 
+def run_serve_lane(args) -> None:
+    """Serving throughput lane (--serve NxM): N sessions on N threads
+    each submit M queries through the QueryScheduler against a budget
+    sized to ~half the thread count's forecasts — so admission genuinely
+    arbitrates — and the SAME workload is also submitted one-at-a-time
+    from a single thread. Reports queries/sec and p50/p95 latency for
+    both; the acceptance bar is concurrent qps > serialized qps (the
+    device never idles between queries)."""
+    import threading
+
+    import pyarrow as _pa
+    import pyarrow.parquet as _pq
+
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.expr import aggregates as A
+    from spark_rapids_tpu.expr.expressions import col
+    from spark_rapids_tpu.memory.catalog import BufferCatalog
+    from spark_rapids_tpu.serve import QueryScheduler, SharedPlanCache
+    from spark_rapids_tpu.sql import TpuSession
+
+    try:
+        n_threads, n_queries = (int(x) for x in args.serve.split("x"))
+    except ValueError:
+        raise SystemExit(f"--serve takes N_THREADSxM_QUERIES (e.g. 4x8), "
+                         f"got {args.serve!r}")
+    # parquet group-by workload: every query pays a host decode (GIL-
+    # free native work) plus device compute, so the scheduler's phase
+    # split has something real to overlap — query B's decode against
+    # query A's device phase. The scan cache is OFF: a served fleet of
+    # distinct user queries does not hit one warm file.
+    n_rows = max(1 << 15, int(1_600_000 * args.scale))
+    n_variants = 4
+    tmpd = tempfile.mkdtemp(prefix="srtpu_serve_bench_")
+    rng = np.random.default_rng(11)
+    for v in range(n_variants):
+        d = os.path.join(tmpd, f"v{v}")
+        os.makedirs(d)
+        _pq.write_table(_pa.table({
+            "k": _pa.array(rng.integers(0, 64, n_rows).astype("int32")),
+            "v": _pa.array(
+                rng.integers(0, 100000, n_rows).astype("int64"))}),
+            os.path.join(d, "t.parquet"),
+            row_group_size=max(4096, n_rows // 8))
+    settings = {
+        "spark.rapids.tpu.serve.enabled": True,
+        "spark.rapids.tpu.scan.deviceCache.enabled": False,
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": True,
+        # serving tunes the semaphore up: admission bounds memory, the
+        # permits bound compute concurrency (the reference runs
+        # concurrentGpuTasks=2 for the same reason)
+        "spark.rapids.tpu.sql.concurrentTpuTasks":
+            max(2, min(n_threads, os.cpu_count() or 2)),
+    }
+    if args.event_log:
+        settings["spark.rapids.tpu.eventLog.dir"] = args.event_log
+
+    def query(sess, i):
+        d = os.path.join(tmpd, f"v{i % n_variants}")
+        return (sess.read.parquet(d).group_by("k")
+                .agg(A.agg(A.Sum(col("v")), "sv"),
+                     A.agg(A.Min(col("v")), "mn"),
+                     A.agg(A.Max(col("v")), "mx")).collect())
+
+    # size the budget from the workload's own forecast: room for about
+    # half the threads, so the run exercises queueing without rejects
+    probe = TpuSession(settings)
+    query(probe, 0)
+    an = probe.last_analysis
+    forecast = an.peak_hbm if an is not None else None
+    budget = (int(forecast * max(2.0, n_threads / 2))
+              if forecast else 0)
+    if budget:
+        settings["spark.rapids.tpu.memory.hbm.budgetBytes"] = budget
+    conf = RapidsConf(settings)
+    BufferCatalog.reset(conf)
+    QueryScheduler.reset(conf)
+    SharedPlanCache.reset()
+
+    warm = TpuSession(settings)
+    for i in range(n_variants):
+        query(warm, i)  # compile each distinct shape once (steady state)
+
+    total = n_threads * n_queries
+
+    # serialized one-at-a-time submission of the same workload
+    ser_lat = []
+    sess = TpuSession(settings)
+    t0 = time.perf_counter()
+    for i in range(total):
+        q0 = time.perf_counter()
+        query(sess, i)
+        ser_lat.append(time.perf_counter() - q0)
+    serialized_s = time.perf_counter() - t0
+
+    # concurrent: N sessions on N threads
+    lat = []
+    errors = []
+    lock = threading.Lock()
+
+    def worker(ti):
+        try:
+            s = TpuSession(settings)
+            for qi in range(n_queries):
+                q0 = time.perf_counter()
+                query(s, ti * n_queries + qi)
+                with lock:
+                    lat.append(time.perf_counter() - q0)
+        except Exception as e:  # pragma: no cover
+            with lock:
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=worker, args=(ti,))
+               for ti in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    concurrent_s = time.perf_counter() - t0
+
+    def pct(xs, p):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(p * len(xs)))] * 1e3 if xs else None
+
+    st = QueryScheduler.instance().stats()
+    qps = total / concurrent_s if concurrent_s else None
+    ser_qps = total / serialized_s if serialized_s else None
+    serve = {
+        "threads": n_threads,
+        "queries_per_thread": n_queries,
+        "total_queries": total,
+        "rows_per_query": n_rows,
+        "scale": args.scale,
+        "qps": round(qps, 2) if qps else None,
+        "p50_ms": round(pct(lat, 0.5), 1) if lat else None,
+        "p95_ms": round(pct(lat, 0.95), 1) if lat else None,
+        "serialized_qps": round(ser_qps, 2) if ser_qps else None,
+        "serialized_p50_ms": round(pct(ser_lat, 0.5), 1),
+        "speedup_vs_serialized": (round(qps / ser_qps, 3)
+                                  if qps and ser_qps else None),
+        "budget_bytes": budget or None,
+        "forecast_bytes": forecast,
+        "admitted": st["admitted"], "queued": st["queued"],
+        "rejected": st["rejected"],
+        "bypass_admissions": st["bypass_admissions"],
+        "peak_active": st["peak_active"],
+        "peak_inflight_forecast": st["peak_inflight_forecast"],
+        "errors": errors,
+        # the zero-violation contract: every query completed, nothing
+        # rejected, no bypass, and the summed admitted forecasts never
+        # exceeded the budget
+        "ok": not errors and st["rejected"] == 0
+              and st["bypass_admissions"] == 0
+              and (st["peak_inflight_forecast"] <= budget
+                   if budget else True),
+    }
+    print(json.dumps({
+        "metric": "serve_throughput",
+        # empty per_shape marks this as a bench-family json so
+        # tpu_profile --diff routes it through diff_bench's serve gates
+        "per_shape": {},
+        "serve": serve,
+    }))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0)
@@ -928,12 +1093,23 @@ def main() -> None:
              "mesh (the MULTICHIP_*.json payload); forces an N-device "
              "virtual CPU mesh when no multi-chip accelerator is up")
     ap.add_argument(
+        "--serve", type=str, default="",
+        help="run the concurrent-serving lane instead of the shapes: "
+             "N_THREADSxM_QUERIES (e.g. 4x8) submitted through the "
+             "QueryScheduler under a budget sized to force queueing; "
+             "prints queries/sec + p50/p95 latency vs serialized "
+             "one-at-a-time submission (the BENCH json's 'serve' lane)")
+    ap.add_argument(
         "--event-log", type=str, default="",
         help="directory for a structured JSONL event log of the bench run "
              "(spark.rapids.tpu.eventLog.dir); inspect it offline with "
              "tools/tpu_profile.py, or --diff the emitted BENCH json "
              "against a previous round's")
     args = ap.parse_args()
+
+    if args.serve:
+        run_serve_lane(args)
+        return
 
     if args.mesh:
         # device-count flag must land before jax creates its CPU backend

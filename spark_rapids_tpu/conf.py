@@ -154,6 +154,15 @@ CONCURRENT_TPU_TASKS = conf(
     "spark.rapids.tpu.sql.concurrentTpuTasks", 1,
     "Number of tasks that may hold the TPU concurrently "
     "(reference GpuSemaphore: GpuSemaphore.scala:27-66).", check=_positive)
+SEMAPHORE_ACQUIRE_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.sql.semaphore.acquireTimeoutMs", 0,
+    "Give up acquiring the TPU concurrency semaphore after this many "
+    "milliseconds and raise TpuSemaphoreTimeout naming the current "
+    "holder threads and the wait duration, instead of blocking forever "
+    "(the escape hatch for the watchdog's 'deadlocked semaphore' "
+    "scenario). 0 (the default) waits indefinitely, matching the "
+    "reference GpuSemaphore.", conf_type=int,
+    check=lambda v: None if v >= 0 else "must be >= 0")
 ENABLE_TRACE = conf(
     "spark.rapids.tpu.sql.trace.enabled", False,
     "Wrap operator hot sections in jax.profiler TraceAnnotations "

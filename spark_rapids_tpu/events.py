@@ -104,6 +104,18 @@ EVENT_TYPES: Dict[str, tuple] = {
     # recompile_storm; the same rules replay offline via
     # tools/tpu_profile.py --alerts
     "alert": ("kind", "detail", "value", "threshold"),
+    # serving-layer admission decisions (serve/scheduler.py): verdict is
+    # admit / queue / reject; forecast_bytes is the analyzer's peak-HBM
+    # forecast (null for unbounded plans), free_bytes the live headroom
+    # (budget - watermark - reservations) at decision time
+    "admission": ("session", "digest", "verdict", "forecast_bytes",
+                  "free_bytes", "reason"),
+    # fair-queue lifecycle (serve/scheduler.py): op enqueue / dequeue /
+    # timeout; depth is the session's queue depth after the op; wait_ns
+    # is the queued duration (dequeue/timeout only, else 0). The queue
+    # WAIT itself also rides as an op_span on the session's serve lane
+    # so Perfetto shows the interleaving.
+    "queue": ("session", "op", "depth", "wait_ns"),
 }
 
 #: OPTIONAL fields per event type — emitted only in specific contexts,
@@ -167,7 +179,13 @@ class EventLogger:
     def emit(self, etype: str, **fields: Any) -> None:
         if not self.enabled:
             return
-        rec = {"ts": time.perf_counter_ns(), "event": etype}
+        # ``tid`` (the emitting thread) rides on every record like ``ts``
+        # does: under concurrent serving, query windows overlap in time,
+        # and the offline profiler attributes per-op events to the query
+        # whose drain thread emitted them (the same by-thread model the
+        # live progress tracker uses)
+        rec = {"ts": time.perf_counter_ns(), "event": etype,
+               "tid": threading.get_ident()}
         rec.update(fields)
         with self._lock:
             self._ring.append(rec)
@@ -353,6 +371,20 @@ def chrome_trace(records: List[dict]) -> dict:
                         "ts": us(ts - (r.get("dur") or 0)),
                         "dur": (r.get("dur") or 0) / 1e3,
                         "args": {"bytes": r.get("bytes")}})
+        elif ev == "admission":
+            out.append({"ph": "i", "pid": _PID, "tid": tid_of("serve"),
+                        "name": f"{r['verdict']} session {r['session']}"
+                                f" ({r.get('reason') or 'fits'})",
+                        "ts": us(ts), "s": "t"})
+        elif ev == "queue":
+            # PER-SESSION depth counter tracks (the event's depth field
+            # is the session's own queue depth — one global track would
+            # zigzag between sessions' depths); the wait spans
+            # themselves arrive as op_span records on the matching
+            # 'serve session-N' lanes
+            out.append({"ph": "C", "pid": _PID,
+                        "name": f"queue_depth {r['session']}",
+                        "ts": us(ts), "args": {"depth": r["depth"]}})
         # plan_tagged / plan_analysis / op_batch / agg_strategy carry no
         # timeline shape; the offline profiler reads them from the JSONL
         # log instead

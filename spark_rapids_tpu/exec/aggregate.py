@@ -170,40 +170,36 @@ def _agg_pipeline(
         value_exprs, ops, sig, cap, str_max_lens, approx_float_sum,
         side_signature(sides), str_val_max_lens, nonnull, strategy,
     )
-    fn = _AGG_CACHE.get(key)
-    if fn is not None:
-        return fn
     chain_t = tuple(chain)
 
-    def run(cols, num_rows, side_args):
-        from ..ops.filter_gather import elide_validity, live_of
+    def build():
+        def run(cols, num_rows, side_args):
+            from ..ops.filter_gather import elide_validity, live_of
 
-        live = live_of(num_rows, cap)
-        cols = elide_validity(cols, live, nonnull)
-        for e, s in zip(chain_t, side_args):
-            cols, live = e.lower_batch(cols, live, cap, s)
-        keys = [lower(e, cols, cap) for e in key_exprs]
-        vals: List[Optional[ColV]] = []
-        for e in value_exprs:
-            vals.append(None if e is None else lower(e, cols, cap))
-        if key_exprs:
-            return groupby_ops.groupby_agg(
-                keys, list(key_dtypes), vals, list(ops), live, str_max_lens,
-                approx_float_sum=approx_float_sum,
-                str_val_max_lens=str_val_max_lens,
-                strategy=strategy,
-            )
-        outs = groupby_ops.reduce_no_keys(
-            vals, list(ops), live, str_val_max_lens=str_val_max_lens)
-        return [], outs, jnp.int32(1)
+            live = live_of(num_rows, cap)
+            cols = elide_validity(cols, live, nonnull)
+            for e, s in zip(chain_t, side_args):
+                cols, live = e.lower_batch(cols, live, cap, s)
+            keys = [lower(e, cols, cap) for e in key_exprs]
+            vals: List[Optional[ColV]] = []
+            for e in value_exprs:
+                vals.append(None if e is None else lower(e, cols, cap))
+            if key_exprs:
+                return groupby_ops.groupby_agg(
+                    keys, list(key_dtypes), vals, list(ops), live,
+                    str_max_lens, approx_float_sum=approx_float_sum,
+                    str_val_max_lens=str_val_max_lens,
+                    strategy=strategy,
+                )
+            outs = groupby_ops.reduce_no_keys(
+                vals, list(ops), live, str_val_max_lens=str_val_max_lens)
+            return [], outs, jnp.int32(1)
 
-    if len(_AGG_CACHE) > 512:
-        _AGG_CACHE.clear()
-    from .base import note_compile_miss
+        return jax.jit(run)
 
-    note_compile_miss("agg_update")
-    fn = _AGG_CACHE[key] = jax.jit(run)
-    return fn
+    from .base import cached_pipeline
+
+    return cached_pipeline(_AGG_CACHE, key, "agg_update", build)
 
 
 def _fused_agg_trace(key_exprs, key_dts, value_exprs, update_ops, merge_ops,
@@ -714,8 +710,7 @@ class TpuHashAggregateExec(TpuExec):
             tuple(self._merge_ops), eval_exprs, self.mode, approx,
             side_signature(sides), self.conf.shape_bucket_min, strategy,
         )
-        fn = _AGG_CACHE.get(key)
-        if fn is None:
+        def build():
             update_batch, finish = _fused_agg_trace(
                 tuple(self._bound_keys), self._key_dtypes(),
                 tuple(self._update_exprs), tuple(self._update_ops),
@@ -743,12 +738,11 @@ class TpuHashAggregateExec(TpuExec):
                         update_batch(cols, live_of(n, cap), cap, side_args))
                 return finish(partial_sets)
 
-            if len(_AGG_CACHE) > 512:
-                _AGG_CACHE.clear()
-            from .base import note_compile_miss
+            return jax.jit(run)
 
-            note_compile_miss("agg_stage")
-            fn = _AGG_CACHE[key] = jax.jit(run)
+        from .base import cached_pipeline
+
+        fn = cached_pipeline(_AGG_CACHE, key, "agg_stage", build)
         vals, nseg = fn(all_args, sides)
         schema = (self._buffer_schema if self.mode == A.PARTIAL
                   else self._schema)
@@ -794,7 +788,7 @@ class TpuHashAggregateExec(TpuExec):
         see docs/tuning.md (the agg shape's device time was dominated by
         per-program dispatch gaps, not kernel time)."""
         from ..conf import IMPROVED_FLOAT_OPS
-        from .base import note_compile_miss, side_signature
+        from .base import side_signature
 
         approx = self.conf.get(IMPROVED_FLOAT_OPS)
         sides = [e.side_vals() for e in chain]
@@ -818,8 +812,7 @@ class TpuHashAggregateExec(TpuExec):
             tuple(self._merge_ops), eval_exprs, self.mode, approx,
             side_signature(sides), self.conf.shape_bucket_min, strategy,
         )
-        fn = _AGG_CACHE.get(key)
-        if fn is None:
+        def build():
             update_batch, finish = _fused_agg_trace(
                 tuple(self._bound_keys), self._key_dtypes(),
                 tuple(self._update_exprs), tuple(self._update_ops),
@@ -836,10 +829,11 @@ class TpuHashAggregateExec(TpuExec):
                 ]
                 return finish(partial_sets)
 
-            if len(_AGG_CACHE) > 512:
-                _AGG_CACHE.clear()
-            note_compile_miss("agg_plan")
-            fn = _AGG_CACHE[key] = jax.jit(run)
+            return jax.jit(run)
+
+        from .base import cached_pipeline
+
+        fn = cached_pipeline(_AGG_CACHE, key, "agg_plan", build)
         vals, nseg = fn(
             [vals_of_batch(b) for b in batches],
             [count_scalar(b.num_rows_lazy) for b in batches], sides)

@@ -44,18 +44,59 @@ BYTES_TOUCHED = "bytesTouched"
 # cudf JIT compiles in its buildTime metric).
 # ---------------------------------------------------------------------------
 class CompileCounter:
-    __slots__ = ("total", "by_site")
+    __slots__ = ("total", "by_site", "_lock")
 
     def __init__(self):
         self.total = 0
         self.by_site: Dict[str, int] = {}
+        # concurrent sessions compile concurrently: unguarded += would
+        # lose counts and break the recompile-guard tests' exact deltas
+        self._lock = threading.Lock()
 
     def note(self, site: str) -> None:
-        self.total += 1
-        self.by_site[site] = self.by_site.get(site, 0) + 1
+        with self._lock:
+            self.total += 1
+            self.by_site[site] = self.by_site.get(site, 0) + 1
+
+    def snapshot(self) -> tuple:
+        with self._lock:
+            return self.total, dict(self.by_site)
 
 
 COMPILE_COUNTER = CompileCounter()
+
+
+# ---------------------------------------------------------------------------
+# Shared guard for the process-global jit pipeline caches. Every cache in
+# the engine (fused_chain/project/agg/mesh/exchange/pq_decode/
+# upload_unpack) had the same get-then-build shape, which under
+# concurrent sessions is a check-then-act race: two threads both see a
+# miss, both count it, and both build — the recompile guarantees ("this
+# plan compiles exactly once") silently break. One helper, one lock:
+# the fast path stays a lock-free dict read (GIL-atomic), the slow path
+# double-checks under the lock before counting + building. Builders only
+# CONSTRUCT the jitted callable (tracing/compilation is deferred to the
+# first call, which jax serializes internally), so holding the lock
+# across build() is cheap.
+# ---------------------------------------------------------------------------
+_PIPELINE_CACHE_LOCK = threading.RLock()
+
+
+def cached_pipeline(cache: dict, key, site: Optional[str],
+                    build: Callable[[], Callable],
+                    max_entries: int = 512) -> Callable:
+    fn = cache.get(key)
+    if fn is not None:
+        return fn
+    with _PIPELINE_CACHE_LOCK:
+        fn = cache.get(key)
+        if fn is None:
+            if len(cache) > max_entries:
+                cache.clear()
+            if site is not None:
+                note_compile_miss(site)
+            fn = cache[key] = build()
+    return fn
 
 
 def note_compile_miss(site: str) -> None:
@@ -266,6 +307,16 @@ class TpuExec:
             finally:
                 sem.release_if_necessary()
 
+    def host_prefetch(self) -> None:
+        """Serving-path pipelining hook: start this plan's host-side work
+        (file reads, parquet decode on the shared pools) BEFORE the
+        caller takes the device semaphore, so an admitted query's host
+        phase overlaps the running query's device compute. Default:
+        recurse — scans override (exec/scan.py). Must not block on the
+        work it starts and must be safe to call at most once per plan."""
+        for c in self.children:
+            c.host_prefetch()
+
     #: True when lower_batch may clear liveness bits (filters); tells the
     #: chain driver a final compaction is needed for standalone output
     sparsifies = False
@@ -453,7 +504,7 @@ def compile_snapshot() -> tuple:
     """(total, by_site) snapshot for delta reporting (sessions snapshot
     before executing a plan so explain_metrics attributes misses to THAT
     plan, not to everything compiled since process start)."""
-    return COMPILE_COUNTER.total, dict(COMPILE_COUNTER.by_site)
+    return COMPILE_COUNTER.snapshot()
 
 
 def format_metrics(plan: TpuExec, since: Optional[tuple] = None) -> str:
@@ -492,10 +543,11 @@ def format_metrics(plan: TpuExec, since: Optional[tuple] = None) -> str:
 
     walk(plan, 0)
     base_total, base_sites = (0, {}) if since is None else since
-    total = COMPILE_COUNTER.total - base_total
+    now_total, now_sites = COMPILE_COUNTER.snapshot()
+    total = now_total - base_total
     deltas = {
         k: v - base_sites.get(k, 0)
-        for k, v in COMPILE_COUNTER.by_site.items()
+        for k, v in now_sites.items()
         if v - base_sites.get(k, 0)
     }
     sites = ", ".join(f"{k}={v}" for k, v in sorted(deltas.items()))
@@ -610,8 +662,8 @@ def fused_pipeline(chain: Sequence[TpuExec], sig: tuple, cap: int,
     """
     key = (tuple(e.fusion_key() for e in chain), sig, cap,
            side_signature(sides), nonnull)
-    fn = _FUSED_CACHE.get(key)
-    if fn is None:
+
+    def build():
         chain_t = tuple(chain)
         needs_compact = any(e.sparsifies for e in chain_t)
 
@@ -627,11 +679,10 @@ def fused_pipeline(chain: Sequence[TpuExec], sig: tuple, cap: int,
                 return cols, count
             return cols, num_rows
 
-        if len(_FUSED_CACHE) > 1024:
-            _FUSED_CACHE.clear()
-        note_compile_miss("fused_chain")
-        fn = _FUSED_CACHE[key] = jax.jit(run)
-    return fn
+        return jax.jit(run)
+
+    return cached_pipeline(_FUSED_CACHE, key, "fused_chain", build,
+                           max_entries=1024)
 
 
 def run_fused_chain(exec_self: TpuExec, index: int) -> Iterator[ColumnarBatch]:

@@ -107,22 +107,19 @@ def _project_pipeline(exprs: Tuple[E.Expression, ...], sig: tuple, cap: int,
     (ops/filter_gather.elide_validity); the compiled fn takes
     ``(cols, num_rows)`` either way so call sites stay uniform."""
     key = (exprs, sig, cap, nonnull)
-    fn = _PROJECT_CACHE.get(key)
-    if fn is None:
-        if len(_PROJECT_CACHE) > 512:
-            _PROJECT_CACHE.clear()
-        from .base import note_compile_miss
 
-        note_compile_miss("project")
-
+    def build():
         def run(cols, num_rows):
             if nonnull and any(nonnull):
                 live = filter_gather.live_of(num_rows, cap)
                 cols = filter_gather.elide_validity(cols, live, nonnull)
             return [lower(e, cols, cap) for e in exprs]
 
-        fn = _PROJECT_CACHE[key] = jax.jit(run)
-    return fn
+        return jax.jit(run)
+
+    from .base import cached_pipeline
+
+    return cached_pipeline(_PROJECT_CACHE, key, "project", build)
 
 
 class TpuProjectExec(TpuExec):

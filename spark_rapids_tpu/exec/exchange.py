@@ -107,18 +107,19 @@ def _piece_slicer(sig: tuple, pcap: int, ccaps: Tuple[int, ...]):
     ``data[a:b]`` would compile one XLA slice per distinct range.
     """
     key = (sig, pcap, ccaps)
-    fn = _SLICE_CACHE.get(key)
-    if fn is None:
 
+    def build():
         def run(cols, start, n):
             idx = jnp.arange(pcap, dtype=jnp.int32) + start
             valid_slot = jnp.arange(pcap, dtype=jnp.int32) < n
             return filter_gather.gather(cols, idx, valid_slot, ccaps)
 
-        if len(_SLICE_CACHE) > 1024:
-            _SLICE_CACHE.clear()
-        fn = _SLICE_CACHE[key] = jax.jit(run)
-    return fn
+        return jax.jit(run)
+
+    from .base import cached_pipeline
+
+    return cached_pipeline(_SLICE_CACHE, key, None, build,
+                           max_entries=1024)
 
 
 def _vals_signature(vals: Sequence[Val]) -> tuple:
@@ -167,16 +168,18 @@ def concat_pieces(
     )
     sigs = tuple(_vals_signature(p.vals) for p in pieces)
     key = (sigs, out_cap, out_char_caps)
-    fn = _CONCAT_CACHE.get(key)
-    if fn is None:
 
+    def build():
         def run(col_parts, counts, byte_counts):
             return concat_ops.concat_pieces_traced(
                 col_parts, counts, byte_counts, out_cap, out_char_caps)
 
-        if len(_CONCAT_CACHE) > 1024:
-            _CONCAT_CACHE.clear()
-        fn = _CONCAT_CACHE[key] = jax.jit(run)
+        return jax.jit(run)
+
+    from .base import cached_pipeline
+
+    fn = cached_pipeline(_CONCAT_CACHE, key, None, build,
+                         max_entries=1024)
     cols, _n = fn(
         [p.vals for p in pieces],
         [jnp.int32(p.n) for p in pieces],

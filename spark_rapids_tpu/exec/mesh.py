@@ -491,18 +491,15 @@ _PROGRAM_CACHE: dict = {}
 
 def _cached_program(key, builder, site: Optional[str] = None,
                     on_miss=None):
-    fn = _PROGRAM_CACHE.get(key)
-    if fn is None:
-        if len(_PROGRAM_CACHE) > 256:
-            _PROGRAM_CACHE.clear()
-        if site is not None:
-            from .base import note_compile_miss
+    from .base import cached_pipeline
 
-            note_compile_miss(site)
+    def build():
         if on_miss is not None:
             on_miss()
-        fn = _PROGRAM_CACHE[key] = builder()
-    return fn
+        return builder()
+
+    return cached_pipeline(_PROGRAM_CACHE, key, site, build,
+                           max_entries=256)
 
 
 class TpuMeshAggregateExec(_MeshStage):

@@ -8,6 +8,7 @@ values append as constant device columns.
 """
 from __future__ import annotations
 
+import threading
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -22,6 +23,27 @@ from .base import TpuExec
 
 SCAN_TIME = "scanTime"  # reference metric name (GpuMetricNames)
 DECODE_TIME = "tpuDecodeTime"
+
+# Serving-path prefetch pool: host_prefetch() submits whole-split reads
+# here. DISTINCT from the srtpu-pqdec chunk-decode pool on purpose — a
+# split read fans out chunk decodes onto that pool, so running the outer
+# task on the same bounded pool could occupy every worker with waiters
+# (classic nested-pool deadlock). Two workers is enough: the point is
+# overlap with the device phase, not parallel split storms.
+_PREFETCH_POOL = None
+_PREFETCH_POOL_LOCK = threading.Lock()
+
+
+def _prefetch_pool():
+    global _PREFETCH_POOL
+    if _PREFETCH_POOL is None:
+        with _PREFETCH_POOL_LOCK:
+            if _PREFETCH_POOL is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                _PREFETCH_POOL = ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="srtpu-prefetch")
+    return _PREFETCH_POOL
 
 
 def constant_string_column(value, n: int, cap: int) -> DeviceColumn:
@@ -153,6 +175,7 @@ class TpuFileSourceScanExec(TpuExec):
         self.scanner = scanner
         self.fmt = fmt
         self._prefetch = None  # MULTITHREADED reader futures
+        self._prefetch_dev = None  # host_prefetch device-path futures
         self.metrics[SCAN_TIME] = self.metric(SCAN_TIME)
         self.metrics[DECODE_TIME] = self.metric(DECODE_TIME)
 
@@ -172,9 +195,12 @@ class TpuFileSourceScanExec(TpuExec):
         cloud-path scans buffer EVERY split in a thread pool on first
         touch so later partitions find their bytes already fetched
         (reference: MultiFileCloudParquetPartitionReader
-        GpuParquetScan.scala:1299-1333)."""
+        GpuParquetScan.scala:1299-1333). The serving path's
+        host_prefetch() fills the same future table ahead of the drain,
+        so an already-started prefetch is consumed whatever the reader
+        type."""
         rt = getattr(self.scanner, "reader_type", lambda: "PERFILE")()
-        if rt != "MULTITHREADED":
+        if rt != "MULTITHREADED" and self._prefetch is None:
             return self.scanner.read_split_i(index)
         if self._prefetch is None:
             from concurrent.futures import ThreadPoolExecutor
@@ -330,6 +356,28 @@ class TpuFileSourceScanExec(TpuExec):
         with self.op_timed("plan", SCAN_TIME):
             return fn(index)
 
+    def host_prefetch(self) -> None:
+        """Serving-path phase split: start every split's host decode (+
+        staged upload dispatch on the device path) on the prefetch pool
+        NOW, before the caller blocks on the TPU semaphore — host work
+        of an admitted query overlaps the running query's device
+        compute. The drain consumes the futures instead of re-reading."""
+        n = self.scanner.num_splits()
+        if n == 0:
+            return
+        if hasattr(self.scanner, "read_split_device"):
+            if self._prefetch_dev is None:
+                self._prefetch_dev = [
+                    _prefetch_pool().submit(
+                        self.scanner.read_split_device, i)
+                    for i in range(n)
+                ]
+        elif self._prefetch is None:
+            self._prefetch = [
+                _prefetch_pool().submit(self.scanner.read_split_i, i)
+                for i in range(n)
+            ]
+
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
         from ..io.arrow_convert import arrow_to_batch
 
@@ -340,7 +388,12 @@ class TpuFileSourceScanExec(TpuExec):
         # kernels expand dictionary/RLE pages on-device
         if hasattr(self.scanner, "read_split_device"):
             with self.op_timed("decode", DECODE_TIME):
-                dev, pvals = self.scanner.read_split_device(index)
+                if self._prefetch_dev is not None:
+                    fut = self._prefetch_dev[index]
+                    self._prefetch_dev[index] = None
+                    dev, pvals = fut.result()
+                else:
+                    dev, pvals = self.scanner.read_split_device(index)
             if dev is not None:
                 for b in dev:
                     yield self.record_batch(

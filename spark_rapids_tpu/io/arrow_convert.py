@@ -155,19 +155,13 @@ def packed_upload(host_arrays: List[np.ndarray]):
         _obs.inc("tpu_transfer_bytes", int(pos), direction="h2d")
 
     key = tuple(layout)
-    fn = _UNPACK_CACHE.get(key)
-    if fn is None:
-        # NOTE: one unpack program per distinct (offset, length, dtype)
-        # layout — ragged row-group layouts (e.g. per-group dictionary
-        # sizes) each compile once, the same churn rate as the decode
-        # programs keyed on the same lengths; the miss counter makes it
-        # visible in explain_metrics() instead of silent
-        if len(_UNPACK_CACHE) > 512:
-            _UNPACK_CACHE.clear()
-        from ..exec.base import note_compile_miss
 
-        note_compile_miss("upload_unpack")
-
+    # NOTE: one unpack program per distinct (offset, length, dtype)
+    # layout — ragged row-group layouts (e.g. per-group dictionary
+    # sizes) each compile once, the same churn rate as the decode
+    # programs keyed on the same lengths; the miss counter makes it
+    # visible in explain_metrics() instead of silent
+    def build():
         def unpack(b):
             outs = []
             for off, ln, dts in key:
@@ -182,7 +176,11 @@ def packed_upload(host_arrays: List[np.ndarray]):
                         seg.reshape(ln, dt.itemsize), dt).reshape(ln))
             return outs
 
-        fn = _UNPACK_CACHE[key] = jax.jit(unpack)
+        return jax.jit(unpack)
+
+    from ..exec.base import cached_pipeline
+
+    fn = cached_pipeline(_UNPACK_CACHE, key, "upload_unpack", build)
     return fn(dev)
 
 

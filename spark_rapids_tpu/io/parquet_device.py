@@ -753,14 +753,10 @@ def _run_decode(plan: ChunkPlan, dtype_tpu, key_t, run, dev_args):
     uploaded) args and wrap the result as a DeviceColumn."""
     import jax
 
-    fn = _DECODE_CACHE.get(key_t)
-    if fn is None:
-        if len(_DECODE_CACHE) > 512:
-            _DECODE_CACHE.clear()
-        from ..exec.base import note_compile_miss
+    from ..exec.base import cached_pipeline
 
-        note_compile_miss("pq_decode")
-        fn = _DECODE_CACHE[key_t] = jax.jit(run)
+    fn = cached_pipeline(_DECODE_CACHE, key_t, "pq_decode",
+                         lambda: jax.jit(run))
     out = fn(dev_args)
     from ..columnar.column import DeviceColumn
     from ..expr.values import DictV

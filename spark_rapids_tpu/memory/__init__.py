@@ -15,7 +15,7 @@ from .catalog import (
     TIER_DISK,
     TIER_HOST,
 )
-from .semaphore import TpuSemaphore
+from .semaphore import TpuSemaphore, TpuSemaphoreTimeout
 from .spillable import SpillableColumnarBatch, SpillableVals
 
 __all__ = [
@@ -30,4 +30,5 @@ __all__ = [
     "TIER_DISK",
     "TIER_HOST",
     "TpuSemaphore",
+    "TpuSemaphoreTimeout",
 ]

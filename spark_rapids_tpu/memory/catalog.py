@@ -108,6 +108,13 @@ class BufferCatalog:
         self.metrics = SpillMetrics()
         self._spill_dir: Optional[str] = None
         self._budget = self._derive_budget()
+        # admission reservations (serve/scheduler.py): rid -> (bytes,
+        # label). An admitted query's forecast counts against the budget
+        # from admission until release, so the scheduler's admit decision
+        # and the spiller can never over-commit the same headroom.
+        self._reservations: Dict[int, tuple] = {}
+        self._reserved_bytes = 0
+        self._next_rid = 0
 
     # -- singleton (reference: RapidsBufferCatalog.singleton) --------------
     @classmethod
@@ -274,6 +281,49 @@ class BufferCatalog:
     @property
     def device_bytes(self) -> int:
         return self._device_bytes
+
+    # -- admission reservations (serve/scheduler.py) -----------------------
+    def reserve(self, nbytes: int, label: str = "") -> int:
+        """Charge an admitted query's peak-HBM forecast against the
+        budget until :meth:`release_reservation`. Accounting only — no
+        allocation happens; the reservation narrows what the scheduler
+        will admit next. Deliberately conservative: a running query's
+        ACTUAL buffers also register in ``device_bytes``, so headroom is
+        double-counted toward safety (queueing, never OOM)."""
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            self._reservations[rid] = (int(nbytes), label)
+            self._reserved_bytes += int(nbytes)
+            if _obs.enabled():
+                _obs.set_gauge("tpu_hbm_reserved_bytes",
+                               self._reserved_bytes)
+            if self.conf.get(MEMORY_DEBUG):
+                log.info("reserve %d B (%s): reserved=%d B", nbytes, label,
+                         self._reserved_bytes)
+            return rid
+
+    def release_reservation(self, rid: int) -> None:
+        with self._lock:
+            entry = self._reservations.pop(rid, None)
+            if entry is None:
+                return
+            self._reserved_bytes -= entry[0]
+            if _obs.enabled():
+                _obs.set_gauge("tpu_hbm_reserved_bytes",
+                               self._reserved_bytes)
+
+    @property
+    def reserved_bytes(self) -> int:
+        return self._reserved_bytes
+
+    def admission_state(self) -> tuple:
+        """(budget, device_bytes, reserved_bytes) read atomically under
+        the catalog lock — the scheduler derives its admission headroom
+        from one consistent snapshot, never from separate property reads
+        that could interleave with a concurrent register/reserve."""
+        with self._lock:
+            return self._budget, self._device_bytes, self._reserved_bytes
 
 
 class SpillableHandle:

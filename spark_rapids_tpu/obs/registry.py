@@ -120,6 +120,23 @@ METRICS: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     "tpu_pq_pipeline_bytes": (
         COUNTER, "Bytes through the pipelined parquet decode stages",
         ("stage",)),
+    "tpu_serve_admissions": (
+        COUNTER, "Serving-layer admission decisions by verdict "
+        "(admit/queue/reject — serve/scheduler.py)", ("verdict",)),
+    "tpu_serve_queue": (
+        COUNTER, "Fair-queue lifecycle ops (enqueue/dequeue/timeout)",
+        ("op",)),
+    "tpu_serve_queue_depth": (
+        GAUGE, "Queries currently waiting in the serving queue (all "
+        "sessions)", ()),
+    "tpu_serve_queue_wait_seconds": (
+        HISTOGRAM, "Queued duration per admitted query", ()),
+    "tpu_serve_plan_cache": (
+        COUNTER, "Shared plan-cache lookups by outcome (hit/miss) — one "
+        "analysis/compile-prep per plan digest across sessions", ("op",)),
+    "tpu_hbm_reserved_bytes": (
+        GAUGE, "Outstanding admission reservations (admitted peak-HBM "
+        "forecasts not yet released)", ()),
 }
 
 #: event type -> the live metric family that carries the same signal, so
@@ -142,6 +159,8 @@ EVENT_BACKED_METRICS: Dict[str, str] = {
     "alert": "tpu_watchdog_alerts",
     "agg_strategy": "tpu_agg_strategy",
     "pq_pipeline": "tpu_pq_pipeline_stages",
+    "admission": "tpu_serve_admissions",
+    "queue": "tpu_serve_queue",
 }
 
 

@@ -37,13 +37,27 @@ def build_status(registry: MetricsRegistry, progress: ProgressTracker,
         "device_bytes": cat.device_bytes,
         "peak_device_bytes": m.peak_device_bytes,
         "spilled_bytes": m.spilled_bytes,
+        "reserved_bytes": cat.reserved_bytes,
         "budget_bytes": budget,
         "pressure": (cat.device_bytes / budget) if budget else None,
     }
+    # serving queue (serve/scheduler.py) — peek only: /status must not
+    # conjure a scheduler in a process that never served
+    from ..serve.scheduler import QueryScheduler
+
+    sched = QueryScheduler.instance()
+    serve = None
+    if sched is not None:
+        serve = {
+            "stats": sched.stats(),
+            "queue": sched.queue_status(),
+            "active": sched.active_status(),
+        }
     return {
         "queries": progress.status(),
         "queries_live": progress.live_count(),
         "hbm": hbm,
+        "serve": serve,
         "alerts": [a.to_json() for a in watchdog.alerts()]
         if watchdog is not None else [],
         "metrics": registry.snapshot(),

@@ -6,7 +6,10 @@ sampler raises TYPED alerts from the observability plane:
 
   * **stall** — an operator span has been OPEN longer than
     ``watchdog.stallThresholdMs`` (a hung device dispatch, a wedged
-    host decode, a deadlocked semaphore);
+    host decode, a deadlocked semaphore — for the latter,
+    ``sql.semaphore.acquireTimeoutMs`` is the matching escape hatch:
+    the blocked acquirer raises a named TpuSemaphoreTimeout listing
+    the holder threads instead of waiting forever);
   * **hbm_pressure** — the BufferCatalog device-byte watermark is above
     ``watchdog.hbmPressureFraction`` of the shared budget
     (derive_hbm_budget — the SAME derivation the spiller and the plan

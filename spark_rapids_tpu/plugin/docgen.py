@@ -27,7 +27,8 @@ def _import_conf_modules() -> None:
 
     for mod in ("spark_rapids_tpu.events",
                 "spark_rapids_tpu.memory.catalog",
-                "spark_rapids_tpu.ml.columnar_rdd"):
+                "spark_rapids_tpu.ml.columnar_rdd",
+                "spark_rapids_tpu.serve.scheduler"):
         try:
             importlib.import_module(mod)
         except ImportError:

@@ -9,6 +9,7 @@ pass, and executes the result. ``last_executed_plan`` and
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from .. import events as _events  # registers the eventLog.* conf entries
@@ -18,6 +19,8 @@ from ..cpu import plan as C
 from ..memory import catalog as _catalog  # noqa: F401 — registers the
 # memory.* conf entries (hbm.budgetBytes) BEFORE RapidsConf validates a
 # user's settings dict; the plan analyzer's OOM check reads them
+from ..serve import scheduler as _serve  # noqa: F401 — registers the
+# serve.* conf entries (serve.enabled picks the submit path below)
 from ..exec.transitions import ColumnarToRowExec
 from ..expr import aggregates as A
 from ..expr import expressions as E
@@ -35,12 +38,16 @@ class LNode:
 
 
 _SCANNER_CACHE: Dict[tuple, Any] = {}
+_SCANNER_CACHE_LOCK = threading.Lock()
 
 
 def _make_scanner(fmt: str, path: str, opts: tuple, conf: RapidsConf,
                   pushed: tuple = ()):
     """Build (and cache) a file scanner; the cache avoids re-parsing
-    footers on every schema access (conf identity is part of the key)."""
+    footers on every schema access (conf identity is part of the key).
+    Guarded: concurrent serving sessions plan in parallel, and the
+    check-then-act would otherwise build (and race-install) duplicate
+    scanners for one file."""
     # the key holds the conf VALUES planning depends on, not id(conf): an
     # id can be reused after GC and silently serve a scanner planned under
     # different settings (advisor finding r2)
@@ -53,7 +60,12 @@ def _make_scanner(fmt: str, path: str, opts: tuple, conf: RapidsConf,
     key = (fmt, path, opts, pushed, conf.get(PARQUET_READER_TYPE),
            conf.get(MAX_READER_BATCH_SIZE_BYTES), conf.get(CLOUD_SCHEMES))
     sc = _SCANNER_CACHE.get(key)
-    if sc is None:
+    if sc is not None:
+        return sc
+    with _SCANNER_CACHE_LOCK:
+        sc = _SCANNER_CACHE.get(key)
+        if sc is not None:
+            return sc
         od = dict(opts)
         if fmt == "parquet":
             from ..io.parquet import ParquetScanner
@@ -209,6 +221,31 @@ def _as_expr(e: Union[str, E.Expression]) -> E.Expression:
     return E.col(e) if isinstance(e, str) else e
 
 
+_SESSION_SEQ = [0]
+_SESSION_SEQ_LOCK = threading.Lock()
+
+
+def _next_session_id() -> str:
+    with _SESSION_SEQ_LOCK:
+        _SESSION_SEQ[0] += 1
+        return f"session-{_SESSION_SEQ[0]}"
+
+
+# Query ids are PROCESS-global, not per-session: concurrent serving
+# sessions share the live progress tracker (keyed by query id) and merge
+# their event logs for offline profiling — per-session numbering would
+# collide entries across sessions (two live "query 3"s overwrite each
+# other's progress attribution).
+_QUERY_SEQ = [0]
+_QUERY_SEQ_LOCK = threading.Lock()
+
+
+def _next_query_id() -> int:
+    with _QUERY_SEQ_LOCK:
+        _QUERY_SEQ[0] += 1
+        return _QUERY_SEQ[0]
+
+
 class TpuSession:
     """reference analog: SparkSession with the plugin installed."""
 
@@ -218,11 +255,20 @@ class TpuSession:
         self.last_executed_plan = None
         self.last_cpu_plan = None
         self.last_analysis = None
+        #: stable name in serving queues / event lanes ("session-N")
+        self.serve_id = _next_session_id()
+        # planning is session-state-mutating (last_* fields, the pending
+        # obs slot): the serving path lets N threads share one session,
+        # so plan+claim runs under this lock (the drain itself is
+        # arbitrated by the scheduler + semaphore, not this lock)
+        self._plan_lock = threading.RLock()
+        self._serve_analysis = None
+        self._serve_plan_key = None
+        self._last_digest: Optional[str] = None
         # the structured event log (events.py): a ring buffer always backs
         # export_trace(); a JSONL sink appears when eventLog.dir is set.
         # Disabled (the default) costs one boolean per emit site.
         self.events = _events.EventLogger(self.conf)
-        self._query_seq = 0
         self._active_query: Optional[int] = None
         self._pending_obs: Optional[tuple] = None
         # the live observability plane (obs/): registry + conf-gated
@@ -288,21 +334,46 @@ class TpuSession:
         from ..conf import ANALYSIS_CROSS_CHECK, ANALYSIS_ENABLED, SQL_ENABLED
 
         obs_on = _obs.enabled()
+        serve_on = self._serve_enabled()
         run_analysis = self.conf.get(SQL_ENABLED) and (
             self.conf.get(ANALYSIS_CROSS_CHECK)
             # with event logging on, the analyzer's forecasts ride in the
             # log so tpu_profile's forecast-vs-actual report has its
             # bounds without a separate explain() run; the live plane
-            # needs them too — /status progress denominators
-            or ((self.events.enabled or obs_on)
+            # needs them too — /status progress denominators; the serving
+            # scheduler needs the peak-HBM forecast for admission
+            or ((self.events.enabled or obs_on or serve_on)
                 and self.conf.get(ANALYSIS_ENABLED)))
+        digest: Optional[str] = None
+        if self.events.enabled or obs_on or serve_on:
+            import hashlib
+
+            digest = hashlib.sha1(
+                cpu.tree_string().encode()).hexdigest()[:12]
+        self._last_digest = digest
         analysis = None
+        self._serve_analysis = None
+        self._serve_plan_key = None
         if run_analysis:
             # the static analyzer runs BEFORE conversion/execution — it
             # must never touch the device (plugin/plananalysis.py)
             from ..plugin.plananalysis import analyze_plan
 
-            analysis = self.last_analysis = analyze_plan(cpu, self.conf)
+            if serve_on and digest is not None:
+                # one analysis per plan digest across ALL sessions: the
+                # admission forecast of a repeated plan shape is served
+                # from the shared cache instead of recomputed
+                from ..serve import SharedPlanCache, conf_fingerprint
+
+                key = (digest, conf_fingerprint(self.conf))
+                self._serve_plan_key = key
+                analysis, _hit = SharedPlanCache.get().analysis_for(
+                    key, lambda: analyze_plan(cpu, self.conf))
+                self.last_analysis = analysis
+            else:
+                analysis = self.last_analysis = analyze_plan(
+                    cpu, self.conf)
+            self._serve_analysis = analysis
         final, is_tpu = self.overrides.apply(cpu)
         if is_tpu:
             final = ColumnarToRowExec(self.conf, final)
@@ -311,12 +382,7 @@ class TpuSession:
         # misses THIS plan's run compiled (the counter is process-global)
         self._compile_baseline = compile_snapshot()
         if self.events.enabled or obs_on:
-            import hashlib
-
-            self._query_seq += 1
-            qid = self._active_query = self._query_seq
-            digest = hashlib.sha1(
-                cpu.tree_string().encode()).hexdigest()[:12]
+            qid = self._active_query = _next_query_id()
             if self.events.enabled:
                 self._emit_query_events(node, qid, digest, is_tpu)
             if obs_on:
@@ -386,16 +452,26 @@ class TpuSession:
             _events.emit("plan_analysis", query_id=qid,
                          **self.last_analysis.event_fields())
 
-    def _run_collect(self, final: C.CpuExec) -> List[tuple]:
+    _PENDING_UNSET = object()
+
+    def _run_collect(self, final: C.CpuExec, qid: Optional[int] = None,
+                     pending: Any = _PENDING_UNSET) -> List[tuple]:
         """Driver-side collect with the query_end event (duration + row
         count) paired to _execute's query_start. Emitted in a finally so a
         failing query still CLOSES its window — an unterminated
         query_start would make the offline profiler attribute every later
-        event to the dead query."""
+        event to the dead query. The serving path passes ``qid`` and the
+        ``pending`` obs registration it claimed under the plan lock
+        (concurrent submits on one session would otherwise race the
+        shared slots)."""
         import time as _time
 
         t0 = _time.perf_counter_ns()
-        obs_qid = self._obs_begin(self._obs_take_pending())
+        if pending is TpuSession._PENDING_UNSET:
+            pending = self._obs_take_pending()
+        if qid is None:
+            qid = self._active_query
+        obs_qid = self._obs_begin(pending)
         rows: Optional[List[tuple]] = None
         try:
             rows = final.collect()
@@ -403,7 +479,7 @@ class TpuSession:
         finally:
             if self.events.enabled:
                 _events.emit(
-                    "query_end", query_id=self._active_query,
+                    "query_end", query_id=qid,
                     dur=_time.perf_counter_ns() - t0,
                     rows=len(rows) if rows is not None else None,
                     error=rows is None)
@@ -412,6 +488,67 @@ class TpuSession:
                     obs_qid,
                     rows=len(rows) if rows is not None else None,
                     error=rows is None)
+
+    # -- serving path (serve/scheduler.py) ---------------------------------
+    def _serve_enabled(self) -> bool:
+        return self.conf.get(_serve.SERVE_ENABLED)
+
+    def _collect(self, node: LNode) -> List[tuple]:
+        """Plan + drain one query, through the serving scheduler when
+        spark.rapids.tpu.serve.enabled is set."""
+        if not self._serve_enabled():
+            return self._run_collect(self._execute(node))
+        return self._collect_serve(node)
+
+    def _collect_serve(self, node: LNode) -> List[tuple]:
+        """Submit-through-scheduler: plan on the calling thread (host
+        work of a queued query overlaps the running query's device
+        compute), admit against the peak-HBM forecast, host-prefetch
+        scans after admission but BEFORE the device semaphore, then
+        drain. The reservation releases in a finally so a failed query
+        frees its headroom."""
+        from ..serve import QueryScheduler, SharedPlanCache
+        from ..serve.scheduler import SERVE_PRIORITY
+
+        sched = QueryScheduler.get(self.conf)
+        with self._plan_lock:
+            final = self._execute(node)
+            digest = self._last_digest or ""
+            plan_key = self._serve_plan_key
+            analysis = self._serve_analysis
+            pending = self._obs_take_pending()
+            qid = self._active_query
+        # the analyzer's peak-HBM forecast whenever it produced one —
+        # "bounded" (forecasts ASSERTED) is a stronger property than the
+        # admission check needs: parquet plans forecast a peak (footer-
+        # derived residency) without being fully bounded
+        forecast = analysis.peak_hbm if analysis is not None else None
+        try:
+            # priority/timeout/depth are THIS session's settings — the
+            # scheduler singleton may have been created by another one
+            ticket = sched.acquire(
+                self.serve_id, self.conf.get(SERVE_PRIORITY), forecast,
+                digest, conf_=self.conf)
+        except Exception:
+            # a reject/timeout must still CLOSE the query_start window
+            # _execute opened, or the offline profiler attributes every
+            # later event to the dead query
+            if self.events.enabled and qid is not None:
+                _events.emit("query_end", query_id=qid, dur=0, rows=None,
+                             error=True)
+            raise
+        try:
+            if isinstance(final, ColumnarToRowExec):
+                # pipelined phase split: host-side decode starts now, on
+                # the shared pools, while whoever holds the semaphore
+                # keeps the device busy
+                final.tpu_child.host_prefetch()
+            rows = self._run_collect(final, qid=qid, pending=pending)
+            if plan_key is not None:
+                SharedPlanCache.get().mark_warm(plan_key)
+            return rows
+        finally:
+            sched.release(ticket)
 
     def export_trace(self, path: str) -> str:
         """Write the session's event ring buffer as Chrome/Perfetto
@@ -686,7 +823,7 @@ class DataFrame:
         return self.schema.names
 
     def collect(self) -> List[tuple]:
-        return self.session._run_collect(self.session._execute(self.node))
+        return self.session._collect(self.node)
 
     def count(self) -> int:
         return len(self.collect())

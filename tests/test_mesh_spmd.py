@@ -306,7 +306,7 @@ def test_per_shard_spans_and_transfers_in_event_log():
     for r in recs:
         et = r.get("event")
         declared = set(EV.EVENT_TYPES[et]) | set(
-            EV.EVENT_OPTIONAL_FIELDS.get(et, ())) | {"ts", "event"}
+            EV.EVENT_OPTIONAL_FIELDS.get(et, ())) | {"ts", "event", "tid"}
         assert set(r) <= declared, (et, sorted(set(r) - declared))
     spans = [r for r in recs if r.get("event") == "op_span"
              and r.get("shard") is not None]

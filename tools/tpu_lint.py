@@ -205,7 +205,15 @@ def _jit_regions(tree: ast.AST, parents) -> Set[ast.AST]:
             continue
         scope = _enclosing_function(node, parents)
         while True:
-            body = scope.body if scope is not None else tree.body
+            # a Lambda scope has an expression body, never statement
+            # defs — look straight through it to the outer function
+            # (e.g. ``cached_pipeline(..., lambda: jax.jit(run))``)
+            if scope is None:
+                body = tree.body
+            elif isinstance(scope, ast.Lambda):
+                body = []
+            else:
+                body = scope.body
             for stmt in body:
                 if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                         and stmt.name == arg.id:
@@ -249,10 +257,35 @@ def _refs_any(node: ast.AST, names: Set[str]) -> bool:
         isinstance(n, ast.Name) and n.id in names for n in ast.walk(node))
 
 
-def _in_cache_store(call: ast.Call, parents) -> bool:
+#: the sanctioned guarded-cache helpers (exec/base.cached_pipeline and
+#: exec/mesh._cached_program): a builder function handed to one of these
+#: has its jit result stored in the keyed cache BY the helper, under the
+#: pipeline-cache lock — that IS the cache store
+_CACHED_BUILDER_FUNCS = ("cached_pipeline", "_cached_program")
+
+
+def _is_cached_builder_call(call: ast.Call) -> bool:
+    chain = _attr_chain(call.func)
+    return chain is not None \
+        and chain.split(".")[-1] in _CACHED_BUILDER_FUNCS
+
+
+def _passed_to_cached_builder(name: str, tree: ast.AST) -> bool:
+    """Is a def of this name used as an argument to cached_pipeline /
+    _cached_program anywhere in the module?"""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_cached_builder_call(node):
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(a, ast.Name) and a.id == name:
+                    return True
+    return False
+
+
+def _in_cache_store(call: ast.Call, parents, tree: ast.AST) -> bool:
     """jax.jit(...) whose result lands in a subscript store
-    (``_CACHE[key] = jax.jit(run)``) or is returned from an
-    lru_cache-decorated function."""
+    (``_CACHE[key] = jax.jit(run)``), is returned from an
+    lru_cache-decorated function, or is returned from / wrapped in a
+    builder handed to the guarded cache helpers (cached_pipeline)."""
     cur = call
     while True:
         parent = parents.get(cur)
@@ -260,6 +293,11 @@ def _in_cache_store(call: ast.Call, parents) -> bool:
             return False
         if isinstance(parent, ast.Assign):
             return any(isinstance(t, ast.Subscript) for t in parent.targets)
+        if isinstance(parent, ast.Lambda):
+            # ``cached_pipeline(..., lambda: jax.jit(run))``
+            outer = parents.get(parent)
+            return isinstance(outer, ast.Call) \
+                and _is_cached_builder_call(outer)
         if isinstance(parent, ast.Return):
             fn = _enclosing_function(parent, parents)
             if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -270,6 +308,8 @@ def _in_cache_store(call: ast.Call, parents) -> bool:
                     if chain and ("lru_cache" in chain or chain.endswith(
                             ".cache") or chain == "cache"):
                         return True
+                if _passed_to_cached_builder(fn.name, tree):
+                    return True
             return False
         if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
                                ast.Module)):
@@ -351,7 +391,7 @@ def lint_file(path: str, relpath: str) -> List[Finding]:
                     "jax.jit(lambda ...): a fresh lambda never hits the "
                     "executable cache — jit a module-level def"))
             elif _enclosing_function(node, parents) is not None \
-                    and not _in_cache_store(node, parents):
+                    and not _in_cache_store(node, parents, tree):
                 findings.append(Finding(
                     relpath, node.lineno, "TPU002", qual_of(node),
                     "jax.jit(...) inside a function without a cache "

@@ -152,31 +152,66 @@ def aggregate_ops(events: List[dict]) -> Dict[str, OpStats]:
 
 def _query_windows(events: List[dict]) -> List[dict]:
     """One record per query: start/end ts, duration, rows, tagging and
-    analysis payloads, and the events inside its window (single-session
-    logs interleave queries serially, so windows are ts ranges)."""
+    analysis payloads, and the events inside its window.
+
+    Concurrency-aware: under the serving scheduler, sessions interleave,
+    so (a) queries are keyed by (emitting thread, query_id) — per-session
+    query counters collide across sessions in a merged log — and (b)
+    when a query's ts window overlaps another's, its events are filtered
+    to the records its own drain thread emitted (every record carries
+    ``tid``; the same by-thread attribution the live progress tracker
+    uses). Serial single-session logs behave exactly as before."""
     queries: Dict[object, dict] = {}
     order: List[dict] = []
+
+    def qkey(r: dict) -> tuple:
+        return (r.get("tid"), r.get("query_id"))
+
+    def _fallback(r: dict) -> Optional[dict]:
+        """query_end drained on a different thread than planning (the
+        writer path): match the open query with this query_id."""
+        for q in order:
+            if q["query_id"] == r.get("query_id") and q["end"] is None:
+                return q
+        return None
+
     for r in events:
         ev = r.get("event")
         if ev == "query_start":
             q = {"query_id": r.get("query_id"), "start": r["ts"],
                  "end": None, "dur": None, "rows": None,
+                 "tid": r.get("tid"),
                  "plan_digest": r.get("plan_digest"),
                  "tagged": None, "analysis": None}
-            queries[r.get("query_id")] = q
+            queries[qkey(r)] = q
             order.append(q)
-        elif ev == "plan_tagged" and r.get("query_id") in queries:
-            queries[r["query_id"]]["tagged"] = r
-        elif ev == "plan_analysis" and r.get("query_id") in queries:
-            queries[r["query_id"]]["analysis"] = r
-        elif ev == "query_end" and r.get("query_id") in queries:
-            q = queries[r["query_id"]]
-            q["end"] = r["ts"]
-            q["dur"] = r.get("dur")
-            q["rows"] = r.get("rows")
+        elif ev == "plan_tagged":
+            q = queries.get(qkey(r)) or _fallback(r)
+            if q is not None:
+                q["tagged"] = r
+        elif ev == "plan_analysis":
+            q = queries.get(qkey(r)) or _fallback(r)
+            if q is not None:
+                q["analysis"] = r
+        elif ev == "query_end":
+            q = queries.get(qkey(r))
+            if q is None or q["end"] is not None:
+                q = _fallback(r)
+            if q is not None:
+                q["end"] = r["ts"]
+                q["dur"] = r.get("dur")
+                q["rows"] = r.get("rows")
     for q in order:
         lo, hi = q["start"], q["end"] if q["end"] is not None else float("inf")
-        q["events"] = [r for r in events if lo <= r.get("ts", 0) <= hi]
+        overlaps = any(
+            o is not q and q["start"] <= (o["end"] or float("inf"))
+            and o["start"] <= hi for o in order)
+        q["events"] = [
+            r for r in events
+            if lo <= r.get("ts", 0) <= hi
+            and (not overlaps or q["tid"] is None
+                 or r.get("tid") in (None, q["tid"]))
+        ]
     return order
 
 
@@ -375,10 +410,55 @@ def build_report(events: List[dict], top_n: int = 10,
     for stage, (n, b, dur) in sorted(pipe.items()):
         lines.append(f"  {stage}: {n} ({_mb(b)}, {_ms(dur)} host)")
 
+    # serving layer: admission verdicts, queue balance + wait quantiles
+    # (serve/scheduler.py events; absent in non-serving logs)
+    adm: Dict[str, int] = defaultdict(int)
+    for r in events:
+        if r.get("event") == "admission":
+            adm[r["verdict"]] += 1
+    qops: Dict[str, int] = defaultdict(int)
+    waits: List[int] = []
+    max_depth = 0
+    for r in events:
+        if r.get("event") == "queue":
+            qops[r["op"]] += 1
+            max_depth = max(max_depth, r.get("depth") or 0)
+            if r["op"] == "dequeue":
+                waits.append(r.get("wait_ns") or 0)
+    serving_violations = 0
+    lines.append("== serving ==")
+    if not adm and not qops:
+        lines.append("  no serving activity "
+                     "(spark.rapids.tpu.serve.enabled off)")
+    else:
+        lines.append("  admissions: " + ", ".join(
+            f"{v}={n}" for v, n in sorted(adm.items())))
+        if qops:
+            waits.sort()
+
+            def pct(p: float) -> str:
+                return _ms(waits[min(len(waits) - 1,
+                                     int(p * len(waits)))]) if waits else "-"
+            lines.append(
+                f"  queue: {qops.get('enqueue', 0)} enqueued, "
+                f"{qops.get('dequeue', 0)} dequeued, "
+                f"{qops.get('timeout', 0)} timed out, "
+                f"max depth {max_depth}, wait p50={pct(0.5)} "
+                f"p95={pct(0.95)}")
+            if qops.get("enqueue", 0) != (qops.get("dequeue", 0)
+                                          + qops.get("timeout", 0)):
+                serving_violations += 1
+                lines.append(
+                    "  VIOLATION: queue events unbalanced — "
+                    f"{qops.get('enqueue', 0)} enqueue(s) vs "
+                    f"{qops.get('dequeue', 0)} dequeue(s) + "
+                    f"{qops.get('timeout', 0)} timeout(s) (a query "
+                    "entered the queue and never left)")
+
     lines.append("== forecast vs actual ==")
     fa_lines, violations = forecast_vs_actual(queries)
     lines.extend(fa_lines)
-    return "\n".join(lines), violations
+    return "\n".join(lines), violations + serving_violations
 
 
 # ---------------------------------------------------------------------------
@@ -455,6 +535,37 @@ def diff_bench(old: dict, new: dict, threshold: float
                 lines.append(
                     f"  {shape}.{field}: ok {va:.1f} -> {vb:.1f} "
                     f"({ratio:.2f}x)")
+    # serving lane (bench.py --serve): structural gates always — the new
+    # run must be internally clean (ok flag: no errors/rejects/bypass,
+    # summed forecasts within budget) and must still beat serialized
+    # submission; qps is noise-compared only when the runs match shape
+    sa, sb = old.get("serve"), new.get("serve")
+    if sa and sb:
+        if not sb.get("ok"):
+            regressions += 1
+            lines.append("  serve: REGRESSION new run not ok "
+                         f"(errors={sb.get('errors')}, "
+                         f"rejected={sb.get('rejected')}, "
+                         f"bypass={sb.get('bypass_admissions')})")
+        sp = sb.get("speedup_vs_serialized")
+        if sp is not None and sp <= 1.0:
+            regressions += 1
+            lines.append(f"  serve: REGRESSION concurrent qps no longer "
+                         f"beats serialized ({sp:.3f}x)")
+        elif sp is not None:
+            lines.append(f"  serve: ok {sp:.3f}x vs serialized "
+                         f"(qps {sb.get('qps')}, p95 {sb.get('p95_ms')}ms)")
+        comparable = (sa.get("scale") == sb.get("scale")
+                      and sa.get("threads") == sb.get("threads")
+                      and sa.get("queries_per_thread")
+                      == sb.get("queries_per_thread"))
+        va, vb = sa.get("qps"), sb.get("qps")
+        if comparable and va and vb and va / vb > 1.0 + threshold:
+            regressions += 1
+            lines.append(f"  serve.qps: REGRESSION {va} -> {vb}")
+    elif sa and not sb:
+        lines.append("  serve: lane missing from new run (run bench.py "
+                     "--serve to compare)")
     lines.append(f"  {regressions} regression(s)")
     return "\n".join(lines), regressions
 

@@ -79,6 +79,30 @@ def render_status(status: dict, clock: str = "") -> str:
                      f"value={a.get('value'):g} "
                      f"threshold={a.get('threshold'):g}")
 
+    serve = status.get("serve")
+    if serve:
+        st = serve.get("stats") or {}
+        lines.append(
+            f"serve: {st.get('active', 0)} running, "
+            f"{st.get('waiting', 0)} queued  "
+            f"(admitted={st.get('admitted', 0)} "
+            f"queued={st.get('queued', 0)} "
+            f"rejected={st.get('rejected', 0)} "
+            f"timeouts={st.get('timeouts', 0)})")
+        for a in serve.get("active") or []:
+            rm = a.get("running_ms")
+            lines.append(
+                f"  > {a.get('session')} plan={a.get('digest')} "
+                f"forecast={_mb(a.get('forecast_bytes'))}"
+                + (f" running {rm:.0f}ms" if rm is not None else "")
+                + (" [bypass]" if a.get("bypass") else ""))
+        for q in serve.get("queue") or []:
+            lines.append(
+                f"  #{q.get('position')} {q.get('session')} "
+                f"plan={q.get('digest')} "
+                f"waited {q.get('waited_ms', 0):.0f}ms — "
+                f"{q.get('reason')}")
+
     lines.append("")
     queries = status.get("queries") or []
     if not queries:
