@@ -156,6 +156,28 @@ def _device_time(exec_, iters=4):
     return max((tn - t1) / (iters - 1), 1e-9)
 
 
+def _xla_stats(cost_snapshot, device_ms, peak_gbps=HBM_GBPS):
+    """Per-shape compiler-reported roofline block: ``xla_bytes_accessed``
+    sums cost_analysis 'bytes accessed' over the distinct XLA programs
+    the shape compiled (each dispatches once per query run, so the sum
+    is one run's compiler-reported traffic), and ``hbm_frac_xla`` is
+    that traffic / device time / peak — the XLA-measured twin of the
+    layout-derived hbm_frac_device; the two bound the true utilization.
+    Degrades to None when the backend reported no byte costs or the
+    device slope was noise."""
+    from spark_rapids_tpu import xla_cost
+
+    recs = xla_cost.records_since(cost_snapshot)
+    xb = sum(r["bytes_accessed"] for r in recs
+             if r.get("bytes_accessed") is not None)
+    out = {"xla_bytes_accessed": int(xb) if xb else None,
+           "hbm_frac_xla": None}
+    if xb and device_ms and device_ms >= 0.1:
+        gbps = xb / (device_ms / 1e3) / 1e9
+        out["hbm_frac_xla"] = round(gbps / peak_gbps, 4)
+    return out
+
+
 def _agg_strategy_of(exec_):
     """The aggregation strategy the plan's aggregate exec(s) resolved at
     execution (conf sql.agg.strategy; exec/aggregate.resolved_strategy) —
@@ -1139,6 +1161,13 @@ def main() -> None:
     # order-insensitive float aggregation, as the reference's own benchmark
     # runs enable (spark.rapids.sql.variableFloatAgg.enabled)
     conf_dict = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True}
+    # compiled-program cost plane: harvest XLA's own bytes/flops at every
+    # compile miss (warm-up only — the timed iterations compile nothing)
+    # so each shape reports hbm_frac_xla, the compiler-reported twin of
+    # the layout-derived hbm_frac_device; the two bound the truth
+    from spark_rapids_tpu import xla_cost
+
+    xla_cost.FORCE_HARVEST = True
     bench_logger = None
     if args.event_log:
         # event-log the whole bench: the session-path shapes pick the dir
@@ -1149,6 +1178,11 @@ def main() -> None:
         bench_logger = EV.EventLogger(RapidsConf(conf_dict))
         EV.install(bench_logger)
     conf = RapidsConf(conf_dict)
+    # hbm_frac_xla and hbm_frac_device must share ONE peak so the two
+    # estimates bound the truth: the calibrated roofline conf when
+    # declared, else the same v5e spec figure hbm_frac_device uses
+    peak_gbps = conf.get(xla_cost.ROOFLINE_PEAK_HBM_GBPS) or HBM_GBPS
+    xla_cost.set_conf_peaks(conf)
 
     results = {}
     details = {}
@@ -1157,8 +1191,11 @@ def main() -> None:
         fn = SHAPES[name]
         carg = conf_dict if name == "parquet" else conf
         mem_before = _mem_snapshot()
+        cost_before = xla_cost.snapshot()
         cpu_t, tpu_t, extra = fn(args.scale, args.iters, carg, T, E, A, X)
         extra.update(_mem_stats(mem_before))
+        extra.update(_xla_stats(cost_before, extra.get("device_ms"),
+                                peak_gbps))
         sp = cpu_t / tpu_t
         results[name] = sp
         details[name] = {"speedup": round(sp, 2),

@@ -79,6 +79,18 @@ EVENT_TYPES: Dict[str, tuple] = {
     "op_batch": ("op", "rows", "bytes"),
     # pipeline-cache compile miss, naming the site (exec/base.py)
     "compile_miss": ("site", "total"),
+    # compiled-program cost harvest (xla_cost.py): exactly ONE per
+    # compile miss, emitted at the program's first call — trace/compile
+    # phase split (ms) plus what XLA says the program costs per
+    # invocation (cost_analysis/memory_analysis). Cost fields are None
+    # when the backend didn't report them (the CPU fallback reports a
+    # different key set than a real TPU) — consumers guard on presence.
+    # Renders as a duration span on the Perfetto "compile" track plus a
+    # cumulative compile-seconds counter; tools/tpu_profile.py joins it
+    # against the op_span device lane in '== roofline =='.
+    "program_cost": ("site", "digest", "backend", "trace_ms",
+                     "compile_ms", "flops", "bytes_accessed", "temp_bytes",
+                     "argument_bytes", "output_bytes"),
     # host-link transfers: packed uploads (h2d), sanctioned host_pull
     # reads (d2h), host_fence sync points (direction "fence", 0 bytes)
     "transfer": ("direction", "bytes", "site"),
@@ -128,6 +140,15 @@ EVENT_TYPES: Dict[str, tuple] = {
 EVENT_OPTIONAL_FIELDS: Dict[str, tuple] = {
     "op_span": ("shard",),
     "transfer": ("shard",),
+    # ``op``: the exec whose hot section compiled the program (absent
+    # for compiles outside any op scope, e.g. scan staging helpers);
+    # ``out_bytes``: per-output byte breakdown when the backend reports
+    # one; ``generated_code_bytes``: memory_analysis code size;
+    # ``peak_hbm_gbps``/``peak_tflops``: explicitly conf-declared
+    # roofline peaks riding to the offline profiler (absent when the
+    # confs are 0.0 and per-backend defaults apply)
+    "program_cost": ("op", "out_bytes", "generated_code_bytes",
+                     "peak_hbm_gbps", "peak_tflops"),
 }
 
 
@@ -299,6 +320,7 @@ def chrome_trace(records: List[dict]) -> dict:
 
     out: List[dict] = []
     open_queries: Dict[Any, dict] = {}
+    compile_s = 0.0
     for r in records:
         ev = r.get("event")
         ts = r["ts"]
@@ -335,6 +357,26 @@ def chrome_trace(records: List[dict]) -> dict:
                         "ts": us(ts), "args": {"misses": r["total"]}})
             out.append({"ph": "i", "pid": _PID, "tid": tid_of("compile"),
                         "name": f"miss:{r['site']}", "ts": us(ts), "s": "t"})
+        elif ev == "program_cost":
+            # compiles were invisible in traces (instant miss markers
+            # only): render the harvested trace+compile phases as a REAL
+            # duration span ending at the emit timestamp, plus a
+            # cumulative compile-seconds counter track
+            dur_ms = (r.get("trace_ms") or 0) + (r.get("compile_ms") or 0)
+            compile_s += dur_ms / 1e3
+            args = {"site": r.get("site"), "digest": r.get("digest"),
+                    "trace_ms": r.get("trace_ms"),
+                    "compile_ms": r.get("compile_ms")}
+            for k in ("flops", "bytes_accessed", "temp_bytes"):
+                if r.get(k) is not None:
+                    args[k] = r[k]
+            out.append({"ph": "X", "pid": _PID, "tid": tid_of("compile"),
+                        "name": f"compile:{r.get('site')}"
+                                + (f" [{r['op']}]" if r.get("op") else ""),
+                        "ts": us(ts) - dur_ms * 1e3, "dur": dur_ms * 1e3,
+                        "args": args})
+            out.append({"ph": "C", "pid": _PID, "name": "compile_seconds",
+                        "ts": us(ts), "args": {"seconds": round(compile_s, 4)}})
         elif ev == "spill":
             out.append({"ph": "C", "pid": _PID, "name": "hbm_device_bytes",
                         "ts": us(ts), "args": {"bytes": r["device_bytes"]}})

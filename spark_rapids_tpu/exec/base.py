@@ -17,6 +17,7 @@ import jax
 
 from .. import events as _events
 from .. import obs as _obs
+from .. import xla_cost as _xla_cost
 from ..columnar import ColumnarBatch, DeviceColumn
 from ..conf import RapidsConf
 from ..expr.eval import ColV, DictV, StrV, Val
@@ -95,7 +96,14 @@ def cached_pipeline(cache: dict, key, site: Optional[str],
                 cache.clear()
             if site is not None:
                 note_compile_miss(site)
-            fn = cache[key] = build()
+            # compiled-program cost plane (xla_cost.py): while a cost
+            # consumer is active (events / obs / the bench-harness
+            # FORCE_HARVEST hook), the fresh jit callable is wrapped so
+            # its first call times trace+compile separately and harvests
+            # cost_analysis()/memory_analysis() into ONE program_cost
+            # record; with everything off (the default) wrap() returns
+            # the value untouched and cost_analysis is never called
+            fn = cache[key] = _xla_cost.wrap(build(), site, key)
     return fn
 
 
@@ -233,6 +241,17 @@ def timed(metric: Optional[Metric], trace_name: str = "", trace: bool = False,
     if event_op is not None:
         _events.emit("op_span", op=event_op, section=event_section,
                      start=start, dur=dur, lane="host")
+
+
+@contextlib.contextmanager
+def _op_scoped(inner, op: str):
+    """Cost-plane attribution wrapper (built only while a cost consumer
+    is on): programs compiled inside this exec's hot section record
+    op=<node_name> so the roofline report can join XLA bytes/flops
+    against the op's measured device lane."""
+    with _xla_cost.op_scope(op):
+        with inner:
+            yield
 
 
 @contextlib.contextmanager
@@ -392,7 +411,13 @@ class TpuExec:
         if _obs.enabled():
             # live plane: per-op time counters + the open-span table the
             # stall watchdog samples (wrapper only exists while obs is on)
-            return _obs_timed(ctx, self.node_name, section)
+            ctx = _obs_timed(ctx, self.node_name, section)
+        if _xla_cost.harvesting():
+            # cost-plane op attribution rides THE harvester's own gate
+            # (one source of truth — a new harvest consumer must not be
+            # able to harvest with attribution silently missing); the
+            # disabled fast path stays the plain timed() context
+            ctx = _op_scoped(ctx, self.node_name)
         return ctx
 
     def record_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
@@ -507,15 +532,42 @@ def compile_snapshot() -> tuple:
     return COMPILE_COUNTER.snapshot()
 
 
-def format_metrics(plan: TpuExec, since: Optional[tuple] = None) -> str:
+def format_metrics(plan: TpuExec, since: Optional[tuple] = None,
+                   cost_since: Optional[int] = None) -> str:
     """Per-operator metrics report — the profiler's user-facing output
     (reference: the SQL-UI metric table GpuExec publishes per node). One
-    line per exec with its metrics prettied by kind; opTimeDevice rows add
-    a derived HBM GB/s (bytesTouched / opTimeDevice) so bandwidth-bound
-    ops are visible at a glance; a footer reports pipeline-cache compile
-    misses by site (relative to the ``since`` compile_snapshot when
-    given)."""
+    line per exec with its metrics prettied by kind, plus a derived HBM
+    GB/s LABELED BY THE LANE THAT FED IT: ``hbm_gbps[device]`` (layout
+    bytes / opTimeDevice, deviceSync runs) is preferred whenever the
+    device lane exists; without it the column degrades to
+    ``hbm_gbps[host]`` (layout bytes / host wall-clock) — an async
+    dispatch makes the host lane far smaller than the device work it
+    queued, so an UNLABELED figure fed by it silently overstates
+    bandwidth. ``cost_since`` (an xla_cost.snapshot()) additionally adds
+    per-op XLA-compiler columns (xla_bytes/xla_flops/xla_gbps) for
+    programs harvested during this run, and a footer reports
+    pipeline-cache compile misses by site plus the harvested
+    trace/compile split (relative to the ``since`` compile_snapshot)."""
     lines: List[str] = []
+    cost_recs = (_xla_cost.records_since(cost_since)
+                 if cost_since is not None else [])
+    cost_by_op: Dict[str, List[dict]] = {}
+    for r in cost_recs:
+        if r.get("op"):
+            cost_by_op.setdefault(r["op"], []).append(r)
+    # cost attribution is by CLASS name (op_scope pushes node_name): a
+    # class appearing at several plan nodes prints its harvested costs
+    # ONCE (first visit pops the entry), and gets no xla_gbps — any
+    # single node's device lane is the wrong denominator for the
+    # class-wide byte sum
+    name_counts: Dict[str, int] = {}
+
+    def count_names(n: TpuExec) -> None:
+        name_counts[n.node_name] = name_counts.get(n.node_name, 0) + 1
+        for c in n.children:
+            count_names(c)
+
+    count_names(plan)
 
     def walk(node: TpuExec, depth: int) -> None:
         parts = []
@@ -523,8 +575,9 @@ def format_metrics(plan: TpuExec, since: Optional[tuple] = None) -> str:
             if m.value:
                 parts.append(f"{m.name}={m.pretty()}")
         dev = node.metrics.get(OP_TIME_DEVICE)
+        host = node.metrics.get(TOTAL_TIME)
         byt = node.metrics.get(BYTES_TOUCHED)
-        if dev is not None and dev.value and byt is not None:
+        if byt is not None and byt.value:
             # bandwidth the op actually demanded: its INPUT stream (the
             # children's output bytes) plus its own output — output alone
             # would misdiagnose a reducing op (an aggregate streaming GBs
@@ -534,8 +587,22 @@ def format_metrics(plan: TpuExec, since: Optional[tuple] = None) -> str:
                 for c in node.children if BYTES_TOUCHED in c.metrics
             )
             io_bytes = byt.value + in_bytes
-            if io_bytes:
-                parts.append(f"hbm_gbps={io_bytes / dev.value:.2f}")
+            if io_bytes and dev is not None and dev.value:
+                parts.append(f"hbm_gbps[device]={io_bytes / dev.value:.2f}")
+            elif io_bytes and host is not None and host.value:
+                parts.append(f"hbm_gbps[host]={io_bytes / host.value:.2f}")
+        recs = cost_by_op.pop(node.node_name, None)
+        if recs:
+            xb = sum(r["bytes_accessed"] for r in recs
+                     if r.get("bytes_accessed") is not None)
+            xf = sum(r["flops"] for r in recs if r.get("flops") is not None)
+            if xb:
+                parts.append(f"xla_bytes={xb / 1e6:.1f}MB")
+            if xf:
+                parts.append(f"xla_flops={xf / 1e6:.1f}M")
+            if (xb and dev is not None and dev.value
+                    and name_counts.get(node.node_name) == 1):
+                parts.append(f"xla_gbps[device]={xb / dev.value:.2f}")
         lines.append("  " * depth + node.describe()
                      + (": " + ", ".join(parts) if parts else ""))
         for c in node.children:
@@ -553,6 +620,16 @@ def format_metrics(plan: TpuExec, since: Optional[tuple] = None) -> str:
     sites = ", ".join(f"{k}={v}" for k, v in sorted(deltas.items()))
     lines.append(f"compile cache misses: {total}"
                  + (f" ({sites})" if sites else ""))
+    if cost_recs:
+        trace_ms = sum(r.get("trace_ms") or 0 for r in cost_recs)
+        comp_ms = sum(r.get("compile_ms") or 0 for r in cost_recs)
+        temps = [r["temp_bytes"] for r in cost_recs
+                 if r.get("temp_bytes") is not None]
+        lines.append(
+            f"programs harvested: {len(cost_recs)} "
+            f"(trace {trace_ms:.1f}ms + compile {comp_ms:.1f}ms"
+            + (f", largest temp {max(temps) / 1e6:.1f}MB" if temps else "")
+            + ")")
     lines.append(memory_footer())
     return "\n".join(lines)
 

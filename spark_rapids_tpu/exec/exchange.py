@@ -239,8 +239,8 @@ class TpuShuffleExchangeExec(TpuExec):
                 sml: Tuple[int, ...]):
         P = self.num_partitions
         key = (sig, cap, P, sml, self._part_cache_key())
-        fn = self._jits.get(key)
-        if fn is None:
+
+        def build():
             part = self.partitioning
 
             def run(cols, num_rows, map_index):
@@ -254,8 +254,15 @@ class TpuShuffleExchangeExec(TpuExec):
                 ]
                 return sorted_cols, offsets, byte_offs
 
-            fn = self._jits[key] = jax.jit(run)
-        return fn
+            return jax.jit(run)
+
+        # the shared pipeline-cache guard: miss accounting + the
+        # compiled-program cost plane ride cached_pipeline (xla_cost.py)
+        # — the shuffle map kernel is often the bandwidth-dominant
+        # program and must not be invisible to the roofline report
+        from .base import cached_pipeline
+
+        return cached_pipeline(self._jits, key, "exchange", build)
 
     def _sample_range_bounds(self, parts: List[List[ColumnarBatch]]) -> None:
         """Sample key values host-side and set the range bounds

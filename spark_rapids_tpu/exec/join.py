@@ -613,12 +613,12 @@ class TpuShuffledHashJoinExec(TpuExec):
         cache = getattr(self, "_jits", None)
         if cache is None:
             cache = self._jits = {}
-        if key not in cache:
-            from .base import note_compile_miss
+        # the shared pipeline-cache guard: miss accounting + the
+        # compiled-program cost plane ride cached_pipeline (xla_cost.py)
+        from .base import cached_pipeline
 
-            note_compile_miss("join")
-            cache[key] = jax.jit(fn)
-        return cache[key]
+        return cached_pipeline(cache, key, "join",
+                               lambda: jax.jit(fn))
 
     def _unmatched_build(self, build_cols, build_live_all, matched_any):
         """full outer: emit build rows no probe row matched (including live
@@ -721,13 +721,12 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             if cache is None:
                 cache = self._jits = {}
             key = (batch_signature(pbatch), out_cap, np_, nb)
-            if key not in cache:
-                from .base import note_compile_miss
+            from .base import cached_pipeline
 
-                note_compile_miss("join")
-                cache[key] = jax.jit(expand)
+            fn = cached_pipeline(cache, key, "join",
+                                 lambda: jax.jit(expand))
             with self.op_timed():
-                vals, count = cache[key](vals_of_batch(pbatch), build_vals)
+                vals, count = fn(vals_of_batch(pbatch), build_vals)
                 n = int(count)
             if n:
                 yield self.record_batch(batch_from_vals(vals, self._schema, n))

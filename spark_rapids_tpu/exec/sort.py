@@ -115,13 +115,14 @@ class TpuSortExec(TpuExec):
             return filter_gather.gather(cols, perm, live_sorted)
 
         key = (batch_signature(batch), cap, sml)
-        if key not in self._jits:
-            from .base import note_compile_miss
+        # the shared pipeline-cache guard: miss accounting + the
+        # compiled-program cost plane ride cached_pipeline (xla_cost.py)
+        from .base import cached_pipeline
 
-            note_compile_miss("sort")
-            self._jits[key] = jax.jit(run)
+        fn = cached_pipeline(self._jits, key, "sort",
+                             lambda: jax.jit(run))
         with self.op_timed():
-            vals = self._jits[key](
+            vals = fn(
                 vals_of_batch(batch), count_scalar(batch.num_rows_lazy))
         yield self.record_batch(
             batch_from_vals(vals, self.output_schema, batch.num_rows_lazy))

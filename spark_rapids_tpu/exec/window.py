@@ -118,13 +118,14 @@ class TpuWindowExec(TpuExec):
         sml = self._str_lens(batch, all_keys)
         run = self.window_fn(cap, sml)
         key = (batch_signature(batch), cap, sml)
-        if key not in self._jits:
-            from .base import note_compile_miss
+        # the shared pipeline-cache guard: miss accounting + the
+        # compiled-program cost plane ride cached_pipeline (xla_cost.py)
+        from .base import cached_pipeline
 
-            note_compile_miss("window")
-            self._jits[key] = jax.jit(run)
+        fn = cached_pipeline(self._jits, key, "window",
+                             lambda: jax.jit(run))
         with self.op_timed():
-            vals = self._jits[key](
+            vals = fn(
                 vals_of_batch(batch), count_scalar(batch.num_rows_lazy))
         yield self.record_batch(
             batch_from_vals(vals, self._schema, batch.num_rows_lazy))

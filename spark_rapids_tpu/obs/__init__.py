@@ -41,8 +41,9 @@ __all__ = [
     "Alert", "EVENT_BACKED_METRICS", "METRICS", "MetricsRegistry",
     "ObsPlane", "ProgressTracker", "Watchdog", "WatchdogRules",
     "active", "add_op_time", "enabled", "ensure_started", "inc",
-    "install", "note_compile_miss", "note_op_batch", "note_query_end",
-    "note_query_start", "observe", "plane", "replay_alerts",
+    "install", "note_compile_miss", "note_op_batch", "note_program_cost",
+    "note_query_end", "note_query_start", "observe", "plane",
+    "replay_alerts",
     "set_gauge", "shutdown", "span_close", "span_open", "tracker",
     "uninstall",
 ]
@@ -87,6 +88,20 @@ def note_compile_miss(site: str) -> None:
     reg = active()
     if reg is not None:
         reg.note_compile_miss(site)
+
+
+def note_program_cost(site: str, trace_s: float, compile_s: float,
+                      temp_bytes: Optional[int] = None) -> None:
+    """Live twins of the program_cost event (xla_cost.py): compile
+    seconds by site+phase, and the largest-temp-allocation high-water
+    gauge (None when the backend's memory_analysis reported nothing)."""
+    reg = active()
+    if reg is None:
+        return
+    reg.inc("tpu_compile_seconds", trace_s, site=site, phase="trace")
+    reg.inc("tpu_compile_seconds", compile_s, site=site, phase="compile")
+    if temp_bytes is not None:
+        reg.set_gauge_max("tpu_program_temp_bytes", temp_bytes, site=site)
 
 
 def note_query_start(query_id, plan_digest: str = "",

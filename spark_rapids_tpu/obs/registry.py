@@ -64,6 +64,15 @@ METRICS: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
         HISTOGRAM, "Per-batch operator host time distribution", ("op",)),
     "tpu_compile_misses": (
         COUNTER, "XLA pipeline-cache compile misses by site", ("site",)),
+    "tpu_compile_seconds": (
+        COUNTER, "Harvested XLA program build time by site and phase "
+        "(trace = jit lower, compile = XLA backend compile — the "
+        "program_cost event's live twin, xla_cost.py)", ("site", "phase")),
+    "tpu_program_temp_bytes": (
+        GAUGE, "Largest XLA temp allocation harvested per compile site "
+        "(memory_analysis temp_size_in_bytes high-water mark; a jump "
+        "means a kernel started materializing intermediates the layout "
+        "model doesn't know about)", ("site",)),
     "tpu_transfers": (
         COUNTER, "Host-link transfers by direction (h2d/d2h/fence)",
         ("direction",)),
@@ -151,6 +160,7 @@ EVENT_BACKED_METRICS: Dict[str, str] = {
     "op_span": "tpu_op_time_seconds",
     "op_batch": "tpu_op_rows",
     "compile_miss": "tpu_compile_misses",
+    "program_cost": "tpu_compile_seconds",
     "transfer": "tpu_transfer_bytes",
     "spill": "tpu_spill_bytes",
     "shuffle_write": "tpu_shuffle_bytes",
@@ -210,6 +220,17 @@ class MetricsRegistry:
         key = _label_values(name, labels)
         with self._lock:
             self._vals[name][key] = float(value)
+
+    def set_gauge_max(self, name: str, value: float, **labels: str) -> None:
+        """High-water-mark gauge write: keeps the larger of the current
+        and new value under ONE lock acquisition (a read-then-set pair
+        would race concurrent emitters)."""
+        key = _label_values(name, labels)
+        with self._lock:
+            d = self._vals[name]
+            cur = d.get(key)
+            if cur is None or value > cur:
+                d[key] = float(value)
 
     def observe(self, name: str, value: float, **labels: str) -> None:
         key = _label_values(name, labels)

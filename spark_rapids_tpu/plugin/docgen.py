@@ -28,7 +28,8 @@ def _import_conf_modules() -> None:
     for mod in ("spark_rapids_tpu.events",
                 "spark_rapids_tpu.memory.catalog",
                 "spark_rapids_tpu.ml.columnar_rdd",
-                "spark_rapids_tpu.serve.scheduler"):
+                "spark_rapids_tpu.serve.scheduler",
+                "spark_rapids_tpu.xla_cost"):
         try:
             importlib.import_module(mod)
         except ImportError:

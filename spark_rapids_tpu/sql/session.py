@@ -381,6 +381,15 @@ class TpuSession:
         # snapshot BEFORE execution so explain_metrics reports only the
         # misses THIS plan's run compiled (the counter is process-global)
         self._compile_baseline = compile_snapshot()
+        from .. import xla_cost as _xla_cost
+
+        # same pattern for harvested program costs: the report shows the
+        # XLA cost columns for programs THIS run compiled (a warm rerun
+        # compiles nothing, so its report carries none — steady state);
+        # conf-declared roofline peaks ride in the harvested events so
+        # the offline profiler (which has no conf) honors calibration
+        self._cost_baseline = _xla_cost.snapshot()
+        _xla_cost.set_conf_peaks(self.conf)
         if self.events.enabled or obs_on:
             qid = self._active_query = _next_query_id()
             if self.events.enabled:
@@ -568,9 +577,16 @@ class TpuSession:
         table each GpuExec publishes). Every exec line shows wall-clock
         totalTime, output rows/batches, and bytesTouched; runs under
         spark.rapids.tpu.metrics.deviceSync.enabled add device-accurate
-        opTimeDevice and a derived per-op HBM GB/s. The footer counts XLA
-        pipeline compile-cache misses by site for THIS plan's run (a
-        recompile-storm detector). How to read it: docs/tuning.md."""
+        opTimeDevice and a derived per-op HBM GB/s labeled by the lane
+        that fed it (hbm_gbps[device] preferred; hbm_gbps[host]
+        otherwise — the host lane understates async device work, so its
+        figure overstates bandwidth and says so in its label). When the
+        cost plane harvested programs during the run (event log / obs
+        on), per-op xla_bytes/xla_flops/xla_gbps columns report what XLA
+        actually compiled. The footer counts XLA pipeline compile-cache
+        misses by site for THIS plan's run (a recompile-storm detector)
+        plus the harvested trace/compile time split. How to read it:
+        docs/tuning.md."""
         from ..exec.base import TpuExec, format_metrics
 
         plan = self.last_executed_plan
@@ -579,7 +595,9 @@ class TpuSession:
         node = plan.tpu_child if isinstance(plan, ColumnarToRowExec) else plan
         if not isinstance(node, TpuExec):
             return "<last plan ran on CPU; no device metrics>"
-        return format_metrics(node, getattr(self, "_compile_baseline", None))
+        return format_metrics(node, getattr(self, "_compile_baseline", None),
+                              cost_since=getattr(self, "_cost_baseline",
+                                                 None))
 
 
 class GroupedData:

@@ -104,18 +104,32 @@ def assert_tpu_and_cpu_equal(
     before = len(_TC.cross_check_log())
     cpu_rows = build(cpu_sess).collect()
     snap = compile_snapshot()
-    tpu_rows = build(tpu_sess).collect()
+    # harvest the compiled-program cost plane (xla_cost.py) during the
+    # TPU run: every differential test exercises the CostProbe path and
+    # the analyzer-bound vs XLA-bytes comparison below (the cost of a
+    # probe is the same trace+compile jit would have done lazily)
+    from spark_rapids_tpu import xla_cost as _XC
+
+    cost_snap = _XC.snapshot()
+    prev_harvest = _XC.FORCE_HARVEST
+    _XC.FORCE_HARVEST = True
+    try:
+        tpu_rows = build(tpu_sess).collect()
+    finally:
+        _XC.FORCE_HARVEST = prev_harvest
     new = _TC.cross_check_log()[before:]
     assert not new, (
         "static matrix vs lowering-probe verdict disagreement:\n"
         + "\n".join(new)
     )
     compare_rows(cpu_rows, tpu_rows, ignore_order, approx_float)
-    _assert_analysis_cross_check(tpu_sess, snap, build, tpu_conf, tpu_rows)
+    _assert_analysis_cross_check(tpu_sess, snap, build, tpu_conf, tpu_rows,
+                                 cost_snap=cost_snap)
     return cpu_rows
 
 
-def _assert_analysis_cross_check(tpu_sess, snap, build, tpu_conf, tpu_rows):
+def _assert_analysis_cross_check(tpu_sess, snap, build, tpu_conf, tpu_rows,
+                                 cost_snap=None):
     """The static-plan-analyzer cross-check (plugin/plananalysis.py):
 
     1. for BOUNDED plans, the actual per-run compile cache-miss delta at
@@ -125,8 +139,33 @@ def _assert_analysis_cross_check(tpu_sess, snap, build, tpu_conf, tpu_rows):
     2. for BOUNDED plans, every operator's measured bytesTouched is
        covered by the analyzer's static byte bound;
     3. when the run elided validity planes, a rerun on the mask-carrying
-       path (nullElision disabled) produces identical results.
+       path (nullElision disabled) produces identical results;
+    4. every program cost harvested during the run is well-formed
+       (site/digest present, non-negative phase times), and the
+       analyzer-bound vs XLA-bytes comparison is recorded on the session
+       as ``last_xla_vs_analyzer`` — XLA ABOVE the bound is expected
+       (temp-inflated kernels) and deliberately NOT asserted against:
+       it is the roofline-push lead, not a bug.
     """
+    if cost_snap is not None:
+        from spark_rapids_tpu import xla_cost as _XC
+
+        recs = _XC.records_since(cost_snap)
+        for r in recs:
+            assert r.get("site") and r.get("digest"), r
+            assert (r.get("trace_ms") or 0) >= 0, r
+            assert (r.get("compile_ms") or 0) >= 0, r
+        an = tpu_sess.last_analysis
+        bounds = an.bytes_by_op if an is not None else {}
+        comparison = {}
+        for r in recs:
+            op = r.get("op")
+            if op and r.get("bytes_accessed") is not None:
+                xb, _ = comparison.get(op, (0.0, None))
+                comparison[op] = (xb + r["bytes_accessed"],
+                                  bounds.get(op))
+        tpu_sess.last_xla_vs_analyzer = comparison
+
     analysis = tpu_sess.last_analysis
     if analysis is None:
         return

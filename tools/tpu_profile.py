@@ -11,6 +11,16 @@ Report sections:
   * top ops           — top-N operators by device time (host time when no
                         deviceSync lane was recorded), batches/rows/bytes
   * compile misses    — per-site counts, storm flag at/over the threshold
+  * roofline          — per compile site: harvested XLA cost
+                        (program_cost events) joined against the op_span
+                        device lane into achieved GB/s and FLOP/s versus
+                        the backend's declared peaks, a bandwidth- vs
+                        compute-limited classification, the program
+                        furthest below roofline, and the analyzer-bound
+                        vs XLA-bytes delta (XLA above the bound means the
+                        kernel materializes intermediates the layout
+                        model doesn't know about — the roofline-push
+                        lead, not a violation)
   * transfers         — host-link bytes each way + sync-point count
   * shuffle           — pieces/bytes/rows each way, per codec
   * spill timeline    — every spill/unspill with the live device-byte
@@ -51,10 +61,29 @@ from typing import Dict, List, Optional, Tuple
 
 DEFAULT_STORM_THRESHOLD = 8
 #: time deltas under this (ns) are measurement noise, never a regression
+#: (also applied to harvested compile-time deltas in --diff: trace/
+#: compile jitter below the floor is never flagged)
 DIFF_MIN_NS = 1_000_000
 #: same floor for bench-JSON ms fields (0.1ms of scheduler jitter on a
 #: 0.3ms shape is a 1.33x "ratio", not a regression)
 DIFF_MIN_MS = 1.0
+#: hbm_frac_* gates only fire when the OLD run's fraction was above this
+#: floor — below it the figure is quantization noise and any ratio is
+#: meaningless (must sit under the committed BENCH shape values, which
+#: run ~2e-4..6e-3 on the CPU fallback, or the gate is dead exactly
+#: where CI runs it)
+DIFF_MIN_FRAC = 1e-4
+
+#: per-backend (peak HBM GB/s, peak TFLOP/s) used when --peak-hbm-gbps /
+#: --peak-tflops are not given; MUST mirror
+#: spark_rapids_tpu.xla_cost.BACKEND_PEAKS (tests/test_program_cost.py
+#: pins the two in sync — duplicated here so the offline tool never
+#: needs to import jax just to read a constant)
+BACKEND_PEAKS = {
+    "tpu": (819.0, 197.0),
+    "gpu": (900.0, 19.5),
+    "cpu": (100.0, 1.0),
+}
 
 
 # ---------------------------------------------------------------------------
@@ -215,6 +244,180 @@ def _query_windows(events: List[dict]) -> List[dict]:
     return order
 
 
+def roofline_section(events: List[dict], queries: List[dict],
+                     peak_gbps: Optional[float] = None,
+                     peak_tflops: Optional[float] = None,
+                     ops: Optional[Dict[str, "OpStats"]] = None
+                     ) -> List[str]:
+    """Join each compile site's harvested XLA cost (program_cost events)
+    against its op's measured device lane: achieved GB/s and FLOP/s vs
+    the declared peaks, limiter classification, the program furthest
+    below roofline, and the analyzer-bound vs XLA-bytes delta.
+
+    Honest accounting: ``bytes_accessed``/``flops`` are PER-INVOCATION
+    figures of each distinct compiled program, summed once each — so the
+    achieved numbers are lower bounds that are exact for a cold
+    single-dispatch run (the bench/CI case) and conservative when
+    programs re-dispatched. An op's measured lane is ONE denominator:
+    sites sharing an op (the aggregate compiles at agg_update AND
+    agg_plan inside the same op_timed scope) get one combined
+    ``op=...`` achieved line over the group's summed bytes instead of
+    each dividing by the op's whole lane (which would double-count time
+    and understate every row). Sites whose backend reported partial
+    cost keys (the CPU fallback) degrade to partial rows, never
+    errors."""
+    costs = [r for r in events if r.get("event") == "program_cost"]
+    lines = ["== roofline =="]
+    if not costs:
+        lines.append("  no program_cost events (cost plane saw no compile"
+                     " misses — warm caches, or the log predates it)")
+        return lines
+    backend = next((r.get("backend") for r in costs if r.get("backend")),
+                   None)
+    dg, dt = BACKEND_PEAKS.get(backend or "", BACKEND_PEAKS["cpu"])
+    # peak resolution: CLI flag > conf-declared peaks riding in the
+    # events (spark.rapids.tpu.roofline.* at harvest time — the only
+    # channel a session conf has to this offline tool) > backend default
+    logged_g = next((r.get("peak_hbm_gbps") for r in costs
+                     if r.get("peak_hbm_gbps")), None)
+    logged_t = next((r.get("peak_tflops") for r in costs
+                     if r.get("peak_tflops")), None)
+    peak_gbps = peak_gbps or logged_g or dg
+    peak_tflops = peak_tflops or logged_t or dt
+    if ops is None:
+        ops = aggregate_ops(events)
+    # analyzer comparison is PER QUERY: each query's own (site, op) XLA
+    # traffic against ITS analyzer bound — a merged multi-query log must
+    # not sum ten queries' bytes against one query's bound, and an op
+    # must not be charged a site-mate's bytes
+    per_q: Dict[Tuple[str, str], List[Tuple[float, int]]] = defaultdict(list)
+    for q in queries:
+        qb = (q.get("analysis") or {}).get("bytes_by_op") or {}
+        acc: Dict[Tuple[str, str], float] = defaultdict(float)
+        for r in q.get("events", []):
+            if (r.get("event") == "program_cost" and r.get("op")
+                    and r.get("bytes_accessed") is not None):
+                acc[(r.get("site"), r["op"])] += r["bytes_accessed"]
+        for (site, op), xb in acc.items():
+            if qb.get(op) is not None:
+                per_q[(site, op)].append((xb, qb[op]))
+    sites: Dict[str, dict] = {}
+    for r in costs:
+        s = sites.setdefault(r.get("site"), {
+            "programs": 0, "bytes": 0.0, "flops": 0.0, "temp": 0,
+            "compile_ms": 0.0, "ops": set(), "partial": False,
+            "by_op": {}})
+        s["programs"] += 1
+        s["compile_ms"] += (r.get("trace_ms") or 0) + (r.get("compile_ms")
+                                                       or 0)
+        if r.get("bytes_accessed") is None:
+            s["partial"] = True
+        else:
+            s["bytes"] += r["bytes_accessed"]
+        if r.get("flops") is not None:
+            s["flops"] += r["flops"]
+        if r.get("temp_bytes") is not None:
+            s["temp"] = max(s["temp"], r["temp_bytes"])
+        if r.get("op"):
+            s["ops"].add(r["op"])
+            d = s["by_op"].setdefault(r["op"], {"bytes": 0.0, "flops": 0.0})
+            d["bytes"] += r.get("bytes_accessed") or 0
+            d["flops"] += r.get("flops") or 0
+    lines.append(f"  peaks: {peak_gbps:.0f} GB/s, {peak_tflops:.1f} "
+                 f"TFLOP/s (backend {backend or '?'}; override with "
+                 "spark.rapids.tpu.roofline.peakHbmGBps/.peakTflops or "
+                 "--peak-hbm-gbps/--peak-tflops)")
+    # which sites claim each op: ops claimed by >1 site get ONE combined
+    # achieved line (the op's lane is one denominator, not one per site)
+    op_claims: Dict[str, set] = {}
+    for site, s in sites.items():
+        for o in s["ops"]:
+            op_claims.setdefault(o, set()).add(site)
+    shared_ops = {o for o, cl in op_claims.items() if len(cl) > 1}
+    by_shared_op: Dict[str, dict] = {}
+    for r in costs:
+        o = r.get("op")
+        if o in shared_ops:
+            d = by_shared_op.setdefault(o, {"bytes": 0.0, "flops": 0.0})
+            d["bytes"] += r.get("bytes_accessed") or 0
+            d["flops"] += r.get("flops") or 0
+
+    def achieved(t_ns: float, lane: str, nbytes: float, nflops: float
+                 ) -> Tuple[str, float, str]:
+        gbps = nbytes / t_ns          # bytes/ns == GB/s
+        tflops = nflops / t_ns / 1e3  # flops/ns == GFLOP/s
+        bw_frac = gbps / peak_gbps if peak_gbps else 0.0
+        fl_frac = tflops / peak_tflops if peak_tflops else 0.0
+        limiter = ("bandwidth-limited" if bw_frac >= fl_frac
+                   else "compute-limited")
+        return (f"achieved[{lane}]={gbps:.3f}GB/s "
+                f"({bw_frac * 100:.2f}% of peak) "
+                f"{tflops * 1e3:.3f}GFLOP/s "
+                f"({fl_frac * 100:.2f}%) -> {limiter}",
+                max(bw_frac, fl_frac), limiter)
+
+    worst: Optional[Tuple[float, str, str]] = None
+    for site, s in sorted(sites.items()):
+        opl = ",".join(sorted(s["ops"])) or "?"
+        row = (f"  site={site} op={opl} programs={s['programs']} "
+               f"compile={s['compile_ms']:.1f}ms "
+               f"xla_bytes={_mb(s['bytes']) if s['bytes'] else '-'}")
+        if s["temp"]:
+            row += f" peak_temp={_mb(s['temp'])}"
+        # a site's own achieved figure covers only the ops it owns
+        # EXCLUSIVELY (shared ops render on the combined lines below);
+        # a mixed site still gets a row for its exclusive share
+        excl = [o for o in s["ops"] if o not in shared_ops]
+        ex_bytes = s["bytes"] - sum(s["by_op"][o]["bytes"]
+                                    for o in s["ops"] if o in shared_ops)
+        ex_flops = s["flops"] - sum(s["by_op"][o]["flops"]
+                                    for o in s["ops"] if o in shared_ops)
+        dev_ns = sum(ops[o].device_ns for o in excl if o in ops)
+        host_ns = sum(ops[o].host_ns for o in excl if o in ops)
+        t_ns, lane = (dev_ns, "device") if dev_ns else (host_ns, "host")
+        if t_ns and (ex_bytes or ex_flops):
+            txt, score, limiter = achieved(t_ns, lane, ex_bytes, ex_flops)
+            row += " " + txt
+            if worst is None or score < worst[0]:
+                worst = (score, site, limiter)
+        elif s["partial"] and not s["bytes"]:
+            row += " (backend reported no byte/flop cost keys)"
+        lines.append(row)
+        for o in sorted(s["ops"]):
+            pairs = per_q.get((site, o))
+            if not pairs:
+                continue
+            # show the worst single query (largest overshoot)
+            xb, b = max(pairs, key=lambda t: t[0] - t[1])
+            if xb > b:
+                lines.append(
+                    f"    {o}: XLA touches {_mb(xb)} > analyzer "
+                    f"bound {_mb(b)} (+{_mb(xb - b)} materialized "
+                    "intermediates — roofline-push lead)")
+            else:
+                lines.append(
+                    f"    {o}: XLA touches {_mb(xb)} <= analyzer "
+                    f"bound {_mb(b)}")
+    for o in sorted(shared_ops):
+        st = ops.get(o)
+        d = by_shared_op.get(o, {})
+        if st is None or not (d.get("bytes") or d.get("flops")):
+            continue
+        t_ns, lane = ((st.device_ns, "device") if st.device_ns
+                      else (st.host_ns, "host"))
+        if not t_ns:
+            continue
+        group = "+".join(sorted(op_claims[o]))
+        txt, score, limiter = achieved(t_ns, lane, d["bytes"], d["flops"])
+        lines.append(f"  op={o} sites={group} {txt}")
+        if worst is None or score < worst[0]:
+            worst = (score, f"{o} ({group})", limiter)
+    if worst is not None:
+        lines.append(f"  furthest below roofline: {worst[1]} at "
+                     f"{worst[0] * 100:.2f}% of peak ({worst[2]})")
+    return lines
+
+
 def forecast_vs_actual(queries: List[dict]) -> Tuple[List[str], int]:
     """Per bounded query: measured compile misses per site vs the
     analyzer's forecast, and measured per-op bytes vs the byte bound.
@@ -263,6 +466,31 @@ def forecast_vs_actual(queries: List[dict]) -> Tuple[List[str], int]:
             else:
                 lines.append(f"  query {qid} bytes[{op}]: measured "
                              f"{_mb(got)} <= bound {_mb(bound)}")
+        # analyzer bound vs XLA's compiler-reported bytes: the layout
+        # model bounds what rows REQUIRE; XLA reports what the compiled
+        # kernel TOUCHES (temp-inflated). XLA above the bound is the
+        # interesting signal — the kernel materializes intermediates the
+        # layout model doesn't know about — and a lead, NOT a violation.
+        xla_by_op: Dict[str, float] = defaultdict(float)
+        for r in q["events"]:
+            if (r.get("event") == "program_cost" and r.get("op")
+                    and r.get("bytes_accessed") is not None):
+                xla_by_op[r["op"]] += r["bytes_accessed"]
+        for op in sorted(xla_by_op):
+            bound = bounds.get(op)
+            if bound is None:
+                continue
+            got = xla_by_op[op]
+            if got > bound:
+                lines.append(
+                    f"  query {qid} xla[{op}]: XLA bytes {_mb(got)} "
+                    f"exceed analyzer bound {_mb(bound)} "
+                    f"(+{_mb(got - bound)} materialized intermediates — "
+                    "roofline-push lead, not a violation)")
+            else:
+                lines.append(
+                    f"  query {qid} xla[{op}]: XLA bytes {_mb(got)} "
+                    f"within analyzer bound {_mb(bound)}")
     if not lines:
         lines.append("  no plan_analysis events in log (enable "
                      "sql.analysis.enabled with the event log on)")
@@ -274,8 +502,9 @@ def forecast_vs_actual(queries: List[dict]) -> Tuple[List[str], int]:
 # the report
 # ---------------------------------------------------------------------------
 def build_report(events: List[dict], top_n: int = 10,
-                 storm_threshold: int = DEFAULT_STORM_THRESHOLD
-                 ) -> Tuple[str, int]:
+                 storm_threshold: int = DEFAULT_STORM_THRESHOLD,
+                 peak_gbps: Optional[float] = None,
+                 peak_tflops: Optional[float] = None) -> Tuple[str, int]:
     """(report text, violation count) for one merged event stream."""
     lines: List[str] = []
     queries = _query_windows(events)
@@ -325,6 +554,9 @@ def build_report(events: List[dict], top_n: int = 10,
     for site, n in sorted(sites.items(), key=lambda kv: -kv[1]):
         storm = " <-- COMPILE STORM" if n >= storm_threshold else ""
         lines.append(f"  {site}: {n}{storm}")
+
+    lines.extend(roofline_section(events, queries, peak_gbps, peak_tflops,
+                                  ops=ops))
 
     xfer: Dict[str, List[int]] = defaultdict(lambda: [0, 0])
     for r in events:
@@ -535,6 +767,24 @@ def diff_bench(old: dict, new: dict, threshold: float
                 lines.append(
                     f"  {shape}.{field}: ok {va:.1f} -> {vb:.1f} "
                     f"({ratio:.2f}x)")
+        # compiler-reported HBM utilization: compared only when BOTH
+        # runs harvested it (hbm_frac_xla = XLA bytes / device time /
+        # peak); a relative drop beyond the threshold means the device
+        # got less busy for the same compiled work
+        fa, fb = a.get("hbm_frac_xla"), b.get("hbm_frac_xla")
+        if fa is not None and fb is not None and fa > DIFF_MIN_FRAC:
+            # same unbounded ratio form as the tpu_ms/device_ms gates: a
+            # drop-fraction ((fa-fb)/fa) saturates at 1.0 and can never
+            # clear CI's threshold 2.0, so a full collapse would pass
+            ratio = fa / fb if fb > 0 else float("inf")
+            if ratio > 1.0 + threshold:
+                regressions += 1
+                lines.append(f"  {shape}.hbm_frac_xla: REGRESSION "
+                             f"{fa:.4f} -> {fb:.4f} ({ratio:.2f}x drop, "
+                             f"threshold {1 + threshold:.2f}x)")
+            else:
+                lines.append(f"  {shape}.hbm_frac_xla: ok {fa:.4f} -> "
+                             f"{fb:.4f}")
     # serving lane (bench.py --serve): structural gates always — the new
     # run must be internally clean (ok flag: no errors/rejects/bypass,
     # summed forecasts within budget) and must still beat serialized
@@ -689,8 +939,48 @@ def diff_logs(old_events: List[dict], new_events: List[dict],
             regressions += 1
             lines.append(f"  {op}.bytes: REGRESSION {_mb(sa.bytes)} -> "
                          f"{_mb(sb.bytes)}")
+    # roofline gates over harvested program costs: a site whose XLA
+    # bytes_accessed or peak temp allocation GREW beyond the threshold is
+    # a silent intermediate-materialization regression — exactly what the
+    # cost plane exists to catch. Compile-TIME deltas stay subject to the
+    # 1ms noise floor (trace/compile jitter is never a regression).
+    ca, cb = _site_costs(old_events), _site_costs(new_events)
+    for site in sorted(set(ca) & set(cb)):
+        a_c, b_c = ca[site], cb[site]
+        for field, label in (("bytes", "xla_bytes"), ("temp", "peak_temp")):
+            va, vb = a_c[field], b_c[field]
+            if va <= 0 or vb <= va * (1.0 + threshold):
+                if va > 0 and vb > 0:
+                    lines.append(f"  {site}.{label}: ok {_mb(va)} -> "
+                                 f"{_mb(vb)}")
+                continue
+            regressions += 1
+            lines.append(f"  {site}.{label}: REGRESSION {_mb(va)} -> "
+                         f"{_mb(vb)} (intermediate materialization?)")
+        va, vb = a_c["compile_ns"], b_c["compile_ns"]
+        if (va > 0 and vb > va * (1.0 + threshold)
+                and vb - va > DIFF_MIN_NS):
+            regressions += 1
+            lines.append(f"  {site}.compile: REGRESSION {_ms(va)} -> "
+                         f"{_ms(vb)}")
     lines.append(f"  {regressions} regression(s)")
     return "\n".join(lines), regressions
+
+
+def _site_costs(events: List[dict]) -> Dict[str, dict]:
+    """Per-site program_cost aggregates for --diff: summed bytes, peak
+    temp, summed trace+compile ns (fields the backend omitted count 0)."""
+    per: Dict[str, dict] = {}
+    for r in events:
+        if r.get("event") != "program_cost":
+            continue
+        d = per.setdefault(r.get("site"),
+                           {"bytes": 0.0, "temp": 0, "compile_ns": 0})
+        d["bytes"] += r.get("bytes_accessed") or 0
+        d["temp"] = max(d["temp"], r.get("temp_bytes") or 0)
+        d["compile_ns"] += int(((r.get("trace_ms") or 0)
+                                + (r.get("compile_ms") or 0)) * 1e6)
+    return per
 
 
 def run_diff(old_path: str, new_path: str, threshold: float
@@ -734,6 +1024,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--storm-threshold", type=int,
                     default=DEFAULT_STORM_THRESHOLD,
                     help="compile misses per site that flag a storm")
+    ap.add_argument("--peak-hbm-gbps", type=float, default=None,
+                    help="roofline peak HBM bandwidth (GB/s); default: "
+                         "per-backend from the log's program_cost events")
+    ap.add_argument("--peak-tflops", type=float, default=None,
+                    help="roofline peak compute (TFLOP/s); default: "
+                         "per-backend from the log's program_cost events")
     ap.add_argument("--alerts", action="store_true",
                     help="replay the live watchdog rules over the log(s) "
                          "to tune thresholds offline (obs/watchdog.py)")
@@ -775,7 +1071,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not events:
         print("no events found", file=sys.stderr)
         return 1
-    text, violations = build_report(events, args.top, args.storm_threshold)
+    text, violations = build_report(events, args.top, args.storm_threshold,
+                                    peak_gbps=args.peak_hbm_gbps,
+                                    peak_tflops=args.peak_tflops)
     print(text)
     # forecast violations mean the analyzer's bounds or the emitters
     # drifted — CI runs this on a fresh log so the drift can't land
