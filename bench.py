@@ -178,6 +178,29 @@ def _xla_stats(cost_snapshot, device_ms, peak_gbps=HBM_GBPS):
     return out
 
 
+def _hlo_stats(hlo_snapshot):
+    """Per-shape per-fusion attribution block (hlo.py, harvested under
+    the same FORCE_HARVEST warm-up as _xla_stats): ``hlo_top_fusion_
+    bytes`` is the largest single-fusion byte attribution across the
+    shape's compiled programs — the instruction the roofline push must
+    shrink — and ``hlo_scatter_count`` the scatter-classified
+    instructions across those programs (the amplification idiom; the
+    --diff gate flags any same-strategy increase). Both None when no
+    program was harvested (warm caches or unparseable dialect)."""
+    from spark_rapids_tpu import hlo
+
+    recs = hlo.records_since(hlo_snapshot)
+    if not recs:
+        return {"hlo_top_fusion_bytes": None, "hlo_scatter_count": None}
+    top = 0
+    scat = 0
+    for r in recs:
+        scat += r.get("scatter_count") or 0
+        for f in r.get("top_fusions") or []:
+            top = max(top, f.get("bytes") or 0)
+    return {"hlo_top_fusion_bytes": top or None, "hlo_scatter_count": scat}
+
+
 def _agg_strategy_of(exec_):
     """The aggregation strategy the plan's aggregate exec(s) resolved at
     execution (conf sql.agg.strategy; exec/aggregate.resolved_strategy) —
@@ -922,8 +945,11 @@ def run_mesh_lane(args) -> None:
            if speeds else None)
     host_par = min(n_dev, os.cpu_count() or 1)
     backend = jax.devices()[0].platform
+    from spark_rapids_tpu import envinfo
+
     print(json.dumps({
         "metric": "mesh_scaling",
+        "env": envinfo.environment_info(),
         "n_devices": n_dev,
         "backend": backend + (
             "-host-fallback" if backend == "cpu" else ""),
@@ -1094,8 +1120,11 @@ def run_serve_lane(args) -> None:
               and (st["peak_inflight_forecast"] <= budget
                    if budget else True),
     }
+    from spark_rapids_tpu import envinfo
+
     print(json.dumps({
         "metric": "serve_throughput",
+        "env": envinfo.environment_info(),
         # empty per_shape marks this as a bench-family json so
         # tpu_profile --diff routes it through diff_bench's serve gates
         "per_shape": {},
@@ -1165,9 +1194,15 @@ def main() -> None:
     # compile miss (warm-up only — the timed iterations compile nothing)
     # so each shape reports hbm_frac_xla, the compiler-reported twin of
     # the layout-derived hbm_frac_device; the two bound the truth
-    from spark_rapids_tpu import xla_cost
+    from spark_rapids_tpu import envinfo, hlo, xla_cost
 
     xla_cost.FORCE_HARVEST = True
+    # environment provenance: stamped into the BENCH json top level (and
+    # printed up front) so a later --diff can warn when two rounds came
+    # from different hardware — the CPU-fallback-vs-device confusion
+    # every round since r06 has had to caveat in prose
+    env = envinfo.environment_info()
+    print("env: " + envinfo.describe(env), file=sys.stderr)
     bench_logger = None
     if args.event_log:
         # event-log the whole bench: the session-path shapes pick the dir
@@ -1192,10 +1227,12 @@ def main() -> None:
         carg = conf_dict if name == "parquet" else conf
         mem_before = _mem_snapshot()
         cost_before = xla_cost.snapshot()
+        hlo_before = hlo.snapshot()
         cpu_t, tpu_t, extra = fn(args.scale, args.iters, carg, T, E, A, X)
         extra.update(_mem_stats(mem_before))
         extra.update(_xla_stats(cost_before, extra.get("device_ms"),
                                 peak_gbps))
+        extra.update(_hlo_stats(hlo_before))
         sp = cpu_t / tpu_t
         results[name] = sp
         details[name] = {"speedup": round(sp, 2),
@@ -1236,6 +1273,7 @@ def main() -> None:
         "unit": f"x (pipeline wallclock; scale={args.scale})",
         "vs_baseline": round(geomean / 4.0, 3),
         "geomean_all_shapes": round(geomean, 3),
+        "env": env,
         "per_shape": details,
         **extras,
     }))

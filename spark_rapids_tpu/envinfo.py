@@ -1,0 +1,64 @@
+"""Environment provenance: which hardware produced these numbers.
+
+Every BENCH round since PR 1 has carried a prose caveat ("CPU fallback,
+tunnel down, not comparable to r05") because nothing machine-readable
+recorded WHAT backend a run measured. This helper is the one home for
+that record: bench.py stamps it into every ``BENCH_*.json`` /
+``MULTICHIP_*.json`` top level, the session rides it on ``query_start``
+events, ``/status`` serves it live, and ``tpu_profile --diff`` warns
+loudly when two runs' backends or device kinds differ — numbers from
+different hardware compare structure, not speed.
+
+Memoized after the first call: ``jax.devices()`` is cheap once the
+backend exists, but this is called on every query_start with events on,
+and the answer cannot change within a process (jax pins its backend at
+first use).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+_CACHED: Optional[Dict[str, Any]] = None
+
+
+def environment_info() -> Dict[str, Any]:
+    """{backend, device_kind, device_count, jax_version, host_cores} —
+    plain JSON, safe to embed in events and bench payloads."""
+    global _CACHED
+    if _CACHED is None:
+        import jax
+
+        devs = jax.devices()
+        _CACHED = {
+            "backend": jax.default_backend(),
+            "device_kind": devs[0].device_kind if devs else None,
+            "device_count": len(devs),
+            "jax_version": jax.__version__,
+            "host_cores": os.cpu_count(),
+        }
+    return dict(_CACHED)
+
+
+def describe(env: Optional[Dict[str, Any]]) -> str:
+    """One operator-readable line ("backend=cpu device=TFRT_CPU x2
+    jax=0.4.37") shared by /status consumers (tpu_top) and bench
+    stderr."""
+    if not env:
+        return "backend=?"
+    return (f"backend={env.get('backend')} "
+            f"device={env.get('device_kind')} "
+            f"x{env.get('device_count')} "
+            f"jax={env.get('jax_version')}")
+
+
+def environments_differ(a: Optional[Dict[str, Any]],
+                        b: Optional[Dict[str, Any]]) -> bool:
+    """True when two provenance blocks name different hardware (backend
+    or device kind) — the condition under which absolute times and HBM
+    fractions are NOT comparable. Missing blocks (pre-provenance logs)
+    never differ: no evidence, no warning."""
+    if not a or not b:
+        return False
+    return (a.get("backend") != b.get("backend")
+            or a.get("device_kind") != b.get("device_kind"))

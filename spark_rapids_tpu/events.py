@@ -51,6 +51,15 @@ EVENT_LOG_RING_SIZE = conf(
     "Events retained in the in-memory ring buffer that backs "
     "TpuSession.export_trace() (oldest dropped first). The JSONL sink is "
     "unbounded; the ring only bounds in-process memory.")
+EVENT_LOG_FLIGHT_RECORDER = conf(
+    "spark.rapids.tpu.eventLog.flightRecorder.enabled", False,
+    "Run the event log as a flight recorder: with eventLog.dir set, "
+    "events land ONLY in the in-memory ring buffer (no streaming JSONL "
+    "file), and each watchdog alert episode (obs/watchdog.py) dumps the "
+    "ring to eventLog.dir as one tpu-flightrec-<pid>-<episode>.jsonl — "
+    "post-hoc diagnosis of a misbehaving run without the volume of full "
+    "logging. Requires the watchdog (spark.rapids.tpu.watchdog.enabled) "
+    "for the trigger; TpuSession.export_trace() still reads the ring.")
 
 
 # ---------------------------------------------------------------------------
@@ -91,6 +100,17 @@ EVENT_TYPES: Dict[str, tuple] = {
     "program_cost": ("site", "digest", "backend", "trace_ms",
                      "compile_ms", "flops", "bytes_accessed", "temp_bytes",
                      "argument_bytes", "output_bytes"),
+    # per-fusion HLO attribution of one harvested program (hlo.py):
+    # emitted right after its program_cost twin (same site+digest), it
+    # names WHICH instructions own the bytes — top-K fusions by
+    # attributed bytes with an idiom classification (scatter-add /
+    # one-hot dot / gather / transpose-copy / collective), the
+    # module-wide scatter count, the largest-output producer, and the
+    # parse coverage fraction (text parsing over backend dialects is
+    # best-effort: coverage < 1 explains a shortfall, never a failure)
+    "hlo_summary": ("site", "digest", "backend", "instructions",
+                    "coverage", "total_bytes", "scatter_count",
+                    "top_fusions", "largest_output"),
     # host-link transfers: packed uploads (h2d), sanctioned host_pull
     # reads (d2h), host_fence sync points (direction "fence", 0 bytes)
     "transfer": ("direction", "bytes", "site"),
@@ -138,6 +158,11 @@ EVENT_TYPES: Dict[str, tuple] = {
 #: stays the single source of truth for emitters AND consumers — a new
 #: optional field lands in this map, not as silent drift.
 EVENT_OPTIONAL_FIELDS: Dict[str, tuple] = {
+    # ``env``: environment provenance (envinfo.environment_info —
+    # backend, device kind/count, jax version, host cores) so an offline
+    # diff can warn loudly when two logs came from different hardware
+    # (the recurring CPU-fallback-vs-device comparability confusion)
+    "query_start": ("env",),
     "op_span": ("shard",),
     "transfer": ("shard",),
     # ``op``: the exec whose hot section compiled the program (absent
@@ -149,6 +174,12 @@ EVENT_OPTIONAL_FIELDS: Dict[str, tuple] = {
     # confs are 0.0 and per-backend defaults apply)
     "program_cost": ("op", "out_bytes", "generated_code_bytes",
                      "peak_hbm_gbps", "peak_tflops"),
+    # ``op``: same attribution as program_cost; ``accounted_frac``: this
+    # summary's total_bytes / the program's cost_analysis bytes accessed
+    # (absent when the backend reported no byte cost) — XLA applies
+    # utilization weighting inside fusions, so the ratio reports how
+    # much of the compiler's figure the shape-level attribution explains
+    "hlo_summary": ("op", "accounted_frac"),
 }
 
 
@@ -166,6 +197,15 @@ class EventLogger:
         self._ring: collections.deque = collections.deque(maxlen=size)
         self.path: Optional[str] = None
         self._fh = None
+        #: flight-recorder mode: eventLog.dir names where alert-triggered
+        #: ring dumps land, but NO streaming sink is opened — the ring is
+        #: the only live store (see dump_flight_record)
+        self.flight_dir: Optional[str] = None
+        if (self.enabled and log_dir and path is None
+                and conf_.get(EVENT_LOG_FLIGHT_RECORDER)):
+            os.makedirs(log_dir, exist_ok=True)
+            self.flight_dir = log_dir
+            return
         if self.enabled and (path or log_dir):
             if path is None:
                 os.makedirs(log_dir, exist_ok=True)
@@ -217,6 +257,28 @@ class EventLogger:
         """Snapshot of the ring buffer (oldest first)."""
         with self._lock:
             return list(self._ring)
+
+    def dump_flight_record(self, episode: int) -> Optional[str]:
+        """Write the current ring snapshot to the flight-recorder dir as
+        ``tpu-flightrec-<pid>-<episode>.jsonl`` (one file per watchdog
+        alert episode — the black box recovered after an incident). A
+        no-op returning None outside flight-recorder mode: a streaming
+        logger already persists everything, and a ring-only logger with
+        no eventLog.dir has nowhere to dump."""
+        if self.flight_dir is None:
+            return None
+        recs = self.records()
+        path = os.path.join(
+            self.flight_dir,
+            f"tpu-flightrec-{os.getpid()}-{episode}.jsonl")
+        # write-then-rename so a reader (or a dying interpreter) never
+        # sees a half-written dump
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r, separators=(",", ":")) + "\n")
+        os.replace(tmp, path)
+        return path
 
     def close(self) -> None:
         with self._lock:
@@ -282,6 +344,18 @@ def emit(etype: str, **fields: Any) -> None:
     logger = _ACTIVE
     if logger is not None:
         logger.emit(etype, **fields)
+
+
+def flight_dump(episode: int) -> Optional[str]:
+    """Dump the active logger's ring for one watchdog alert episode
+    (None when logging is off or the logger is not a flight recorder).
+    Called by the watchdog right after it raises a new alert batch, so
+    the dump contains the alert events themselves plus everything the
+    ring held leading up to them."""
+    logger = _ACTIVE
+    if logger is None:
+        return None
+    return logger.dump_flight_record(episode)
 
 
 # ---------------------------------------------------------------------------

@@ -41,7 +41,8 @@ __all__ = [
     "Alert", "EVENT_BACKED_METRICS", "METRICS", "MetricsRegistry",
     "ObsPlane", "ProgressTracker", "Watchdog", "WatchdogRules",
     "active", "add_op_time", "enabled", "ensure_started", "inc",
-    "install", "note_compile_miss", "note_op_batch", "note_program_cost",
+    "install", "note_compile_miss", "note_hlo_summary", "note_op_batch",
+    "note_program_cost",
     "note_query_end", "note_query_start", "observe", "plane",
     "replay_alerts",
     "set_gauge", "shutdown", "span_close", "span_open", "tracker",
@@ -102,6 +103,22 @@ def note_program_cost(site: str, trace_s: float, compile_s: float,
     reg.inc("tpu_compile_seconds", compile_s, site=site, phase="compile")
     if temp_bytes is not None:
         reg.set_gauge_max("tpu_program_temp_bytes", temp_bytes, site=site)
+
+
+def note_hlo_summary(site: str, scatter_count: int,
+                     top_fusion_bytes: int) -> None:
+    """Live twins of the hlo_summary event (hlo.py): scatter-program
+    counter per site (incremented once per program containing any
+    scatter-classified instruction) and the largest-single-fusion byte
+    high-water gauge."""
+    reg = active()
+    if reg is None:
+        return
+    if scatter_count:
+        reg.inc("tpu_hlo_scatter_programs", 1, site=site)
+    if top_fusion_bytes:
+        reg.set_gauge_max("tpu_hlo_top_fusion_bytes", top_fusion_bytes,
+                          site=site)
 
 
 def note_query_start(query_id, plan_digest: str = "",

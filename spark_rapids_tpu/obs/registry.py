@@ -73,6 +73,17 @@ METRICS: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
         "(memory_analysis temp_size_in_bytes high-water mark; a jump "
         "means a kernel started materializing intermediates the layout "
         "model doesn't know about)", ("site",)),
+    "tpu_hlo_scatter_programs": (
+        COUNTER, "Harvested programs whose optimized HLO contains at "
+        "least one scatter-classified instruction, by compile site "
+        "(hlo.py per-fusion attribution — the hlo_summary event's live "
+        "twin; scatters are the byte-amplification idiom the roofline "
+        "push hunts)", ("site",)),
+    "tpu_hlo_top_fusion_bytes": (
+        GAUGE, "Largest single-fusion byte attribution harvested per "
+        "compile site (high-water mark; a jump means one fusion started "
+        "owning more of the program's traffic — the per-instruction "
+        "refinement of tpu_program_temp_bytes)", ("site",)),
     "tpu_transfers": (
         COUNTER, "Host-link transfers by direction (h2d/d2h/fence)",
         ("direction",)),
@@ -161,6 +172,7 @@ EVENT_BACKED_METRICS: Dict[str, str] = {
     "op_batch": "tpu_op_rows",
     "compile_miss": "tpu_compile_misses",
     "program_cost": "tpu_compile_seconds",
+    "hlo_summary": "tpu_hlo_scatter_programs",
     "transfer": "tpu_transfer_bytes",
     "spill": "tpu_spill_bytes",
     "shuffle_write": "tpu_shuffle_bytes",

@@ -53,9 +53,16 @@ def build_status(registry: MetricsRegistry, progress: ProgressTracker,
             "queue": sched.queue_status(),
             "active": sched.active_status(),
         }
+    # environment provenance (envinfo — the same helper bench.py stamps
+    # into BENCH_*.json): a live operator must be able to tell at a
+    # glance whether the numbers on screen are device-backed or the CPU
+    # fallback's
+    from .. import envinfo
+
     return {
         "queries": progress.status(),
         "queries_live": progress.live_count(),
+        "env": envinfo.environment_info(),
         "hbm": hbm,
         "serve": serve,
         "alerts": [a.to_json() for a in watchdog.alerts()]

@@ -135,6 +135,7 @@ class Watchdog:
         self._conf_budget = conf_budget
         self._alerts: deque = deque(maxlen=history)
         self._active: Set[tuple] = set()
+        self._episode = 0  # flight-recorder dump counter (one per batch)
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -197,6 +198,17 @@ class Watchdog:
             if _events.enabled():
                 _events.emit("alert", kind=alert.kind, detail=alert.detail,
                              value=alert.value, threshold=alert.threshold)
+        if new and _events.enabled():
+            # flight recorder: in ring-only mode (eventLog.flightRecorder
+            # .enabled) each alert episode dumps the ring — including the
+            # alert events just emitted — to eventLog.dir for post-hoc
+            # diagnosis; a streaming logger returns None (already durable)
+            with self._lock:
+                self._episode += 1
+                episode = self._episode
+            path = _events.flight_dump(episode)
+            if path:
+                log.warning("watchdog flight record: %s", path)
         return new
 
     def alerts(self) -> List[Alert]:

@@ -26,6 +26,7 @@ def _import_conf_modules() -> None:
     import importlib
 
     for mod in ("spark_rapids_tpu.events",
+                "spark_rapids_tpu.hlo",
                 "spark_rapids_tpu.memory.catalog",
                 "spark_rapids_tpu.ml.columnar_rdd",
                 "spark_rapids_tpu.serve.scheduler",

@@ -390,6 +390,9 @@ class TpuSession:
         # the offline profiler (which has no conf) honors calibration
         self._cost_baseline = _xla_cost.snapshot()
         _xla_cost.set_conf_peaks(self.conf)
+        from .. import hlo as _hlo
+
+        _hlo.set_conf_top_k(self.conf)
         if self.events.enabled or obs_on:
             qid = self._active_query = _next_query_id()
             if self.events.enabled:
@@ -438,10 +441,16 @@ class TpuSession:
         this session's log."""
         import hashlib
 
+        from .. import envinfo as _envinfo
+
         _events.install(self.events)
+        # env provenance rides on every query_start so a merged/archived
+        # log records WHAT hardware produced it (tpu_profile --diff
+        # warns when two logs' environments differ)
         _events.emit("query_start", query_id=qid, plan_digest=plan_digest,
                      sql_hash=hashlib.sha1(
-                         repr(node).encode()).hexdigest()[:12])
+                         repr(node).encode()).hexdigest()[:12],
+                     env=_envinfo.environment_info())
         meta = self.overrides.last_meta
         if meta is not None:
             fallbacks = []

@@ -325,8 +325,17 @@ class CostProbe:
             t2 = time.perf_counter_ns()
         except Exception:
             return
-        note_program_cost(self._site, self._digest, t1 - t0, t2 - t1,
-                          harvest_compiled(compiled), op=current_op())
+        rec = note_program_cost(self._site, self._digest, t1 - t0, t2 - t1,
+                                harvest_compiled(compiled), op=current_op())
+        # per-fusion HLO attribution (hlo.py): same gate — this runs
+        # only inside the harvesting() window, so with events+obs off
+        # as_text() is never fetched (the zero-overhead contract); a
+        # parse failure records nothing and never fails the query
+        from . import hlo as _hlo
+
+        _hlo.harvest_hlo(compiled, self._site, self._digest,
+                         op=rec.get("op"),
+                         xla_bytes=rec.get("bytes_accessed"))
         self._compiled = compiled
 
 

@@ -721,6 +721,127 @@ def test_session_close_flushes_and_detaches(tmp_path):
             json.loads(line)
 
 
+def test_flight_recorder_mode_ring_only_until_dumped(tmp_path):
+    """eventLog.flightRecorder.enabled + eventLog.dir: events land ONLY
+    in the ring (no streaming JSONL sink opened), and dump_flight_record
+    writes the ring snapshot as one tpu-flightrec-<pid>-<episode>.jsonl;
+    a streaming logger's dump is a no-op (already durable)."""
+    logger = EV.EventLogger(RapidsConf({
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path),
+        "spark.rapids.tpu.eventLog.flightRecorder.enabled": True}))
+    assert logger.enabled and logger.path is None and logger._fh is None
+    assert logger.flight_dir == str(tmp_path)
+    logger.emit("compile_miss", site="x", total=1)
+    assert os.listdir(tmp_path) == [], "flight recorder opened a sink"
+    path = logger.dump_flight_record(1)
+    assert os.path.basename(path) == f"tpu-flightrec-{os.getpid()}-1.jsonl"
+    with open(path) as f:
+        recs = [json.loads(line) for line in f]
+    assert [r["event"] for r in recs] == ["compile_miss"]
+    # a streaming logger has nowhere (and no need) to dump
+    streaming = EV.EventLogger(RapidsConf(
+        {"spark.rapids.tpu.eventLog.dir": str(tmp_path)}))
+    assert streaming.dump_flight_record(1) is None
+    streaming.close()
+
+
+def test_watchdog_alert_dumps_flight_ring(tmp_path):
+    """Each NEW watchdog alert episode dumps the ring — including the
+    alert events just raised — one file per episode."""
+    from spark_rapids_tpu.exec.base import TpuExec
+
+    sess, plane = _watchdog_session(tmp_path, {
+        "spark.rapids.tpu.eventLog.flightRecorder.enabled": True,
+        "spark.rapids.tpu.watchdog.stallThresholdMs": 1})
+    assert sess.events.flight_dir == str(tmp_path)
+
+    class Dummy(TpuExec):
+        @property
+        def output_schema(self):
+            raise NotImplementedError
+
+    d = Dummy(RapidsConf({}))
+    cm = d.op_timed("decode")
+    cm.__enter__()
+    try:
+        time.sleep(0.01)
+        assert [a.kind for a in plane.watchdog.check_now()] == ["stall"]
+        dumps = sorted(f for f in os.listdir(tmp_path)
+                       if f.startswith("tpu-flightrec-"))
+        assert len(dumps) == 1
+        with open(tmp_path / dumps[0]) as f:
+            recs = [json.loads(line) for line in f]
+        assert any(r["event"] == "alert" and r["kind"] == "stall"
+                   for r in recs), "dump lost the triggering alert"
+        # the same open episode does not dump again
+        assert plane.watchdog.check_now() == []
+        assert len([f for f in os.listdir(tmp_path)
+                    if f.startswith("tpu-flightrec-")]) == 1
+    finally:
+        cm.__exit__(None, None, None)
+    # a fresh episode gets its own numbered file
+    cm2 = d.op_timed("decode")
+    cm2.__enter__()
+    try:
+        time.sleep(0.01)
+        assert plane.watchdog.check_now()
+    finally:
+        cm2.__exit__(None, None, None)
+    assert len([f for f in os.listdir(tmp_path)
+                if f.startswith("tpu-flightrec-")]) == 2
+
+
+def test_flight_record_survives_dying_interpreter(tmp_path):
+    """The satellite's acceptance path: ring-buffer mode (no streaming
+    log), a watchdog alert fires MID-QUERY, the interpreter SystemExits
+    without close() — and post-hoc diagnosis still works from the
+    alert-triggered dump alone."""
+    script = f"""
+import sys, time
+sys.path.insert(0, {str(REPO)!r})
+from spark_rapids_tpu import obs
+from spark_rapids_tpu.sql import TpuSession
+from spark_rapids_tpu.expr import aggregates as A
+from spark_rapids_tpu.expr.expressions import col
+
+sess = TpuSession({{
+    "spark.rapids.tpu.eventLog.dir": {str(tmp_path)!r},
+    "spark.rapids.tpu.eventLog.flightRecorder.enabled": True,
+    "spark.rapids.tpu.watchdog.enabled": True,
+    "spark.rapids.tpu.watchdog.intervalMs": 3600000,
+    "spark.rapids.tpu.watchdog.stallThresholdMs": 1,
+}})
+df = sess.range(0, 512).agg(A.agg(A.Sum(col("id")), "s"))
+final = sess._execute(df.node)    # emits query_start into the ring
+it = final.tpu_child.execute_columnar()
+next(it)                          # mid-query: first batch materialized
+cm = final.tpu_child.op_timed("wedged")
+cm.__enter__()                    # a span that will never close
+time.sleep(0.01)
+alerts = obs.plane().watchdog.check_now()
+assert alerts, "stall rule did not fire"
+raise SystemExit(3)               # die WITHOUT close(); no query_end
+"""
+    r = subprocess.run([sys.executable, "-c", script],
+                       capture_output=True, text=True,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 3, r.stderr
+    # NO streaming log exists — the dump is the only artifact
+    names = os.listdir(tmp_path)
+    assert not any(n.startswith("tpu-events-") for n in names), names
+    dumps = [n for n in names if n.startswith("tpu-flightrec-")]
+    assert len(dumps) == 1, names
+    with open(tmp_path / dumps[0]) as f:
+        recs = [json.loads(line) for line in f]  # every line parses
+    kinds = [rec["event"] for rec in recs]
+    assert "query_start" in kinds and "query_end" not in kinds
+    assert any(rec["event"] == "alert" and rec["kind"] == "stall"
+               for rec in recs), kinds
+    # the offline profiler reads the dump like any log
+    text, _ = tpu_profile.build_report(recs)
+    assert "query 1" in text
+
+
 # ---------------------------------------------------------------------------
 # 8. bench satellite: per-shape memory-pressure fields
 # ---------------------------------------------------------------------------

@@ -21,6 +21,14 @@ Report sections:
                         kernel materializes intermediates the layout
                         model doesn't know about — the roofline-push
                         lead, not a violation)
+  * hlo               — per-fusion byte attribution of each harvested
+                        program (hlo_summary events): per compile site,
+                        the top-bytes fusion with its idiom
+                        classification (scatter-add / one-hot dot /
+                        gather / transpose-copy / collective) and its
+                        share of the site's XLA bytes-accessed — the
+                        instruction-level culprit behind a byte
+                        amplification, plus parse coverage
   * transfers         — host-link bytes each way + sync-point count
   * shuffle           — pieces/bytes/rows each way, per codec
   * spill timeline    — every spill/unspill with the live device-byte
@@ -34,9 +42,14 @@ Report sections:
                         code nonzero so CI catches emitter/analyzer drift
 
 Diff mode (``--diff A B``): compare two event logs (per-op host/device
-time and bytes) or two bench JSON result files (``BENCH_*.json`` — the
-``per_shape`` block's tpu_ms/device_ms per shape). Regressions beyond
-``--threshold`` (default 20%) are flagged and make the exit code nonzero.
+time and bytes, per-site XLA bytes/temp, per-site top-fusion bytes and
+scatter counts from hlo_summary events) or two bench JSON result files
+(``BENCH_*.json`` — the ``per_shape`` block's tpu_ms/device_ms plus the
+hlo_top_fusion_bytes/hlo_scatter_count gates). Regressions beyond
+``--threshold`` (default 20%) are flagged and make the exit code
+nonzero. When the two runs' ``env`` provenance blocks name different
+hardware (backend/device kind), a loud ENVIRONMENTS DIFFER banner
+prints first — structural gates stay meaningful, time ratios do not.
 
 Alert replay (``--alerts``): run the LIVE watchdog's rules
 (obs/watchdog.py — stall, hbm_pressure, recompile_storm) over a recorded
@@ -145,6 +158,57 @@ def _ms(ns: Optional[float]) -> str:
 
 def _mb(b: Optional[float]) -> str:
     return "-" if b is None else f"{b / 1e6:.2f}MB"
+
+
+# ---------------------------------------------------------------------------
+# environment provenance (envinfo.environment_info blocks riding on
+# query_start events and BENCH json top levels)
+# ---------------------------------------------------------------------------
+def _env_of(events: List[dict]) -> Optional[dict]:
+    """The first query_start env block in a log (None for pre-provenance
+    logs — the session stamps every query_start, so one is enough)."""
+    for r in events:
+        if r.get("event") == "query_start" and r.get("env"):
+            return r["env"]
+    return None
+
+
+def _env_str(env: Optional[dict]) -> str:
+    if not env:
+        return "backend=?"
+    return (f"backend={env.get('backend')} "
+            f"device={env.get('device_kind')} "
+            f"x{env.get('device_count')} "
+            f"jax={env.get('jax_version')}")
+
+
+def _envs_differ(a: Optional[dict], b: Optional[dict]) -> bool:
+    """Same rule as spark_rapids_tpu.envinfo.environments_differ (kept
+    local so the offline tool stays import-free; tests/test_hlo.py pins
+    the two in agreement): different backend or device kind means
+    absolute times and HBM fractions are NOT comparable. Missing blocks
+    (pre-provenance logs) never differ — no evidence, no warning."""
+    if not a or not b:
+        return False
+    return (a.get("backend") != b.get("backend")
+            or a.get("device_kind") != b.get("device_kind"))
+
+
+def _env_warning(old_env: Optional[dict], new_env: Optional[dict]
+                 ) -> List[str]:
+    """Loud comparability banner for --diff when the two runs name
+    different hardware (the recurring CPU-fallback-vs-device confusion:
+    a 10x 'regression' between a device round and a tunnel-down fallback
+    round is an environment change, not a kernel change)."""
+    if not _envs_differ(old_env, new_env):
+        return []
+    return [
+        "  !!! ENVIRONMENTS DIFFER — timings are NOT comparable !!!",
+        f"  !!! old: {_env_str(old_env)}",
+        f"  !!! new: {_env_str(new_env)}",
+        "  !!! trust structural gates only (strategy/lowering/scatter "
+        "counts), not time or HBM-fraction ratios",
+    ]
 
 
 # ---------------------------------------------------------------------------
@@ -418,6 +482,76 @@ def roofline_section(events: List[dict], queries: List[dict],
     return lines
 
 
+def hlo_section(events: List[dict]) -> List[str]:
+    """``== hlo ==``: per-fusion byte attribution joined to its compile
+    site (hlo_summary events, emitted beside each program_cost twin by
+    spark_rapids_tpu/hlo.py). Per site: programs parsed, the summed
+    shape-level byte attribution, worst parse coverage, module scatter
+    count, and the AMPLIFICATION CULPRIT — the single top-bytes fusion
+    with its idiom classification and its share of the site's XLA
+    bytes-accessed ("agg_update: fusion.7 [scatter-add] accounts for
+    12.1MB of 19.4MB"). Coverage < 1 or a low accounted fraction means
+    the text parse explains only part of the compiler's figure (XLA
+    utilization-weights bytes inside fusions/loop bodies) — reported,
+    never an error."""
+    sums = [r for r in events if r.get("event") == "hlo_summary"]
+    lines = ["== hlo =="]
+    if not sums:
+        lines.append("  no hlo_summary events (cost plane saw no compile"
+                     " misses, or the log predates per-fusion attribution)")
+        return lines
+    # the program_cost twin's compiler-reported bytes, by (site, digest)
+    xla: Dict[Tuple[str, str], float] = defaultdict(float)
+    for r in events:
+        if (r.get("event") == "program_cost"
+                and r.get("bytes_accessed") is not None):
+            xla[(r.get("site"), r.get("digest"))] += r["bytes_accessed"]
+    sites: Dict[str, dict] = {}
+    for r in sums:
+        s = sites.setdefault(r.get("site"), {
+            "programs": 0, "bytes": 0, "xla": 0.0, "cov": 1.0,
+            "scatters": 0, "ops": set(), "top": None})
+        s["programs"] += 1
+        s["bytes"] += r.get("total_bytes") or 0
+        s["xla"] += xla.get((r.get("site"), r.get("digest")), 0.0)
+        if r.get("coverage") is not None:
+            s["cov"] = min(s["cov"], r["coverage"])
+        s["scatters"] += r.get("scatter_count") or 0
+        if r.get("op"):
+            s["ops"].add(r["op"])
+        for f in r.get("top_fusions") or []:
+            if s["top"] is None or (f.get("bytes") or 0) > s["top"]["bytes"]:
+                s["top"] = {"name": f.get("name"), "class": f.get("class"),
+                            "bytes": f.get("bytes") or 0}
+    worst: Optional[Tuple[float, str]] = None
+    for site, s in sorted(sites.items()):
+        opl = ",".join(sorted(s["ops"]))
+        lines.append(
+            f"  site={site}" + (f" op={opl}" if opl else "")
+            + f" programs={s['programs']} attributed={_mb(s['bytes'])}"
+            + f" coverage={s['cov']:.2f}"
+            + (f" scatters={s['scatters']}" if s["scatters"] else ""))
+        top = s["top"]
+        if top is None:
+            continue
+        # the culprit line: the fusion the bytes live in, named against
+        # the compiler's own figure for the site when it reported one
+        denom = s["xla"] or s["bytes"]
+        denom_kind = "XLA bytes" if s["xla"] else "attributed bytes"
+        share = (f" ({top['bytes'] / denom * 100:.0f}% of site "
+                 f"{denom_kind})") if denom else ""
+        lines.append(
+            f"    {site}: {top['name']} [{top['class']}] accounts for "
+            f"{_mb(top['bytes'])} of {_mb(denom)}{share}")
+        if worst is None or top["bytes"] > worst[0]:
+            worst = (top["bytes"],
+                     f"{site}: {top['name']} [{top['class']}] "
+                     f"{_mb(top['bytes'])}")
+    if worst is not None:
+        lines.append(f"  largest single fusion: {worst[1]}")
+    return lines
+
+
 def forecast_vs_actual(queries: List[dict]) -> Tuple[List[str], int]:
     """Per bounded query: measured compile misses per site vs the
     analyzer's forecast, and measured per-op bytes vs the byte bound.
@@ -510,6 +644,9 @@ def build_report(events: List[dict], top_n: int = 10,
     queries = _query_windows(events)
 
     lines.append("== queries ==")
+    env = _env_of(events)
+    if env:
+        lines.append("  env: " + _env_str(env))
     if not queries:
         lines.append("  none recorded")
     for q in queries:
@@ -557,6 +694,8 @@ def build_report(events: List[dict], top_n: int = 10,
 
     lines.extend(roofline_section(events, queries, peak_gbps, peak_tflops,
                                   ops=ops))
+
+    lines.extend(hlo_section(events))
 
     xfer: Dict[str, List[int]] = defaultdict(lambda: [0, 0])
     for r in events:
@@ -735,6 +874,9 @@ def diff_bench(old: dict, new: dict, threshold: float
     new = new.get("parsed", new) if "per_shape" not in new else new
     lines: List[str] = []
     regressions = 0
+    # top-level env blocks (bench.py stamps envinfo.environment_info):
+    # different hardware -> loud warning, time gates stay advisory
+    lines.extend(_env_warning(old.get("env"), new.get("env")))
     shapes = sorted(set(old.get("per_shape") or {})
                     | set(new.get("per_shape") or {}))
     for shape in shapes:
@@ -785,6 +927,31 @@ def diff_bench(old: dict, new: dict, threshold: float
             else:
                 lines.append(f"  {shape}.hbm_frac_xla: ok {fa:.4f} -> "
                              f"{fb:.4f}")
+        # per-fusion attribution gates, the bench twin of diff_logs'
+        # _site_hlo checks: the largest single-fusion byte figure must
+        # not grow beyond the threshold, and the scatter count must not
+        # rise (both shape-derived — meaningful across environments)
+        ta, tb = a.get("hlo_top_fusion_bytes"), b.get("hlo_top_fusion_bytes")
+        if ta and tb:
+            if tb > ta * (1.0 + threshold):
+                regressions += 1
+                lines.append(f"  {shape}.hlo_top_fusion_bytes: REGRESSION "
+                             f"{ta} -> {tb} (one fusion owns more traffic)")
+            else:
+                lines.append(f"  {shape}.hlo_top_fusion_bytes: ok "
+                             f"{ta} -> {tb}")
+        ka, kb = a.get("hlo_scatter_count"), b.get("hlo_scatter_count")
+        if ka is not None and kb is not None:
+            # growth is gated only when the agg lowering did NOT change:
+            # a deliberate strategy flip (already flagged above) owns its
+            # scatter-count delta, a same-strategy rise is a regression
+            if kb > ka and sa == sb:
+                regressions += 1
+                lines.append(f"  {shape}.hlo_scatter_count: REGRESSION "
+                             f"{ka} -> {kb} (a scatter lowering appeared)")
+            elif ka or kb:
+                lines.append(f"  {shape}.hlo_scatter_count: ok {ka} -> "
+                             f"{kb}")
     # serving lane (bench.py --serve): structural gates always — the new
     # run must be internally clean (ok flag: no errors/rejects/bypass,
     # summed forecasts within budget) and must still beat serialized
@@ -914,6 +1081,11 @@ def diff_logs(old_events: List[dict], new_events: List[dict],
               threshold: float) -> Tuple[str, int]:
     lines: List[str] = []
     regressions = 0
+    # environment provenance first: when the two logs name different
+    # hardware, every time/byte ratio below is apples-to-oranges — warn
+    # loudly (warning, not regression: CI diffs a fresh CPU smoke against
+    # committed device rounds on purpose, gating structure only)
+    lines.extend(_env_warning(_env_of(old_events), _env_of(new_events)))
     a, b = aggregate_ops(old_events), aggregate_ops(new_events)
     for op in sorted(set(a) | set(b)):
         sa, sb = a.get(op), b.get(op)
@@ -963,8 +1135,60 @@ def diff_logs(old_events: List[dict], new_events: List[dict],
             regressions += 1
             lines.append(f"  {site}.compile: REGRESSION {_ms(va)} -> "
                          f"{_ms(vb)}")
+    # per-fusion HLO gates (hlo_summary events): a site whose largest
+    # single-fusion byte attribution grew beyond the threshold, or that
+    # gained scatter-classified programs, regressed STRUCTURALLY — this
+    # is the gate the item-1 kernel rewrite is judged by (bytes per
+    # fusion must shrink; a new scatter lowering must not sneak in), and
+    # it holds even across environments (shape-derived, not timed)
+    # union of sites, not intersection: the appears-at-any-size scatter
+    # gate must fire even when the new run compiled the scatter at a
+    # compile site the old log never harvested (exactly the rewrite-
+    # introduces-a-new-site scenario); byte-growth gates still need a
+    # nonzero old-side figure to compute growth against
+    ha, hb = _site_hlo(old_events), _site_hlo(new_events)
+    empty = {"bytes": 0, "top": 0, "scatters": 0}
+    for site in sorted(set(ha) | set(hb)):
+        a_h, b_h = ha.get(site, empty), hb.get(site, empty)
+        for field, label in (("top", "top_fusion_bytes"),
+                             ("bytes", "hlo_bytes")):
+            va, vb = a_h[field], b_h[field]
+            if va > 0 and vb > va * (1.0 + threshold):
+                regressions += 1
+                note = (" (one fusion owns more traffic?)"
+                        if field == "top" else "")
+                lines.append(f"  {site}.{label}: REGRESSION {_mb(va)} -> "
+                             f"{_mb(vb)}{note}")
+            elif va > 0 and vb > 0:
+                lines.append(f"  {site}.{label}: ok {_mb(va)} -> "
+                             f"{_mb(vb)}")
+        if b_h["scatters"] > a_h["scatters"]:
+            regressions += 1
+            lines.append(
+                f"  {site}.scatter_count: REGRESSION {a_h['scatters']} -> "
+                f"{b_h['scatters']} (a scatter lowering appeared)")
+        elif a_h["scatters"] or b_h["scatters"]:
+            lines.append(f"  {site}.scatter_count: ok {a_h['scatters']} "
+                         f"-> {b_h['scatters']}")
     lines.append(f"  {regressions} regression(s)")
     return "\n".join(lines), regressions
+
+
+def _site_hlo(events: List[dict]) -> Dict[str, dict]:
+    """Per-site hlo_summary aggregates for --diff: summed shape-level
+    byte attribution, the largest single-fusion byte figure, and the
+    summed scatter count across the site's harvested programs."""
+    per: Dict[str, dict] = {}
+    for r in events:
+        if r.get("event") != "hlo_summary":
+            continue
+        d = per.setdefault(r.get("site"),
+                           {"bytes": 0, "top": 0, "scatters": 0})
+        d["bytes"] += r.get("total_bytes") or 0
+        d["scatters"] += r.get("scatter_count") or 0
+        for f in r.get("top_fusions") or []:
+            d["top"] = max(d["top"], f.get("bytes") or 0)
+    return per
 
 
 def _site_costs(events: List[dict]) -> Dict[str, dict]:

@@ -63,6 +63,15 @@ def render_status(status: dict, clock: str = "") -> str:
     live = status.get("queries_live", 0)
     lines.append(f"tpu_top {clock}  queries live={live}")
 
+    # environment provenance (envinfo via /status): whether the numbers
+    # on screen are device-backed or the CPU fallback's, at a glance
+    env = status.get("env")
+    if env:
+        lines.append(
+            f"env  backend={env.get('backend')} "
+            f"device={env.get('device_kind')} x{env.get('device_count')} "
+            f"jax={env.get('jax_version')}")
+
     hbm = status.get("hbm") or {}
     budget = hbm.get("budget_bytes")
     dev = hbm.get("device_bytes", 0)
