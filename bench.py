@@ -170,12 +170,30 @@ def _xla_stats(cost_snapshot, device_ms, peak_gbps=HBM_GBPS):
     recs = xla_cost.records_since(cost_snapshot)
     xb = sum(r["bytes_accessed"] for r in recs
              if r.get("bytes_accessed") is not None)
+    # peak temp across the shape's programs: the materialized-
+    # intermediate watermark the radix/pallas lowerings exist to shrink
+    temps = [r["temp_bytes"] for r in recs
+             if r.get("temp_bytes") is not None]
     out = {"xla_bytes_accessed": int(xb) if xb else None,
+           "xla_peak_temp_bytes": int(max(temps)) if temps else None,
            "hbm_frac_xla": None}
     if xb and device_ms and device_ms >= 0.1:
         gbps = xb / (device_ms / 1e3) / 1e9
         out["hbm_frac_xla"] = round(gbps / peak_gbps, 4)
     return out
+
+
+def byte_amplification(xla_bytes, layout_bound):
+    """XLA-reported bytes-accessed over the analyzer's layout bound —
+    the FIRST-CLASS trended number of the round-12 kernel rewrite (the
+    r09 agg shape sat at ~25x; a lowering sized to the layout approaches
+    1). None when either input is missing/zero, so shapes without a
+    harvest or a static forecast degrade instead of faking a ratio.
+    Shared with tools/tpu_profile.py --diff, which BACKFILLS it when
+    diffing older BENCH jsons that carry both inputs."""
+    if not xla_bytes or not layout_bound:
+        return None
+    return round(xla_bytes / layout_bound, 2)
 
 
 def _hlo_stats(hlo_snapshot):
@@ -1233,6 +1251,9 @@ def main() -> None:
         extra.update(_xla_stats(cost_before, extra.get("device_ms"),
                                 peak_gbps))
         extra.update(_hlo_stats(hlo_before))
+        extra["byte_amplification"] = byte_amplification(
+            extra.get("xla_bytes_accessed"),
+            extra.get("predicted_hbm_bytes"))
         sp = cpu_t / tpu_t
         results[name] = sp
         details[name] = {"speedup": round(sp, 2),

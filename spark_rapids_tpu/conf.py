@@ -129,6 +129,15 @@ AUTO_BROADCAST_JOIN_THRESHOLD = conf(
 REPLACE_SORT_MERGE_JOIN = conf(
     "spark.rapids.tpu.sql.replaceSortMergeJoin.enabled", True,
     "Replace sort-merge joins with TPU hash joins (reference: RapidsConf.scala:476).")
+JOIN_PALLAS_PROBE = conf(
+    "spark.rapids.tpu.sql.join.pallasProbe.enabled", False,
+    "Lower single-fixed-width-key hash-join probes to the hand-written "
+    "Pallas kernel (ops/pallas_join.py): each grid step compares one "
+    "probe block against one build tile entirely in VMEM — no "
+    "scatter-built direct-address table and no binary-search gather "
+    "chain. Work is O(probe x build) compares, so this wins only for "
+    "broadcast-class build sides; off by default. Off-TPU the same "
+    "kernel runs under the Pallas interpreter (the CPU CI path).")
 ENABLE_HASH_PARTIAL_AGG = conf(
     "spark.rapids.tpu.sql.hashAgg.replaceMode", "all",
     "Which aggregation modes to replace: all/partial/final.",
@@ -190,17 +199,24 @@ AGG_FUSED_PLAN = conf(
 AGG_STRATEGY = conf(
     "spark.rapids.tpu.sql.agg.strategy", "AUTO",
     "Lowering strategy for grouped-aggregation reductions "
-    "(ops/bucket_reduce.py, ops/groupby.py). MATMUL prices sums/counts "
-    "as one-hot limb matmuls on the MXU over the hash-bucket tiers; "
-    "SCATTER uses native segment scatters over the same tiers; SORT "
-    "radix-sorts rows by the grouping keys and reduces each contiguous "
-    "segment as prefix-sum differences — sized to HBM bandwidth instead "
-    "of MXU flops or scatter latency. AUTO picks per plan from the "
-    "static layout (capacity, aggregated column count/widths, backend) "
-    "and records its choice — with the reason — in explain_metrics() and "
-    "the event log ('agg_strategy'), so a wrong prediction is visible in "
-    "tools/tpu_profile.py instead of only as wall-clock.",
-    valid_values=("AUTO", "MATMUL", "SCATTER", "SORT"))
+    "(ops/bucket_reduce.py, ops/groupby.py, ops/radix_bin.py). MATMUL "
+    "prices sums/counts as one-hot limb matmuls on the MXU over the "
+    "hash-bucket tiers; SCATTER uses native segment scatters over the "
+    "same tiers; SORT radix-sorts rows by the grouping keys and reduces "
+    "each contiguous segment as prefix-sum differences (float sums and "
+    "min/max keep the scatter path); RADIX reduces EVERY aggregate "
+    "family over the radix-binned order in HBM-resident tiles — zero "
+    "scatter instructions and no one-hot, so bytes-accessed approaches "
+    "the layout bound; PALLAS runs the hash-groupby update as "
+    "hand-written jax.experimental.pallas TPU kernels over the "
+    "hash-bucket tiers (interpret mode executes the same kernels "
+    "off-TPU). AUTO picks per plan from the static layout (capacity, "
+    "aggregated column count/widths, backend) against the conf-declared "
+    "roofline peaks (spark.rapids.tpu.roofline.peakHbmGBps/.peakTflops) "
+    "and records its choice — with the reason — in explain_metrics() "
+    "and the event log ('agg_strategy'), so a wrong prediction is "
+    "visible in tools/tpu_profile.py instead of only as wall-clock.",
+    valid_values=("AUTO", "MATMUL", "SCATTER", "SORT", "RADIX", "PALLAS"))
 
 # ---------------------------------------------------------------------------
 # Memory (reference: RapidsConf.scala:200-340, GpuDeviceManager.scala:160-271)

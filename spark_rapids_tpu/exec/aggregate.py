@@ -42,24 +42,47 @@ _AGG_CACHE: dict = {}
 
 
 # ---------------------------------------------------------------------------
-# Aggregation strategy chooser (conf sql.agg.strategy). The cost model's
-# constants are CALIBRATED FROM THE r05 PROFILE, not chip peaks: the
-# profiled agg program ran the one-hot limb matmul at ~7e11 MAC/s (143 ms
-# for cap=2^26 x ~12 limbs x B=128, BENCH_r05 + tools/tpu_profile.py)
-# while touching HBM at 1.3% of roofline — far under MXU peak because the
-# one-hot compare-select feed, not the multiply, is the bottleneck. That
-# gap is exactly what makes a bandwidth-sized lowering competitive.
-# Re-check the constants on a TPU-backed round (axon tunnel down in r07).
+# Aggregation strategy chooser (conf sql.agg.strategy). The cost model
+# reads the SAME roofline peaks the profiler's roofline report measures
+# against (spark.rapids.tpu.roofline.peakHbmGBps/.peakTflops, with
+# xla_cost.BACKEND_PEAKS per-backend defaults) — one peak source, so a
+# deployment that calibrates the conf moves the chooser and the report
+# together. The DERATE fractions below are calibrated from the r05
+# profile, not spec sheets: the profiled one-hot limb matmul ran at
+# ~7e11 MAC/s (143 ms for cap=2^26 x ~12 limbs x B=128) — ~0.7% of the
+# v5e MXU peak, because the one-hot compare-select feed, not the
+# multiply, is the bottleneck. That gap is exactly what makes the
+# bandwidth-sized lowerings competitive. Re-check on a TPU-backed round.
 # ---------------------------------------------------------------------------
-#: measured effective one-hot limb-matmul throughput (MACs/s)
-_MATMUL_EFF_MACS = 7.2e11
-#: sustained streaming HBM bandwidth (v5e public 819 GB/s, derated)
-_HBM_EFF_BPS = 0.6 * 819e9
+#: measured effective one-hot limb-matmul MAC rate / MXU peak MAC rate
+_MATMUL_PEAK_FRAC = 7.3e-3
+#: sustained streaming fraction of peak HBM bandwidth
+_HBM_DERATE = 0.6
 #: near-serial TPU scatter cost per row (why min/max batch per family)
 _SCATTER_SEC_PER_ROW = 10e-9
 #: first hash tier (ops/groupby.py B0) — the optimistic common-case
 #: matmul price; wider key ranges escalate tiers and multiply it
 _FIRST_TIER_B = 128
+#: CPU-backend AUTO: below this capacity the native scatter's serial
+#: walk is cheap and the radix sort dominates, so SCATTER keeps its
+#: round-1-measured win; at or above it the SCATTER dialect's byte
+#: amplification (the while-loop accumulator XLA charges per
+#: instruction — 19.4 GB vs a 772 MB bound at cap=2^24, BENCH_r09)
+#: is the dominant cost and the tiled RADIX lowering takes over
+_RADIX_CPU_MIN_CAP = 1 << 22
+
+
+def _roofline_peaks(conf: RapidsConf, backend: str) -> Tuple[float, float]:
+    """(peak HBM bytes/s, peak MAC/s) for the chooser: the conf-declared
+    roofline peaks when set, else the per-backend defaults — the same
+    resolution order the roofline report uses."""
+    from ..xla_cost import (BACKEND_PEAKS, ROOFLINE_PEAK_HBM_GBPS,
+                            ROOFLINE_PEAK_TFLOPS)
+
+    dg, dt = BACKEND_PEAKS.get(backend, BACKEND_PEAKS["cpu"])
+    g = conf.get(ROOFLINE_PEAK_HBM_GBPS) or dg
+    t = conf.get(ROOFLINE_PEAK_TFLOPS) or dt
+    return g * 1e9, t * 1e12 / 2.0
 
 
 def choose_agg_strategy(
@@ -77,14 +100,19 @@ def choose_agg_strategy(
     the reason rides into explain_metrics and the 'agg_strategy' event so
     a wrong prediction is debuggable offline. AUTO resolves:
 
-      * CPU backend -> SCATTER (native segment scatters; both the
-        materialized one-hot and the bitonic sort lose there, measured in
-        round 1);
+      * CPU backend -> SCATTER below _RADIX_CPU_MIN_CAP (native segment
+        scatters; both the materialized one-hot and the bitonic sort
+        lose there in wall clock, measured in round 1), RADIX at or
+        above it — the scatter dialect's XLA-charged byte amplification
+        dominates at scale and the merge gate is bytes, not the wall
+        clock of a shared box;
       * otherwise the cheaper of MATMUL (cap x limbs x B MACs at the
-        measured effective rate) and SORT (bitonic radix-key sort passes
-        + one bandwidth pass per aggregated column), with the scatter
-        families that run under EITHER strategy (min/max/first/last,
-        exact float sums) cancelling out of the comparison.
+        derated peak MAC rate) and RADIX (bitonic radix-key sort passes
+        + one tile-resident bandwidth pass per reduced stream at the
+        derated peak HBM rate). Exact float sums without
+        variableFloatAgg keep RADIX out of AUTO (its stream split is
+        order-insensitive) and compare MATMUL against SORT instead,
+        whose float sums stay on the order-preserving scatter path.
     """
     from ..conf import AGG_STRATEGY, IMPROVED_FLOAT_OPS
 
@@ -93,10 +121,6 @@ def choose_agg_strategy(
         return mode, "forced by spark.rapids.tpu.sql.agg.strategy"
     if backend is None:
         backend = jax.default_backend()
-    if backend == "cpu":
-        return ("SCATTER",
-                "AUTO: CPU backend — native segment scatters beat both "
-                "the materialized one-hot and the bitonic sort")
     approx = conf.get(IMPROVED_FLOAT_OPS)
     n_int = n_cnt = n_fapprox = n_fexact = n_other = 0
     for op, e in zip(update_ops, update_exprs):
@@ -113,9 +137,27 @@ def choose_agg_strategy(
             n_fexact += 1
             n_cnt += 1
         else:
-            n_other += 1  # min/max/first/last: scatter under either
+            n_other += 1  # min/max/first/last
+    # exact float sums demand the order-preserving scatter adds; RADIX's
+    # NORMAL/BIG stream split is order-insensitive, so AUTO may only
+    # pick it when the query opted into variableFloatAgg semantics
+    radix_ok = n_fexact == 0
+    if backend == "cpu":
+        if cap >= _RADIX_CPU_MIN_CAP and radix_ok:
+            return ("RADIX",
+                    "AUTO: CPU backend at cap>=2^22 — the scatter "
+                    "dialect's while-loop accumulator amplifies "
+                    "XLA-charged bytes ~25x past the layout bound "
+                    "(BENCH_r09); the tiled radix lowering is sized to "
+                    "the bound")
+        return ("SCATTER",
+                "AUTO: CPU backend — native segment scatters beat both "
+                "the materialized one-hot and the bitonic sort")
+    hbm_bps, mac_s = _roofline_peaks(conf, backend)
+    hbm_eff = _HBM_DERATE * hbm_bps
+    mac_eff = _MATMUL_PEAK_FRAC * mac_s
     limbs = 8 * n_int + n_cnt + 2 * n_fapprox
-    matmul_s = cap * limbs * _FIRST_TIER_B / _MATMUL_EFF_MACS
+    matmul_s = cap * limbs * _FIRST_TIER_B / mac_eff
     import math
 
     lg = max(1, math.ceil(math.log2(max(2, cap))))
@@ -129,15 +171,21 @@ def choose_agg_strategy(
         except Exception:  # strings etc: radix chunks, ~8B per pass
             key_bytes += 8
     key_bytes = key_bytes or 4
-    n_val_cols = n_int + n_fapprox + n_cnt
-    sort_s = (cap * (key_bytes + 4) * sort_passes
-              + cap * 8 * n_val_cols * 3) / _HBM_EFF_BPS
-    pick = "SORT" if sort_s < matmul_s else "MATMUL"
+    # every reduced stream is one tile-resident bandwidth pass under
+    # RADIX (winner sorts ride tile-local memory); under SORT min/max/
+    # first/last and float sums keep their scatter families, which
+    # cancel against the matmul side's identical scatters
+    bw_cols = n_int + n_fapprox + n_cnt + (n_other if radix_ok else 0)
+    bw_s = (cap * (key_bytes + 4) * sort_passes
+            + cap * 8 * max(1, bw_cols) * 3) / hbm_eff
+    bw_pick = "RADIX" if radix_ok else "SORT"
+    pick = bw_pick if bw_s < matmul_s else "MATMUL"
     return (pick,
             f"AUTO: est matmul {matmul_s * 1e3:.1f}ms "
-            f"({limbs} limbs x B={_FIRST_TIER_B}) vs sort "
-            f"{sort_s * 1e3:.1f}ms ({sort_passes:.0f} passes, "
-            f"{n_val_cols} col(s)) at cap={cap}")
+            f"({limbs} limbs x B={_FIRST_TIER_B}) vs {bw_pick.lower()} "
+            f"{bw_s * 1e3:.1f}ms ({sort_passes:.0f} passes, "
+            f"{bw_cols} stream(s)) at cap={cap}, "
+            f"peaks {hbm_bps / 1e9:.0f}GB/s {2 * mac_s / 1e12:.0f}TF")
 
 
 def _agg_pipeline(

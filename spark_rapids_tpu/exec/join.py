@@ -500,6 +500,10 @@ class TpuShuffledHashJoinExec(TpuExec):
         # build words/count enter as jit ARGUMENTS (not closure constants):
         # with per-partition builds the same compiled probe must serve every
         # partition's build data
+        from ..conf import JOIN_PALLAS_PROBE
+
+        pallas_probe = self.conf.get(JOIN_PALLAS_PROBE)
+
         def count_phase(cols, num_rows, bwords, bcount):
             live = filter_gather.live_of(num_rows, cap)
             keys = [lower(k, cols, cap) for k in self._probe_keys]
@@ -507,7 +511,8 @@ class TpuShuffledHashJoinExec(TpuExec):
                 keys, [k.dtype for k in self._probe_keys], psml)
             ok = live & ~any_null
             lo, hi = join_ops.probe_ranges(
-                bwords, bcount.astype(jnp.int32), words, ok)
+                bwords, bcount.astype(jnp.int32), words, ok,
+                pallas=pallas_probe)
             counts = hi - lo
             if jt in ("semi", "anti"):
                 keep = (counts > 0) if jt == "semi" else (live & (counts == 0))
@@ -522,7 +527,7 @@ class TpuShuffledHashJoinExec(TpuExec):
             return lo, counts, ex_counts, live
 
         ckey = ("count", batch_signature(pbatch), cap, psml, build_cap,
-                len(build_words))
+                len(build_words), pallas_probe)
         fn = self._jit_cache_get(ckey, count_phase)
         lo, counts, aux, live = fn(
             vals_of_batch(pbatch), count_scalar(pbatch.num_rows_lazy),

@@ -174,11 +174,11 @@ def _parse_shape(s: str, i: int) -> Tuple[int, int, int]:
 
 class Instr:
     __slots__ = ("name", "opcode", "out_bytes", "out_elems", "operands",
-                 "called", "ok")
+                 "called", "ok", "target")
 
     def __init__(self, name: str, opcode: str, out_bytes: int,
                  out_elems: int, operands: List[str], called: List[str],
-                 ok: bool):
+                 ok: bool, target: Optional[str] = None):
         self.name = name
         self.opcode = opcode
         self.out_bytes = out_bytes
@@ -186,9 +186,11 @@ class Instr:
         self.operands = operands    # operand instruction names
         self.called = called        # computations via calls=/body=/...
         self.ok = ok
+        self.target = target        # custom-call target, when present
 
 
 _INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s+=\s+")
+_TARGET_RE = re.compile(r'custom_call_target="([^"]*)"')
 _CALLED_RE = re.compile(
     r"(?:calls|to_apply|body|condition|true_computation|"
     r"false_computation)=%?([\w.\-]+)")
@@ -260,7 +262,9 @@ def _parse_instruction(line: str) -> Optional[Instr]:
     for cm in _CALLED_LIST_RE.finditer(attrs):
         called.extend(p.strip().lstrip("%") for p in cm.group(1).split(",")
                       if p.strip())
-    return Instr(name, opcode, out_b, out_e, operands, called, ok=True)
+    tm = _TARGET_RE.search(attrs)
+    return Instr(name, opcode, out_b, out_e, operands, called, ok=True,
+                 target=tm.group(1) if tm else None)
 
 
 class Module:
@@ -334,6 +338,35 @@ def _opcode_bag(mod: Module, comp: str, seen: Optional[set] = None
     return bag
 
 
+def _dus_update_sizes(mod: Module, ins: Instr) -> List[Optional[int]]:
+    """Element counts of the UPDATE operand of every dynamic-update-slice
+    reachable from ``ins`` (None when the operand shape is unresolvable)
+    — the discriminator between per-element scatter emulation and the
+    tile-window writes of the radix-bin loop."""
+    sizes: List[Optional[int]] = []
+    seen: set = set()
+
+    def walk(comp: str) -> None:
+        if comp in seen:
+            return
+        seen.add(comp)
+        for sub in mod.instrs(comp):
+            if sub.opcode == "dynamic-update-slice":
+                ref = (mod.by_name.get(sub.operands[1])
+                       if len(sub.operands) > 1 else None)
+                sizes.append(ref.out_elems if ref is not None else None)
+            for c in sub.called:
+                walk(c)
+
+    for c in ins.called:
+        walk(c)
+    return sizes
+
+
+#: custom-call targets that mark a hand-written Pallas/Mosaic kernel
+_PALLAS_TARGETS = ("tpu_custom_call", "mosaic", "pallas", "triton")
+
+
 def classify(mod: Module, ins: Instr) -> str:
     """Idiom name for one top-level instruction (priority order: the
     expensive amplifiers first, so a fusion that both scatters and
@@ -341,10 +374,23 @@ def classify(mod: Module, ins: Instr) -> str:
     bag = {ins.opcode}
     for c in ins.called:
         bag |= _opcode_bag(mod, c)
+    if ins.opcode == "custom-call" and ins.target and any(
+            t in ins.target.lower() for t in _PALLAS_TARGETS):
+        # a hand-written Pallas/Mosaic kernel owns its working set in
+        # VMEM; it must never read as the scatter it replaced
+        return "pallas"
     if "scatter" in bag:
         return "scatter-add" if "add" in bag else "scatter"
     if "dynamic-update-slice" in bag and ins.opcode in (
             "fusion", "while", "conditional"):
+        sizes = _dus_update_sizes(mod, ins)
+        if sizes and all(s is not None and s > 1 for s in sizes):
+            # every update writes a multi-element TILE: the radix-bin
+            # loop's sliding output window (ops/radix_bin.py), not the
+            # per-element accumulator of the CPU scatter lowering —
+            # misreading it as scatter would trip the --diff
+            # scatter-appearance gate on the fix itself
+            return "radix-bin"
         # the CPU dialect's scatter lowering: a while/fusion updating
         # one slice per step against a full-size accumulator
         return "scatter-add" if "add" in bag else "scatter"

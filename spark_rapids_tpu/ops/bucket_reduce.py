@@ -59,18 +59,18 @@ FORCE_PER_COLUMN = False
 def _resolve_strategy(strategy=None) -> str:
     """Resolve the lowering for one reduction (trace-time static, so each
     jit cache entry is per-strategy and per-backend). ``strategy`` is an
-    already-chosen MATMUL/SCATTER/SORT from the aggregate exec's chooser;
-    None/AUTO falls back to the backend default: the MXU tradeoff inverts
-    on XLA CPU, where the one-hot never fuses — it materializes (n, B)
-    compare-selects at ~7ns/element (measured: 1.7-2.3 s for 2M rows x
-    128 buckets) while scatter runs a tight serial loop (~0.2 s for the
-    same shape, 4-10x faster). On TPU scatter is the near-serial one
-    (~10ns/row) and the matmul is free. ``FORCE_MATMUL`` (test hook)
-    outranks everything so the MXU limb path stays differentially covered
-    on the CPU backend."""
+    already-chosen MATMUL/SCATTER/SORT/PALLAS from the aggregate exec's
+    chooser; None/AUTO falls back to the backend default: the MXU
+    tradeoff inverts on XLA CPU, where the one-hot never fuses — it
+    materializes (n, B) compare-selects at ~7ns/element (measured:
+    1.7-2.3 s for 2M rows x 128 buckets) while scatter runs a tight
+    serial loop (~0.2 s for the same shape, 4-10x faster). On TPU
+    scatter is the near-serial one (~10ns/row) and the matmul is free.
+    ``FORCE_MATMUL`` (test hook) outranks everything so the MXU limb
+    path stays differentially covered on the CPU backend."""
     if FORCE_MATMUL:
         return "MATMUL"
-    if strategy in ("MATMUL", "SCATTER", "SORT"):
+    if strategy in ("MATMUL", "SCATTER", "SORT", "PALLAS"):
         return strategy
     return "SCATTER" if jax.default_backend() == "cpu" else "MATMUL"
 
@@ -279,6 +279,11 @@ def _bucket_reduce_pass(
         return _bucket_reduce_scatter(seg, B, int_cols, count_cols, float_cols)
     if resolved == "SORT":
         return _bucket_reduce_sort(seg, B, int_cols, count_cols, float_cols)
+    if resolved == "PALLAS":
+        from .pallas_groupby import pallas_bucket_reduce
+
+        return pallas_bucket_reduce(seg, B, int_cols, count_cols,
+                                    float_cols)
     n = seg.shape[0]
     limbs: List[jax.Array] = []
     for data, valid in int_cols:
@@ -299,8 +304,10 @@ def _bucket_reduce_pass(
         d = jnp.where(valid, data, 0.0).astype(jnp.float64)
         # |x| beyond f32 range would make hi=inf and lo=NaN; zero those rows
         # out of the matmul path and scatter-add them separately (cond'd on
-        # actually seeing one, so the common case pays no scatter)
-        ovf = jnp.abs(d) > F32_MAX
+        # actually seeing one, so the common case pays no scatter). NaN
+        # rows must detour too — abs(NaN) > x is False, and a NaN in the
+        # matmul stream poisons EVERY bucket through the one-hot dot
+        ovf = ~(jnp.abs(d) <= F32_MAX)
         d_main = jnp.where(ovf, 0.0, d)
         hi = d_main.astype(jnp.float32)
         lo = (d_main - hi.astype(jnp.float64)).astype(jnp.float32)
@@ -355,7 +362,8 @@ def _bucket_reduce_pass(
 
 
 def bucket_min_max(
-    seg: jax.Array, B: int, op: str, cols: Sequence[jax.Array]
+    seg: jax.Array, B: int, op: str, cols: Sequence[jax.Array],
+    strategy: str = None,
 ) -> List[jax.Array]:
     """Per-bucket min/max for ALL columns of one (op, dtype) family in ONE
     segment scatter — the scatter-side analog of the fused limb matmul:
@@ -364,7 +372,13 @@ def bucket_min_max(
     of one dtype, already masked to the op's identity fill by the caller
     (invalid/dead rows hold +/-inf, dtype extremes, etc. so they never
     win); callers overwrite empty buckets via their count mask. Returns
-    (B,) arrays aligned with ``cols``."""
+    (B,) arrays aligned with ``cols``. Under the PALLAS strategy the
+    winners reduce in the VMEM-resident word kernel instead of a
+    scatter."""
+    if _resolve_strategy(strategy) == "PALLAS":
+        from .pallas_groupby import pallas_bucket_min_max
+
+        return pallas_bucket_min_max(seg, B, op, cols)
     fn = jax.ops.segment_max if op == "max" else jax.ops.segment_min
     if FORCE_PER_COLUMN or len(cols) == 1:
         return [fn(d, seg, num_segments=B) for d in cols]

@@ -295,6 +295,174 @@ def _sorted_segment_aggs(
     return out
 
 
+def _jnp_reduce_dtype(dtype) -> T.DataType:
+    """Engine DataType standing in for a jnp dtype when only the RADIX
+    encoding family matters (float total-order trick vs bool cast vs int
+    sign flip — :func:`ops.sort.fixed_radix_keys` reads the VALUE dtype
+    from the array itself)."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return T.DOUBLE
+    if jnp.dtype(dtype) == jnp.bool_:
+        return T.BOOLEAN
+    return T.LONG
+
+
+def _radix_groupby(
+    key_cols: Sequence[Val],
+    value_cols: Sequence[Optional[ColV]],
+    agg_ops: Sequence[str],
+    perm: jax.Array,
+    radix: Sequence[jax.Array],
+    live_in: jax.Array,
+    cap: int,
+) -> Tuple[List[Val], List[ColV], jax.Array]:
+    """RADIX strategy: every aggregate family reduces on the tiled
+    radix-binned machinery (ops/radix_bin.py) over the sort's binned row
+    order — zero scatter instructions, no one-hot, and every per-row
+    temporary is tile-sized, so the program's bytes-accessed approaches
+    the layout bound instead of amplifying it ~25x (BENCH_r09, ROADMAP
+    open item 1). Streams stay in ORIGINAL row order; the loop gathers
+    one tile at a time. Integer sums/counts are bit-identical to the
+    other lowerings (prefix sums wrap mod 2^64); float sums use the
+    NORMAL/BIG/flag stream split (order-insensitive, strictly tighter
+    than the matmul hi/lo split — AUTO only picks RADIX for exact float
+    sums when variableFloatAgg opted in); min/max/first/last reduce as
+    winner-ROW streams via the sort machinery's total-order words, so
+    Spark's NaN-largest / -0.0 folding falls out of the encoding."""
+    from . import radix_bin as RBX
+
+    # streams are NOT materialized here: every spec carries a builder
+    # closure that gathers the RAW column one tile at a time inside the
+    # reduction loop and derives its stream in tile-local registers
+    # (XLA CSE collapses repeated gathers of the same column), so no
+    # cap-sized derived array is ever charged against the byte budget
+    adds: List[RBX.AddSpec] = []
+    poss: List[RBX.PosSpec] = []
+    winners: List[RBX.MinMaxSpec] = []
+    plan: List[tuple] = []
+    cnt_idx: dict = {}
+    nfam = {"u64": 0, "u32": 0, "f64": 0, "or": 0}
+
+    def add_spec(fam, build, is_or=False):
+        adds.append(RBX.AddSpec(build, {
+            "u64": jnp.uint64, "u32": jnp.uint32, "f64": jnp.float64,
+            "or": jnp.uint64}[fam], is_or=is_or))
+        nfam[fam] += 1
+        return nfam[fam] - 1
+
+    def want_count(valid, key):
+        # valid None = live rows only (dead rows zero structurally)
+        if key not in cnt_idx:
+            if valid is None:
+                def build(tk):
+                    return jnp.ones(tk.p_t.shape[0], jnp.uint32)
+            else:
+                def build(tk, v=valid):
+                    return tk.take(v).astype(jnp.uint32)
+            cnt_idx[key] = add_spec("u32", build)
+        return cnt_idx[key]
+
+    # pos stream 0: the group-representative (first live) row, for key
+    # output — stability makes first-in-sorted == min original row
+    poss.append(RBX.PosSpec(
+        lambda tk: jnp.ones(tk.p_t.shape[0], jnp.bool_), "min"))
+    for ai, (op, v) in enumerate(zip(agg_ops, value_cols)):
+        if op == "count_star":
+            plan.append(("cnt", want_count(None, ("star",))))
+        elif op == "count":
+            plan.append(("cnt", want_count(v.validity, ("c", ai))))
+        elif op == "sum" and not jnp.issubdtype(v.data.dtype, jnp.floating):
+            ci = want_count(v.validity, ("c", ai))
+
+            def ibuild(tk, d=v.data, vv=v.validity):
+                return jnp.where(tk.take(vv),
+                                 tk.take(d).astype(jnp.int64),
+                                 jnp.int64(0)).astype(jnp.uint64)
+
+            plan.append(("isum", (add_spec("u64", ibuild), ci,
+                                  v.data.dtype)))
+        elif op == "sum":
+            ci = want_count(v.validity, ("c", ai))
+
+            def fpart(tk, i, d=v.data, vv=v.validity):
+                return RBX.float_sum_streams(tk.take(d), tk.take(vv))[i]
+
+            fi = add_spec("f64", lambda tk, f=fpart: f(tk, 0))
+            add_spec("f64", lambda tk, f=fpart: f(tk, 1))
+            oi = add_spec("or", lambda tk, f=fpart: f(tk, 2), is_or=True)
+            plan.append(("fsum", (fi, oi, ci, v.data.dtype)))
+        elif op in ("min", "max"):
+            rdt = _jnp_reduce_dtype(v.data.dtype)
+
+            def wbuild(tk, d=v.data, vv=v.validity, rdt=rdt, op=op):
+                return RBX.order_word(tk.take(d), tk.take(vv), rdt, op)
+
+            wi = len(winners)
+            winners.append(RBX.MinMaxSpec(
+                wbuild, lambda tk, vv=v.validity: tk.take(vv), op))
+            plan.append(("winner", (wi, v)))
+        elif op in ("first", "last", "first_ignorenulls",
+                    "last_ignorenulls"):
+            if op.endswith("ignorenulls"):
+                def cons(tk, vv=v.validity):
+                    return tk.take(vv)
+            else:
+                def cons(tk):
+                    return jnp.ones(tk.p_t.shape[0], jnp.bool_)
+            pi = len(poss)
+            poss.append(RBX.PosSpec(
+                cons, "min" if op.startswith("first") else "max"))
+            plan.append(("pos", (pi, v)))
+        else:
+            raise ValueError(f"unknown aggregation op {op!r}")
+
+    out = RBX.tiled_segment_groupby(
+        perm, radix, live_in, adds, poss, winners)
+    nseg = out.nseg
+    out_live = jnp.arange(cap, dtype=jnp.int32) < nseg
+
+    def row_col(rw, v) -> ColV:
+        safe = jnp.clip(rw, 0, cap - 1)
+        vals = jnp.take(v.data, safe, mode="clip")
+        vv = jnp.take(v.validity, safe, mode="clip") & (rw >= 0)
+        return ColV(jnp.where(vv, vals, jnp.zeros((), vals.dtype)), vv)
+
+    out_aggs: List[ColV] = []
+    for kind, payload in plan:
+        if kind == "cnt":
+            out_aggs.append(ColV(out.u32[payload].astype(jnp.int64),
+                                 jnp.ones(cap, jnp.bool_)))
+        elif kind == "isum":
+            si, ci, dt = payload
+            data = out.u64[si].astype(jnp.int64)
+            if dt != jnp.int64:
+                data = data.astype(dt)  # mod-2^32 of a mod-2^64 sum: exact
+            has = out.u32[ci] > 0
+            out_aggs.append(ColV(jnp.where(has, data,
+                                           jnp.zeros((), data.dtype)), has))
+        elif kind == "fsum":
+            fi, oi, ci, dt = payload
+            s = RBX.combine_float_sum(out.f64[fi], out.f64[fi + 1],
+                                      out.flags[oi]).astype(dt)
+            has = out.u32[ci] > 0
+            out_aggs.append(ColV(jnp.where(has, s, jnp.zeros((), dt)), has))
+        elif kind == "pos":
+            pi, v = payload
+            out_aggs.append(row_col(out.pos_rows[pi], v))
+        else:
+            wi, v = payload
+            out_aggs.append(row_col(out.winner_rows[wi], v))
+
+    rep = jnp.clip(out.pos_rows[0], 0, cap - 1)
+    out_keys = gather(key_cols, rep, out_live)
+    out_aggs = [
+        ColV(jnp.where(out_live, a.data, jnp.zeros((), a.data.dtype)),
+             a.validity & out_live)
+        for a in out_aggs
+    ]
+    return out_keys, out_aggs, nseg
+
+
 def sort_groupby(
     key_cols: Sequence[Val],
     key_dtypes: Sequence[T.DataType],
@@ -303,6 +471,7 @@ def sort_groupby(
     num_rows: Union[int, jax.Array],
     str_max_lens: Sequence[int] = (),
     prefix_reduce: bool = False,
+    radix_reduce: bool = False,
 ) -> Tuple[List[Val], List[ColV], jax.Array]:
     """Full groupby via sort: sort by keys, segment, reduce.
 
@@ -312,6 +481,9 @@ def sort_groupby(
     ``prefix_reduce`` (the SORT aggregation strategy) reduces sums/counts
     via prefix differences over the contiguous segments instead of one
     segment scatter per aggregate (see :func:`_sorted_segment_aggs`).
+    ``radix_reduce`` (the RADIX strategy) reduces EVERY aggregate family
+    — float sums and min/max/first/last included — on the tiled
+    radix-binned machinery with zero scatters (:func:`_radix_groupby`).
     """
     cap = (
         key_cols[0].offsets.shape[0] - 1
@@ -325,6 +497,9 @@ def sort_groupby(
         key_cols, key_dtypes, orders, num_rows, str_max_lens
     )
     live_in = live_of(num_rows, cap)
+    if radix_reduce:
+        return _radix_groupby(key_cols, value_cols, agg_ops, perm, radix,
+                              live_in, cap)
     # dead rows sort last (pad_rank is the leading sort key), so liveness in
     # sorted order is the permuted mask — equivalently a prefix of n_live.
     # Using the RAW mask here mislabels rows whenever the mask isn't already
@@ -567,7 +742,12 @@ def hash_groupby(
             ci = _want_count(v.validity & live, ("c", ai))
             int_specs.append((v.data, v.validity & live))
             plan.append(("isum", (len(int_specs) - 1, ci)))
-        elif op == "sum" and approx_float_sum:
+        elif op == "sum" and (approx_float_sum
+                              or reduce_strategy == "PALLAS"):
+            # PALLAS forces the order-insensitive kernel path even for
+            # exact float sums — a forced-strategy tradeoff the conf doc
+            # names (the chooser's AUTO never picks it without the
+            # variableFloatAgg opt-in)
             ci = _want_count(v.validity & live, ("c", ai))
             flt_specs.append((v.data, v.validity & live))
             plan.append(("fsum", (len(flt_specs) - 1, ci, v.data.dtype)))
@@ -605,6 +785,8 @@ def hash_groupby(
             plan.append(("minmax", (op, jnp.dtype(d.dtype), len(fam),
                                     ci, nn_ci)))
             fam.append(d)
+        elif reduce_strategy == "PALLAS":
+            plan.append(("pallas_pos", (op, v)))  # first/last, kernel
         else:
             plan.append(("scatter", (op, v)))  # first/last
 
@@ -614,7 +796,7 @@ def hash_groupby(
         seg, B, int_specs, cnt_specs, flt_specs,
         strategy=reduce_strategy)
     mm_results = {
-        k: bucket_min_max(seg, B, k[0], cols_)
+        k: bucket_min_max(seg, B, k[0], cols_, strategy=reduce_strategy)
         for k, cols_ in mm_fam.items()
     }
     occupied = counts[live_count_i] > 0
@@ -635,9 +817,15 @@ def hash_groupby(
         return tuple(keys_out), jnp.bool_(True)
 
     def _hash_branch(_):
-        first_row = jax.ops.segment_min(
-            jnp.where(live, idx, jnp.int32(cap)), seg, num_segments=B)
-        rep_row = jnp.clip(first_row, 0, cap - 1)
+        if reduce_strategy == "PALLAS":
+            from .pallas_groupby import pallas_bucket_position
+
+            rep0, _found = pallas_bucket_position(seg, B, "min", live)
+            rep_row = jnp.clip(rep0, 0, cap - 1)
+        else:
+            first_row = jax.ops.segment_min(
+                jnp.where(live, idx, jnp.int32(cap)), seg, num_segments=B)
+            rep_row = jnp.clip(first_row, 0, cap - 1)
         order = SortOrder(True, True)
         words: List[jax.Array] = []
         # one nullpack word per 16 keys: 2-bit null ranks must not alias
@@ -676,11 +864,19 @@ def hash_groupby(
     # All slot work happens at size B (tiny); outputs pad up to the input
     # capacity with plain copies — gathers at cap-size would cost ~100x.
     csum = jnp.cumsum(occupied.astype(jnp.int32))
-    dest = jnp.where(occupied, csum - 1, B)
-    bucket_of_slot = (
-        jnp.zeros(B, jnp.int32).at[dest].set(
-            jnp.arange(B, dtype=jnp.int32), mode="drop")
-    )
+    if reduce_strategy == "PALLAS":
+        # identical slot mapping via one (tiny) B-sized sort, so the
+        # PALLAS program carries zero scatter instructions end to end
+        _, bucket_of_slot = lax.sort(
+            [(~occupied).astype(jnp.uint32),
+             jnp.arange(B, dtype=jnp.int32)],
+            num_keys=1, is_stable=True)
+    else:
+        dest = jnp.where(occupied, csum - 1, B)
+        bucket_of_slot = (
+            jnp.zeros(B, jnp.int32).at[dest].set(
+                jnp.arange(B, dtype=jnp.int32), mode="drop")
+        )
     slot_live = jnp.arange(B, dtype=jnp.int32) < ngroups
     pad = cap - B
 
@@ -723,6 +919,19 @@ def hash_groupby(
                 r = jnp.where((counts[nn_ci] == 0) & has, jnp.nan, r)
             r = jnp.where(has, r, jnp.zeros((), r.dtype))
             out_aggs.append(to_slots(r, has))
+        elif kind == "pallas_pos":
+            sop, sv = payload
+            from .pallas_groupby import pallas_bucket_position
+
+            consider = (sv.validity & live
+                        if sop.endswith("ignorenulls") else live)
+            wop = "min" if sop.startswith("first") else "max"
+            row, found = pallas_bucket_position(seg, B, wop, consider)
+            safe = jnp.clip(row, 0, cap - 1)
+            vals = jnp.take(sv.data, safe, mode="clip")
+            vv = jnp.take(sv.validity, safe, mode="clip") & found
+            out_aggs.append(to_slots(
+                jnp.where(vv, vals, jnp.zeros((), vals.dtype)), vv))
         else:
             sop, sv = payload
             r = segment_reduce(sop, sv, seg, B, live)
@@ -811,14 +1020,18 @@ def groupby_agg(
         return keys, aggs, n
 
     prefix = strategy == "SORT"
+    # PALLAS hash tiers cover fixed-width keys; its string/keyless
+    # fallback rides the RADIX tiled path so the plan stays scatter-free
+    radix = strategy == "RADIX" or strategy == "PALLAS"
     if not key_cols:
         return _rewrap(*sort_groupby(
             key_cols, key_dtypes, value_cols, agg_ops, num_rows,
-            str_max_lens, prefix_reduce=prefix))
-    if prefix or any(isinstance(c, StrV) for c in key_cols):
+            str_max_lens, prefix_reduce=prefix, radix_reduce=radix))
+    if strategy == "RADIX" or prefix or any(
+            isinstance(c, StrV) for c in key_cols):
         return _rewrap(*sort_groupby(
             key_cols, key_dtypes, value_cols, agg_ops, num_rows,
-            str_max_lens, prefix_reduce=prefix))
+            str_max_lens, prefix_reduce=prefix, radix_reduce=radix))
     cap = key_cols[0].validity.shape[0]
 
     def pow2_floor(x: int) -> int:
@@ -844,7 +1057,7 @@ def groupby_agg(
     def use_sort(_):
         return pack(*sort_groupby(
             key_cols, key_dtypes, value_cols, agg_ops, num_rows,
-            str_max_lens))
+            str_max_lens, radix_reduce=radix))
 
     def tier(B, below):
         def run(_):

@@ -87,6 +87,7 @@ def probe_ranges(
     build_count: jax.Array,
     probe_words: Sequence[jax.Array],
     probe_live: jax.Array,
+    pallas: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """[lo, hi) of build matches per probe row.
 
@@ -96,7 +97,15 @@ def probe_ranges(
     with two scatters and probing is two gathers. The general path is the
     vectorized binary search, whose log2(build) gather passes are ~20x
     slower on TPU. A lax.cond picks at runtime; only the taken branch
-    executes."""
+    executes. ``pallas`` (conf sql.join.pallasProbe.enabled, trace-time
+    static) lowers single-key probes to the VMEM-tiled Pallas kernel
+    instead (ops/pallas_join.py) — no scatter-built table, no gather
+    chain."""
+    if pallas and len(build_words) <= 2 and len(probe_words) <= 2:
+        from .pallas_join import pallas_probe_ranges
+
+        return pallas_probe_ranges(
+            build_words, build_count, probe_words, probe_live)
     if len(build_words) <= 2 and len(probe_words) <= 2:
         nb = build_words[0].shape[0]
         tbl = 4 * nb

@@ -214,6 +214,136 @@ ENTRY %e (x: f32[16,8], idx: s32[4,1], upd: f32[4,8], w: f32[8,4]) -> f32[16,4] 
     assert by_name["d"]["class"] == "dot"
 
 
+def test_radix_bin_loop_not_misclassified_as_scatter():
+    """The radix-bin lowering compiles to a while loop whose body writes
+    MULTI-ELEMENT tiles through dynamic-update-slice (the sliding output
+    window of ops/radix_bin.py). The classifier must read it as
+    'radix-bin' — calling it scatter would trip the --diff
+    scatter-appearance gate on the byte-amplification fix itself — while
+    the CPU scatter emulation (one element updated per trip against a
+    full-size accumulator) must STILL read as scatter-add."""
+    text = """\
+HloModule jit_radix
+
+%tile_cond (cp: (s32[], f32[4096,2], f32[64,2])) -> pred[] {
+  %cp = (s32[], f32[4096,2]{1,0}, f32[64,2]{1,0}) parameter(0)
+  %ci = s32[] get-tuple-element((s32[], f32[4096,2]{1,0}, f32[64,2]{1,0}) %cp), index=0
+  %cn = s32[] constant(64)
+  ROOT %lt = pred[] compare(s32[] %ci, s32[] %cn), direction=LT
+}
+
+%tile_body (p: (s32[], f32[4096,2], f32[64,2])) -> (s32[], f32[4096,2], f32[64,2]) {
+  %p = (s32[], f32[4096,2]{1,0}, f32[64,2]{1,0}) parameter(0)
+  %i = s32[] get-tuple-element((s32[], f32[4096,2]{1,0}, f32[64,2]{1,0}) %p), index=0
+  %buf = f32[4096,2]{1,0} get-tuple-element((s32[], f32[4096,2]{1,0}, f32[64,2]{1,0}) %p), index=1
+  %tile = f32[64,2]{1,0} get-tuple-element((s32[], f32[4096,2]{1,0}, f32[64,2]{1,0}) %p), index=2
+  %zero = s32[] constant(0)
+  %win = f32[4096,2]{1,0} dynamic-update-slice(f32[4096,2]{1,0} %buf, f32[64,2]{1,0} %tile, s32[] %i, s32[] %zero)
+  %one = s32[] constant(1)
+  %ni = s32[] add(s32[] %i, s32[] %one)
+  ROOT %t = (s32[], f32[4096,2]{1,0}, f32[64,2]{1,0}) tuple(s32[] %ni, f32[4096,2]{1,0} %win, f32[64,2]{1,0} %tile)
+}
+
+%em_cond (ep: (s32[], f32[4096], f32[1])) -> pred[] {
+  %ep = (s32[], f32[4096]{0}, f32[1]{0}) parameter(0)
+  %ei = s32[] get-tuple-element((s32[], f32[4096]{0}, f32[1]{0}) %ep), index=0
+  %en = s32[] constant(4096)
+  ROOT %elt = pred[] compare(s32[] %ei, s32[] %en), direction=LT
+}
+
+%em_body (q: (s32[], f32[4096], f32[1])) -> (s32[], f32[4096], f32[1]) {
+  %q = (s32[], f32[4096]{0}, f32[1]{0}) parameter(0)
+  %j = s32[] get-tuple-element((s32[], f32[4096]{0}, f32[1]{0}) %q), index=0
+  %acc = f32[4096]{0} get-tuple-element((s32[], f32[4096]{0}, f32[1]{0}) %q), index=1
+  %el = f32[1]{0} get-tuple-element((s32[], f32[4096]{0}, f32[1]{0}) %q), index=2
+  %wr = f32[4096]{0} dynamic-update-slice(f32[4096]{0} %acc, f32[1]{0} %el, s32[] %j)
+  %one2 = s32[] constant(1)
+  %nj = s32[] add(s32[] %j, s32[] %one2)
+  ROOT %t2 = (s32[], f32[4096]{0}, f32[1]{0}) tuple(s32[] %nj, f32[4096]{0} %wr, f32[1]{0} %el)
+}
+
+ENTRY %main (init: (s32[], f32[4096,2], f32[64,2]), einit: (s32[], f32[4096], f32[1])) -> f32[4096,2] {
+  %init = (s32[], f32[4096,2]{1,0}, f32[64,2]{1,0}) parameter(0)
+  %einit = (s32[], f32[4096]{0}, f32[1]{0}) parameter(1)
+  %radix = (s32[], f32[4096,2]{1,0}, f32[64,2]{1,0}) while((s32[], f32[4096,2]{1,0}, f32[64,2]{1,0}) %init), condition=%tile_cond, body=%tile_body
+  %emul = (s32[], f32[4096]{0}, f32[1]{0}) while((s32[], f32[4096]{0}, f32[1]{0}) %einit), condition=%em_cond, body=%em_body
+  ROOT %out = f32[4096,2]{1,0} get-tuple-element((s32[], f32[4096,2]{1,0}, f32[64,2]{1,0}) %radix), index=1
+}
+"""
+    s = hlo.summarize_hlo(text)
+    assert s["coverage"] == 1.0
+    by_name = {r["name"]: r for r in s["top_fusions"]}
+    assert by_name["radix"]["class"] == "radix-bin", by_name
+    assert by_name["emul"]["class"] == "scatter-add", by_name
+    # only the per-element emulation counts against the scatter gate
+    assert s["scatter_count"] == 1, s["top_fusions"]
+
+
+def test_pallas_custom_call_classified_not_scatter():
+    """A hand-written Pallas/Mosaic kernel surfaces as a custom-call
+    whose target names the Mosaic pipeline; it owns its working set in
+    VMEM and must classify as 'pallas', never as the scatter/one-hot it
+    replaced (and never inflate scatter_count)."""
+    text = """\
+HloModule jit_pallas
+
+ENTRY %main (p0: s32[1024], p1: f32[1024,16]) -> s32[256,17] {
+  %p0 = s32[1024]{0} parameter(0)
+  %p1 = f32[1024,16]{1,0} parameter(1)
+  ROOT %cc = s32[256,17]{1,0} custom-call(s32[1024]{0} %p0, f32[1024,16]{1,0} %p1), custom_call_target="tpu_custom_call", api_version=API_VERSION_STATUS_RETURNING
+}
+"""
+    s = hlo.summarize_hlo(text)
+    assert s["coverage"] == 1.0
+    assert s["scatter_count"] == 0
+    by_name = {r["name"]: r for r in s["top_fusions"]}
+    assert by_name["cc"]["class"] == "pallas"
+    # bytes still attribute normally: output + operand shapes
+    assert by_name["cc"]["bytes"] == 256 * 17 * 4 + 1024 * 4 + 1024 * 16 * 4
+
+
+def test_compiled_radix_program_has_zero_scatter_classified():
+    """End to end on the REAL compiled program: lower a RADIX-strategy
+    groupby (sums, float sum, min, count, first — every reduction
+    family), parse its optimized HLO, and require ZERO scatter-classified
+    entry instructions with full parse coverage — the merge gate of the
+    byte-amplification fix, pinned against compiler drift."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.expr.eval import ColV
+    from spark_rapids_tpu.ops import groupby as G
+
+    cap = 1 << 10
+    rng = np.random.default_rng(0)
+    keys = ColV(jnp.asarray(rng.integers(0, 50, cap).astype(np.int64)),
+                jnp.ones(cap, jnp.bool_))
+    vals = ColV(jnp.asarray(rng.integers(-100, 100, cap).astype(np.int64)),
+                jnp.ones(cap, jnp.bool_))
+    fvals = ColV(jnp.asarray(rng.normal(size=cap)),
+                 jnp.ones(cap, jnp.bool_))
+
+    def run(k, v, f, n):
+        return G.groupby_agg(
+            [k], [T.LONG], [v, f, v, None, v],
+            ["sum", "sum", "min", "count_star", "first"],
+            n, strategy="RADIX")
+
+    txt = (jax.jit(run)
+           .lower(keys, vals, fvals, jnp.int32(cap)).compile().as_text())
+    s = hlo.summarize_hlo(txt, top_k=64)
+    assert s["coverage"] == 1.0
+    assert s["scatter_count"] == 0, [
+        r for r in s["top_fusions"]
+        if r["class"] in ("scatter", "scatter-add")]
+    mod = hlo.parse_hlo_module(txt)
+    classes = {hlo.classify(mod, ins) for ins in mod.instrs(mod.entry)}
+    assert "radix-bin" in classes, classes
+    assert not classes & {"scatter", "scatter-add"}, classes
+
+
 def test_top_k_truncates_ranked_list():
     s = hlo.summarize_hlo(CPU_HLO, top_k=1)
     assert len(s["top_fusions"]) == 1
@@ -496,11 +626,59 @@ def test_diff_bench_gates_hlo_fields():
         shape(1 << 20, 0, strategy="SORT"),
         shape(1 << 20, 3, strategy="SCATTER"), threshold=0.2)
     assert n == 0, text
+    # ... and its fusion-map delta: the radix loop compiles as ONE big
+    # fusion, so a flip's top-fusion growth is owned too (total bytes
+    # stay gated by byte_amplification)
+    text, n = tpu_profile.diff_bench(
+        shape(1 << 20, 2, strategy="SCATTER"),
+        shape(10 << 20, 0, strategy="RADIX"), threshold=0.2)
+    assert n == 0, text
     # absent fields (old rounds): no gate
     text, n = tpu_profile.diff_bench(
         {"per_shape": {"agg": {"tpu_ms": 100.0}}},
         shape(1 << 20, 2), threshold=0.2)
     assert n == 0, text
+
+
+def test_diff_bench_gates_byte_amplification():
+    def shape(**kw):
+        return {"per_shape": {"agg": {"tpu_ms": 100.0, **kw}}}
+
+    # first-class field, beyond-threshold growth: REGRESSION
+    text, n = tpu_profile.diff_bench(
+        shape(byte_amplification=2.5),
+        shape(byte_amplification=25.0), threshold=0.2)
+    assert n == 1 and "agg.byte_amplification: REGRESSION" in text
+    # shrink (the round-12 fix direction): ok
+    text, n = tpu_profile.diff_bench(
+        shape(byte_amplification=25.0),
+        shape(byte_amplification=2.5), threshold=0.2)
+    assert n == 0 and "agg.byte_amplification: ok" in text
+    # BACKFILL: an r09-era json carries only the two inputs — the ratio
+    # is derived (19.4 GB / 772 MB ~ 25x) and still gates the new run
+    old = shape(xla_bytes_accessed=int(19.4e9),
+                predicted_hbm_bytes=int(772e6))
+    text, n = tpu_profile.diff_bench(
+        old, shape(byte_amplification=4.0), threshold=0.2)
+    assert n == 0 and "25.13x -> 4.00x" in text, text
+    text, n = tpu_profile.diff_bench(
+        shape(byte_amplification=4.0), old, threshold=0.2)
+    assert n == 1 and "REGRESSION" in text
+    # one side missing both inputs: no gate
+    text, n = tpu_profile.diff_bench(
+        shape(), shape(byte_amplification=9.9), threshold=0.2)
+    assert n == 0, text
+    # and bench.py's own helper is the same ratio (shared definition)
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(os.path.dirname(__file__), os.pardir,
+                                  "bench.py"))
+    bench_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_mod)
+    assert bench_mod.byte_amplification(int(19.4e9), int(772e6)) == 25.13
+    assert bench_mod.byte_amplification(None, 100) is None
+    assert bench_mod.byte_amplification(100, 0) is None
 
 
 # ---------------------------------------------------------------------------

@@ -286,18 +286,41 @@ def test_strategy_chooser_forced_and_auto_branches():
     s, why = choose_agg_strategy(auto, 1 << 20, ops, exprs, keys,
                                  backend="cpu")
     assert s == "SCATTER" and "CPU backend" in why
-    # on an accelerator backend AUTO compares the measured-rate models;
+    # on an accelerator backend AUTO compares the derated-peak models;
     # a wide aggregate (many limb columns) pushes the matmul cost up
-    # until the bandwidth-sized sort wins
+    # until the bandwidth-sized tiled radix lowering wins
     wide_ops = tuple(["sum"] * 40)
     wide_exprs = tuple(E.BoundReference(i, T.LONG, True) for i in range(40))
     s_wide, why_wide = choose_agg_strategy(
         auto, 1 << 24, wide_ops, wide_exprs, keys, backend="tpu")
     s_narrow, _ = choose_agg_strategy(
         auto, 1 << 24, ("count_star",), (None,), keys, backend="tpu")
-    assert s_wide == "SORT", why_wide
+    assert s_wide == "RADIX", why_wide
     assert s_narrow == "MATMUL"
-    assert "est matmul" in why_wide and "sort" in why_wide
+    assert "est matmul" in why_wide and "radix" in why_wide
+    # exact float sums (variableFloatAgg off) keep RADIX out of AUTO:
+    # the bandwidth pick degrades to SORT, whose float sums stay on the
+    # order-preserving scatter path
+    fwide_ops = tuple(["sum"] * 40)
+    fwide_exprs = tuple(E.BoundReference(i, T.DOUBLE, True)
+                        for i in range(40))
+    s_f, why_f = choose_agg_strategy(
+        auto, 1 << 24, fwide_ops, fwide_exprs, keys, backend="tpu")
+    assert s_f == "SORT", why_f
+    # CPU AUTO flips to RADIX at the byte-amplification capacity
+    # threshold (the merge gate is XLA bytes, not shared-box wall clock)
+    s_big, why_big = choose_agg_strategy(
+        auto, 1 << 24, ops, exprs, keys, backend="cpu")
+    assert s_big == "RADIX" and "amplif" in why_big
+    # the chooser reads the conf-declared roofline peaks (one peak
+    # source with the roofline report): a huge declared MXU peak makes
+    # the matmul model win the same wide shape RADIX just won
+    fast_mxu = RapidsConf(
+        {"spark.rapids.tpu.roofline.peakTflops": 197000.0})
+    s_conf, why_conf = choose_agg_strategy(
+        fast_mxu, 1 << 24, wide_ops, wide_exprs, keys, backend="tpu")
+    assert s_conf == "MATMUL", why_conf
+    assert "197000TF" in why_conf
 
 
 def test_strategy_visible_in_events_and_explain_metrics():

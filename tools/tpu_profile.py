@@ -866,6 +866,21 @@ def run_alerts(events: List[dict], stall_ms: int, pressure_fraction: float,
 # ---------------------------------------------------------------------------
 # diff mode
 # ---------------------------------------------------------------------------
+def _byte_amp(shape_row: dict) -> Optional[float]:
+    """Per-shape byte amplification (XLA bytes-accessed / analyzer
+    layout bound). Newer BENCH jsons carry it first-class
+    (bench.byte_amplification); older rounds that recorded both inputs
+    are BACKFILLED here so the r09-era baselines still gate the fix."""
+    amp = shape_row.get("byte_amplification")
+    if amp is not None:
+        return amp
+    xb = shape_row.get("xla_bytes_accessed")
+    lb = shape_row.get("predicted_hbm_bytes")
+    if xb and lb:
+        return round(xb / lb, 2)
+    return None
+
+
 def diff_bench(old: dict, new: dict, threshold: float
                ) -> Tuple[str, int]:
     # driver-captured BENCH_*.json files wrap the bench line in a
@@ -913,8 +928,13 @@ def diff_bench(old: dict, new: dict, threshold: float
         # runs harvested it (hbm_frac_xla = XLA bytes / device time /
         # peak); a relative drop beyond the threshold means the device
         # got less busy for the same compiled work
+        # ... unless the agg lowering deliberately changed (flagged
+        # above): a strategy flip rewrites what "the same compiled work"
+        # even is — e.g. the radix rewrite shrinks XLA bytes ~25x, which
+        # reads as a frac drop while being the fix itself
         fa, fb = a.get("hbm_frac_xla"), b.get("hbm_frac_xla")
-        if fa is not None and fb is not None and fa > DIFF_MIN_FRAC:
+        if fa is not None and fb is not None and fa > DIFF_MIN_FRAC \
+                and sa == sb:
             # same unbounded ratio form as the tpu_ms/device_ms gates: a
             # drop-fraction ((fa-fb)/fa) saturates at 1.0 and can never
             # clear CI's threshold 2.0, so a full collapse would pass
@@ -932,7 +952,11 @@ def diff_bench(old: dict, new: dict, threshold: float
         # not grow beyond the threshold, and the scatter count must not
         # rise (both shape-derived — meaningful across environments)
         ta, tb = a.get("hlo_top_fusion_bytes"), b.get("hlo_top_fusion_bytes")
-        if ta and tb:
+        if ta and tb and sa == sb:
+            # a deliberate lowering flip redraws the fusion map (the
+            # radix loop IS one big fusion); its TOTAL bytes are gated
+            # by byte_amplification above, so the per-fusion gate only
+            # binds same-strategy runs
             if tb > ta * (1.0 + threshold):
                 regressions += 1
                 lines.append(f"  {shape}.hlo_top_fusion_bytes: REGRESSION "
@@ -940,6 +964,36 @@ def diff_bench(old: dict, new: dict, threshold: float
             else:
                 lines.append(f"  {shape}.hlo_top_fusion_bytes: ok "
                              f"{ta} -> {tb}")
+        # byte amplification (XLA bytes / layout bound): the trended
+        # number of the round-12 kernel rewrite. Growth beyond the
+        # threshold means the compiled programs started touching bytes
+        # the layout never demanded — a regression even when wall clock
+        # on a noisy shared box hides it (backfilled for older jsons)
+        aa, ab = _byte_amp(a), _byte_amp(b)
+        if aa and ab:
+            if ab > aa * (1.0 + threshold):
+                regressions += 1
+                lines.append(f"  {shape}.byte_amplification: REGRESSION "
+                             f"{aa:.2f}x -> {ab:.2f}x of the layout "
+                             f"bound (threshold {1 + threshold:.2f}x "
+                             "growth)")
+            else:
+                lines.append(f"  {shape}.byte_amplification: ok "
+                             f"{aa:.2f}x -> {ab:.2f}x")
+        # peak temp (largest per-program temp allocation): growth beyond
+        # the threshold under the SAME lowering means a program started
+        # materializing bigger intermediates; a strategy flip owns its
+        # temp profile (flagged above)
+        pa, pb = a.get("xla_peak_temp_bytes"), b.get("xla_peak_temp_bytes")
+        if pa and pb and sa == sb:
+            if pb > pa * (1.0 + threshold):
+                regressions += 1
+                lines.append(f"  {shape}.xla_peak_temp_bytes: REGRESSION "
+                             f"{pa} -> {pb} (bigger materialized "
+                             "intermediates)")
+            else:
+                lines.append(f"  {shape}.xla_peak_temp_bytes: ok "
+                             f"{pa} -> {pb}")
         ka, kb = a.get("hlo_scatter_count"), b.get("hlo_scatter_count")
         if ka is not None and kb is not None:
             # growth is gated only when the agg lowering did NOT change:
