@@ -6,3 +6,4 @@ from .column import (  # noqa: F401
     string_column_from_parts,
 )
 from .batch import ColumnarBatch, batch_from_rows, schema_of  # noqa: F401
+from .split import split_batch  # noqa: F401

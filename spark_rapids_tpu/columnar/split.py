@@ -1,0 +1,119 @@
+"""Row-wise batch splitting for split-and-retry (memory/retry.py).
+
+Reference analog: the ``GpuBatchUtils``/``SpillableColumnarBatch`` halving
+the reference's ``RmmRapidsRetryIterator`` performs on ``SplitAndRetryOOM``
+(cudf ``Table.contiguousSplit``) — when retries under memory pressure
+exhaust, the operator re-attempts on half the input. There is no cudf
+here, so the split re-packs each column's planes into fresh
+capacity-bucketed arrays:
+
+  * fixed-width: data + validity sliced into ``choose_capacity(piece)``
+    buckets, padding slots zeroed/invalid (the engine-wide invariant);
+  * string: offsets rebased per piece (``offsets - offsets[start]``),
+    chars sliced to the piece's byte range, char pool re-bucketed;
+  * dict-encoded: codes/validity split like fixed-width, the dictionary
+    aux planes (offsets + chars pool) SHARED by both pieces — late
+    materialization survives the split;
+  * zero-column batches (count(*) after full pruning) split by row count
+    alone, each piece carrying its own capacity bucket.
+
+The split necessarily syncs the row count (and string byte bounds) to the
+host — it runs on the OOM recovery path, where a link round trip is the
+cheap part of the story.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..types import StructType
+from .batch import ColumnarBatch
+from .column import DeviceColumn, choose_capacity
+
+
+def _split_fixed(data, validity, start: int, rows: int, cap: int):
+    import jax.numpy as jnp
+
+    out_d = jnp.zeros(cap, data.dtype)
+    out_v = jnp.zeros(cap, jnp.bool_)
+    if rows:
+        out_d = out_d.at[:rows].set(data[start:start + rows])
+        out_v = out_v.at[:rows].set(validity[start:start + rows])
+    # null-park the piece's data so masked reductions stay well-defined
+    # even if the source carried values under invalid live slots
+    out_d = jnp.where(out_v, out_d, jnp.zeros((), out_d.dtype))
+    return out_d, out_v
+
+
+def _split_string_col(col: DeviceColumn, start: int, rows: int,
+                      cap: int) -> DeviceColumn:
+    import jax
+    import jax.numpy as jnp
+
+    # one batched pull for the piece's byte bounds (a recovery-path sync)
+    b0, b1 = (int(v) for v in jax.device_get(
+        [col.offsets[start], col.offsets[start + rows]]))
+    nbytes = b1 - b0
+    char_cap = choose_capacity(max(1, nbytes), 128)
+    offsets = jnp.full(cap + 1, jnp.int32(nbytes))
+    if rows:
+        offsets = offsets.at[: rows + 1].set(
+            col.offsets[start: start + rows + 1] - jnp.int32(b0))
+    else:
+        offsets = jnp.zeros(cap + 1, jnp.int32)
+    chars = jnp.zeros(char_cap, jnp.uint8)
+    if nbytes:
+        chars = chars.at[:nbytes].set(col.chars[b0:b1])
+    validity = jnp.zeros(cap, jnp.bool_)
+    if rows:
+        validity = validity.at[:rows].set(col.validity[start:start + rows])
+    return DeviceColumn(col.dtype, rows, None, validity,
+                        offsets=offsets, chars=chars)
+
+
+def _split_dict_col(col: DeviceColumn, start: int, rows: int,
+                    cap: int) -> DeviceColumn:
+    import jax.numpy as jnp
+
+    from ..expr.values import DictV
+
+    d = col.dictv
+    codes = jnp.zeros(cap, jnp.int32)
+    validity = jnp.zeros(cap, jnp.bool_)
+    if rows:
+        codes = codes.at[:rows].set(d.codes[start:start + rows])
+        validity = validity.at[:rows].set(d.validity[start:start + rows])
+    codes = jnp.where(validity, codes, jnp.zeros((), jnp.int32))
+    # dictionary planes (and the static mat_cap/max_len bounds) ride
+    # along unchanged: both pieces keep late materialization
+    dv = DictV(codes, d.dictionary, validity, d.mat_cap, d.max_len,
+               d.unique)
+    return DeviceColumn.dict_encoded(col.dtype, rows, dv)
+
+
+def _slice_piece(batch: ColumnarBatch, start: int, rows: int
+                 ) -> ColumnarBatch:
+    cap = choose_capacity(max(1, rows))
+    cols: List[DeviceColumn] = []
+    for c in batch.columns:
+        if c.is_dict:
+            cols.append(_split_dict_col(c, start, rows, cap))
+        elif c.is_string:
+            cols.append(_split_string_col(c, start, rows, cap))
+        else:
+            d, v = _split_fixed(c.data, c.validity, start, rows, cap)
+            cols.append(DeviceColumn(c.dtype, rows, d, v))
+    return ColumnarBatch(cols, batch.schema, rows, capacity=cap)
+
+
+def split_batch(batch: ColumnarBatch
+                ) -> Tuple[ColumnarBatch, ColumnarBatch]:
+    """Split ``batch`` row-wise into two halves (first half >= second),
+    each re-packed into its own capacity bucket with every plane
+    invariant preserved. Raises ValueError on batches below 2 rows —
+    the split-and-retry recursion's floor."""
+    n = batch.num_rows  # syncs a lazy count: the recovery path may
+    if n < 2:
+        raise ValueError(f"cannot split a {n}-row batch")
+    lo_rows = (n + 1) // 2
+    return (_slice_piece(batch, 0, lo_rows),
+            _slice_piece(batch, lo_rows, n - lo_rows))

@@ -516,6 +516,15 @@ WATCHDOG_STORM_WINDOW_MS = conf(
     "miss-count threshold is sql.analysis.recompileStorm.threshold (one "
     "storm definition engine-wide: static forecast, offline profiler "
     "footer, and live watchdog all agree).", check=_positive)
+WATCHDOG_RETRY_STORM_THRESHOLD = conf(
+    "spark.rapids.tpu.watchdog.retryStorm.threshold", 8,
+    "Raise a retry_storm alert when one operator logs at least this "
+    "many OOM recovery actions (memory/retry.py oom_retry events) "
+    "inside watchdog.recompileStorm.windowMs: the queries still "
+    "complete, but every batch is paying spill + backoff (+ the "
+    "half-capacity recompiles of split-and-retry) — the admission "
+    "forecasts or memory.hbm.budgetBytes need attention.",
+    check=_positive)
 
 # ---------------------------------------------------------------------------
 # Test hooks (reference: RapidsConf 'test' keys)

@@ -116,6 +116,18 @@ EVENT_TYPES: Dict[str, tuple] = {
     "transfer": ("direction", "bytes", "site"),
     # spill lifecycle with the catalog's LIVE device-byte watermark
     "spill": ("kind", "bytes", "device_bytes"),
+    # OOM recovery plane (memory/retry.py): one record per recovery
+    # action. ``kind`` is retry (spill+backoff before re-attempt) /
+    # split (escalation to half-capacity) / requeue (the serve
+    # scheduler re-admitting a query with its forecast inflated to the
+    # observed peak); ``attempt`` counts attempts so far, ``depth`` the
+    # split recursion level, watermark/budget the catalog state at the
+    # failure (budget null = unlimited)
+    "oom_retry": ("op", "kind", "attempt", "depth", "watermark",
+                  "budget"),
+    # one split-and-retry halving: the input rows and both pieces'
+    # (first piece takes the extra row on odd counts)
+    "batch_split": ("op", "depth", "rows", "rows_left", "rows_right"),
     # shuffle pieces through the transport SPI (shuffle/transport.py)
     "shuffle_write": ("shuffle_id", "map_id", "reduce_id", "rows", "bytes",
                       "codec"),
@@ -174,6 +186,10 @@ EVENT_OPTIONAL_FIELDS: Dict[str, tuple] = {
     # confs are 0.0 and per-backend defaults apply)
     "program_cost": ("op", "out_bytes", "generated_code_bytes",
                      "peak_hbm_gbps", "peak_tflops"),
+    # ``retries``: transient-failure retries the network transport paid
+    # before this fetch succeeded (shuffle/network.py exponential
+    # backoff; absent on the in-process transports, 0 on a clean fetch)
+    "shuffle_fetch": ("retries",),
     # ``op``: same attribution as program_cost; ``accounted_frac``: this
     # summary's total_bytes / the program's cost_analysis bytes accessed
     # (absent when the backend reported no byte cost) — XLA applies
@@ -457,6 +473,24 @@ def chrome_trace(records: List[dict]) -> dict:
             out.append({"ph": "i", "pid": _PID, "tid": tid_of("memory"),
                         "name": f"{r['kind']} {r['bytes']}B", "ts": us(ts),
                         "s": "t"})
+        elif ev == "oom_retry":
+            # the resilience track: recovery actions land beside the
+            # compile track, so a degraded query's half-capacity
+            # recompiles are attributable to the split that caused them
+            out.append({"ph": "i", "pid": _PID, "tid": tid_of("resilience"),
+                        "name": f"oom_{r['kind']} {r['op']} "
+                                f"(attempt {r.get('attempt')}, "
+                                f"depth {r.get('depth')})",
+                        "ts": us(ts), "s": "t",
+                        "args": {"watermark": r.get("watermark"),
+                                 "budget": r.get("budget")}})
+        elif ev == "batch_split":
+            out.append({"ph": "i", "pid": _PID, "tid": tid_of("resilience"),
+                        "name": f"split {r['op']} depth {r.get('depth')}: "
+                                f"{r.get('rows')} -> "
+                                f"{r.get('rows_left')}+"
+                                f"{r.get('rows_right')}",
+                        "ts": us(ts), "s": "t"})
         elif ev == "transfer":
             # per-shard staging uploads land on their chip's transfer
             # track so the sharded scan's upload pipeline is visible

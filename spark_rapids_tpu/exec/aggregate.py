@@ -957,16 +957,27 @@ class TpuHashAggregateExec(TpuExec):
         # per-partition constant: the source schema's elision flags
         # (recomputing per batch would put a conf+schema walk on the
         # per-batch dispatch hot path)
+        from ..memory.retry import is_device_oom, with_oom_retry
         from ..plugin.plananalysis import entry_nonnull_flags
 
         src_nonnull = entry_nonnull_flags(source.output_schema, self.conf)
 
+        def update_with_retry(b):
+            # the per-batch update under the OOM harness: a split hands
+            # back one partial PER HALF — exactly what the merge path
+            # already consumes (combine="list"), so the aggregate
+            # completes on half-capacity update programs
+            partials.extend(with_oom_retry(
+                self.node_name,
+                lambda piece: self._run_batch(
+                    piece, ops, exprs, tuple(chain), nonnull=src_nonnull),
+                b, self.conf, combine="list",
+                on_pressure=getattr(source, "invalidate_prefetch", None)))
+
         def flush_buffered():
             for b in batches:
                 with self.op_timed("update"):
-                    partials.append(
-                        self._run_batch(b, ops, exprs, tuple(chain),
-                                        nonnull=src_nonnull))
+                    update_with_retry(b)
             batches.clear()
 
         for batch in source.execute_partition(index):
@@ -975,9 +986,7 @@ class TpuHashAggregateExec(TpuExec):
                 continue
             if not use_fused:
                 with self.op_timed("update"):
-                    partials.append(
-                        self._run_batch(batch, ops, exprs, tuple(chain),
-                                        nonnull=src_nonnull))
+                    update_with_retry(batch)
                 continue
             batches.append(batch)
             cap_sum += max(1, batch.capacity if batch.columns else 1)
@@ -989,10 +998,37 @@ class TpuHashAggregateExec(TpuExec):
                 use_fused = False
                 flush_buffered()
         if use_fused and batches:
-            with self.op_timed("plan"):
-                out = self._run_fused_plan(batches, tuple(chain))
-            yield self.record_batch(out)
-            return
+            try:
+                with self.op_timed("plan"):
+                    from .. import faults as _faults
+
+                    if _faults.enabled():
+                        # the fused whole-plan program is the aggregate's
+                        # pipeline-dispatch boundary when it runs —
+                        # injected OOMs must reach it (the recovery is
+                        # the flush-to-streaming fallback below)
+                        _faults.check(
+                            "oom", self.node_name,
+                            cap=max(b.capacity for b in batches))
+                    out = self._run_fused_plan(batches, tuple(chain))
+                yield self.record_batch(out)
+                return
+            except Exception as e:  # noqa: BLE001 - filtered below
+                from ..memory.retry import OOM_RETRY_ENABLED
+
+                if not is_device_oom(e) \
+                        or not self.conf.get(OOM_RETRY_ENABLED):
+                    # oomRetry.enabled off = the raw pre-recovery
+                    # behavior everywhere, fallback included
+                    raise
+                # the whole-plan fused program (every batch stacked into
+                # one trace) exhausted device memory: degrade to the
+                # streaming per-batch path, whose updates run under the
+                # retry/split harness individually
+                from ..memory.retry import _emit_retry
+
+                _emit_retry(self.node_name, "fused_plan_fallback", 1, 0)
+                flush_buffered()
         if not partials:
             if self.group_exprs:
                 return  # grouped aggregate over empty input -> no rows
@@ -1004,10 +1040,18 @@ class TpuHashAggregateExec(TpuExec):
             )
             with self.op_timed("update"):
                 partials = [self._run_batch(zb, ops, exprs)]
-        with self.op_timed("merge"):
+        from ..memory.retry import with_oom_retry_nosplit
+
+        def merge_and_eval():
             merged = self._merge(partials)
-            if self.mode == A.PARTIAL:
-                out = merged
-            else:
-                out = self._evaluate(merged)
+            return merged if self.mode == A.PARTIAL \
+                else self._evaluate(merged)
+
+        with self.op_timed("merge"):
+            # the merge consumes compacted partials (group-cardinality
+            # sized, not input sized) — not meaningfully splittable, so
+            # it gets the retry-only harness: spill + backoff, then the
+            # typed TpuRetryOOM verdict
+            out = with_oom_retry_nosplit(
+                self.node_name + ".merge", merge_and_eval, self.conf)
         yield self.record_batch(out)

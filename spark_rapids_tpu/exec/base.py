@@ -16,6 +16,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Sequence
 import jax
 
 from .. import events as _events
+from .. import faults as _faults
 from .. import obs as _obs
 from .. import xla_cost as _xla_cost
 from ..columnar import ColumnarBatch, DeviceColumn
@@ -94,6 +95,11 @@ def cached_pipeline(cache: dict, key, site: Optional[str],
         if fn is None:
             if len(cache) > max_entries:
                 cache.clear()
+            if _faults.enabled():
+                # injected compile failure (chaos testing): raised BEFORE
+                # the miss is counted or the entry installed, so a failed
+                # build never pollutes the cache or the miss accounting
+                _faults.check("compile", site or "<anon>")
             if site is not None:
                 note_compile_miss(site)
             # compiled-program cost plane (xla_cost.py): while a cost
@@ -765,22 +771,36 @@ def fused_pipeline(chain: Sequence[TpuExec], sig: tuple, cap: int,
 def run_fused_chain(exec_self: TpuExec, index: int) -> Iterator[ColumnarBatch]:
     """Shared execute_partition for fusable execs: the whole chain below
     (and including) ``exec_self`` runs as one XLA dispatch per batch, with
-    the row count threaded through as a device scalar (no host syncs)."""
+    the row count threaded through as a device scalar (no host syncs).
+
+    Each dispatch runs under the OOM retry harness (memory/retry.py): a
+    device allocation failure spills + re-attempts, and exhausted retries
+    split the batch in half — the halves recompile the chain at their
+    smaller capacity buckets and the piece outputs re-join row-wise
+    (exact: the chain is row-local by construction)."""
+    from ..memory.retry import with_oom_retry
     from ..plugin.plananalysis import entry_nonnull_flags
 
     source, chain = exec_self.fused_source_chain()
     out_schema = exec_self.output_schema
     sides = [e.side_vals() for e in chain]
     nonnull = entry_nonnull_flags(source.output_schema, exec_self.conf)
+    # pressure hook: a scan source's staged prefetch holds device
+    # residency an OOM recovery wants back (exec/scan.py)
+    on_pressure = getattr(source, "invalidate_prefetch", None)
+
+    def attempt(b: ColumnarBatch) -> ColumnarBatch:
+        cap = b.capacity
+        fn = fused_pipeline(chain, batch_signature(b), cap, sides,
+                            nonnull)
+        vals, nr = fn(
+            vals_of_batch(b), count_scalar(b.num_rows_lazy), sides)
+        return batch_from_vals(vals, out_schema, nr, capacity=cap)
+
     for batch in source.execute_partition(index):
         with exec_self.op_timed():
-            cap = batch.capacity
-            fn = fused_pipeline(chain, batch_signature(batch), cap, sides,
-                                nonnull)
-            vals, nr = fn(
-                vals_of_batch(batch), count_scalar(batch.num_rows_lazy),
-                sides)
-            out = batch_from_vals(vals, out_schema, nr, capacity=cap)
+            out = with_oom_retry(exec_self.node_name, attempt, batch,
+                                 exec_self.conf, on_pressure=on_pressure)
         yield exec_self.record_batch(out)
 
 

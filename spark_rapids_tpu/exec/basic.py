@@ -25,7 +25,6 @@ from ..columnar.column import column_from_pylist
 from ..conf import MAX_READER_BATCH_SIZE_ROWS, RapidsConf
 from ..expr import expressions as E
 from ..expr.eval import ColV, StrV, lower
-from ..ops import concat as concat_ops
 from ..ops import filter_gather
 from ..types import StructField, StructType
 from ..columnar.column import choose_capacity
@@ -519,34 +518,13 @@ class TpuCoalesceBatchesExec(TpuExec):
     def _flush(self, pending: List[ColumnarBatch]) -> Optional[ColumnarBatch]:
         if not pending:
             return None
-        if len(pending) == 1:
-            return pending[0]
-        from .base import materialized_batch
+        # ONE multi-batch stitch engine-wide: the same helper re-joins
+        # split-and-retry pieces (memory/retry.py), so the concat
+        # invariants (dict materialization, char-cap bucketing,
+        # zero-column row carry) cannot drift between the two paths
+        from ..memory.retry import concat_batches
 
-        # dict-encoded columns materialize at the concat boundary: batches
-        # may carry DIFFERENT dictionaries (and plain/dict mixes), so the
-        # stitched column uses the universal layout
-        pending = [materialized_batch(b) for b in pending]
-        lengths = [b.num_rows for b in pending]
-        total = sum(lengths)
-        out_cap = choose_capacity(total, self.conf.shape_bucket_min)
-        str_cols = [
-            j for j, f in enumerate(self.output_schema.fields)
-            if isinstance(f.dataType, (T.StringType, T.BinaryType))
-        ]
-        byte_lengths = []
-        for b in pending:
-            bl = [int(b.columns[j].offsets[b.num_rows]) for j in str_cols]
-            byte_lengths.append(bl)
-        out_char_caps = [
-            choose_capacity(max(1, sum(byte_lengths[i][k] for i in range(len(pending)))), 128)
-            for k in range(len(str_cols))
-        ]
-        cols, n = concat_ops.concat_batches_cols(
-            [vals_of_batch(b) for b in pending], lengths, byte_lengths,
-            out_cap, out_char_caps,
-        )
-        return batch_from_vals(cols, self.output_schema, n)
+        return concat_batches(self.conf, pending)
 
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
         pending: List[ColumnarBatch] = []

@@ -353,9 +353,15 @@ class TpuShuffleExchangeExec(TpuExec):
                     for p in range(child.num_partitions)
                     for b in child.execute_partition(p)
                 )
+            from ..memory.retry import named_oom
+
             P = self.num_partitions
             self.partition_rows = [0] * P
-            with self.op_timed():
+            with self.op_timed(), named_oom(f"{self.node_name}.map"):
+                # exchange map-side staging (partition sort + piece
+                # slicing) sits outside the per-batch retry harness: a
+                # device allocation failure here is a named
+                # TpuOutOfDeviceMemory, not a bare XLA traceback
                 for map_id, batch in batch_iter:
                     if not batch.columns:
                         continue
@@ -424,8 +430,12 @@ class TpuShuffleExchangeExec(TpuExec):
             self._map_done = False
         if not pieces:
             return
+        from ..memory.retry import named_oom
+
         schema = self.output_schema
-        yield self.record_batch(concat_pieces(pieces, schema))
+        with named_oom(f"{self.node_name}.reduce"):
+            out = concat_pieces(pieces, schema)
+        yield self.record_batch(out)
 
 
 # ---------------------------------------------------------------------------

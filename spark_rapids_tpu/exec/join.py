@@ -470,24 +470,35 @@ class TpuShuffledHashJoinExec(TpuExec):
             if self.join_type == "full" and not self.partitioned
             else [index]
         )
+        from ..memory.retry import with_oom_retry
+
+        def probe_attempt(b):
+            from .base import materialized_batch
+
+            # join expansion repeats rows: dict columns materialize
+            # up front (their byte bound only covers row subsets)
+            return self._probe_batch(
+                materialized_batch(b), build_cols, build_words,
+                build_count, build_cap)
+
         for pi in probe_parts:
             for pbatch in self._probe.execute_partition(pi):
-                from .base import materialized_batch
-
-                # join expansion repeats rows: dict columns materialize
-                # up front (their byte bound only covers row subsets)
+                # probe rows are row-local against the intact build side,
+                # so split-and-retry streams each half's output as its
+                # own batch (combine="list") — half-capacity probe
+                # programs, exact results
                 with self.op_timed("probe"):
-                    pbatch = materialized_batch(pbatch)
-                    out = self._probe_batch(
-                        pbatch, build_cols, build_words, build_count,
-                        build_cap)
-                if out is None:
-                    continue
-                batch, matched = out
-                if matched is not None and matched_any is not None:
-                    matched_any = matched_any | matched
-                if batch is not None and batch.num_rows > 0:
-                    yield self.record_batch(batch)
+                    outs = with_oom_retry(
+                        self.node_name, probe_attempt, pbatch, self.conf,
+                        combine="list")
+                for out in outs:
+                    if out is None:
+                        continue
+                    batch, matched = out
+                    if matched is not None and matched_any is not None:
+                        matched_any = matched_any | matched
+                    if batch is not None and batch.num_rows > 0:
+                        yield self.record_batch(batch)
         if self.join_type == "full":
             yield from self._unmatched_build(
                 build_cols, build_live_all, matched_any)

@@ -176,6 +176,10 @@ class TpuFileSourceScanExec(TpuExec):
         self.fmt = fmt
         self._prefetch = None  # MULTITHREADED reader futures
         self._prefetch_dev = None  # host_prefetch device-path futures
+        #: splits already drained — a prefetch table rebuilt after an
+        #: OOM-pressure invalidation must not resubmit (and then retain)
+        #: reads nobody will consume again
+        self._consumed_splits: set = set()
         self.metrics[SCAN_TIME] = self.metric(SCAN_TIME)
         self.metrics[DECODE_TIME] = self.metric(DECODE_TIME)
 
@@ -200,6 +204,7 @@ class TpuFileSourceScanExec(TpuExec):
         so an already-started prefetch is consumed whatever the reader
         type."""
         rt = getattr(self.scanner, "reader_type", lambda: "PERFILE")()
+        self._consumed_splits.add(index)
         if rt != "MULTITHREADED" and self._prefetch is None:
             return self.scanner.read_split_i(index)
         if self._prefetch is None:
@@ -210,13 +215,19 @@ class TpuFileSourceScanExec(TpuExec):
             pool = ThreadPoolExecutor(
                 max_workers=self.conf.get(PARQUET_MULTITHREAD_READ_NUM_THREADS),
                 thread_name_prefix="srtpu-scan")
+            # splits already drained (this one included) stay None: a
+            # table rebuilt after invalidate_prefetch must not resubmit
+            # reads nobody will consume again
             self._prefetch = [
                 pool.submit(self.scanner.read_split_i, i)
+                if i not in self._consumed_splits else None
                 for i in range(self.scanner.num_splits())
             ]
             pool.shutdown(wait=False)
         fut = self._prefetch[index]
         self._prefetch[index] = None  # free the decoded table once consumed
+        if fut is None:  # consumed marker, or invalidated mid-drain
+            return self.scanner.read_split_i(index)
         return fut.result()
 
     def _attach_partition_cols(self, batch: ColumnarBatch, pvals):
@@ -370,13 +381,30 @@ class TpuFileSourceScanExec(TpuExec):
                 self._prefetch_dev = [
                     _prefetch_pool().submit(
                         self.scanner.read_split_device, i)
+                    if i not in self._consumed_splits else None
                     for i in range(n)
                 ]
         elif self._prefetch is None:
             self._prefetch = [
                 _prefetch_pool().submit(self.scanner.read_split_i, i)
+                if i not in self._consumed_splits else None
                 for i in range(n)
             ]
+
+    def invalidate_prefetch(self) -> None:
+        """OOM-pressure hook (memory/retry.py ``on_pressure``): cancel
+        pending prefetch futures and drop the tables — the device path's
+        futures hold STAGED device uploads, exactly the residency an OOM
+        recovery wants back. Already-running futures finish and are
+        garbage-collected; the drain falls back to direct re-reads, so
+        results are identical either way."""
+        for futs in (self._prefetch_dev, self._prefetch):
+            if futs:
+                for f in futs:
+                    if f is not None:
+                        f.cancel()
+        self._prefetch_dev = None
+        self._prefetch = None
 
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
         from ..io.arrow_convert import arrow_to_batch
@@ -388,9 +416,12 @@ class TpuFileSourceScanExec(TpuExec):
         # kernels expand dictionary/RLE pages on-device
         if hasattr(self.scanner, "read_split_device"):
             with self.op_timed("decode", DECODE_TIME):
+                self._consumed_splits.add(index)
+                fut = None
                 if self._prefetch_dev is not None:
                     fut = self._prefetch_dev[index]
                     self._prefetch_dev[index] = None
+                if fut is not None:
                     dev, pvals = fut.result()
                 else:
                     dev, pvals = self.scanner.read_split_device(index)
@@ -399,9 +430,16 @@ class TpuFileSourceScanExec(TpuExec):
                     yield self.record_batch(
                         self._attach_partition_cols(b, pvals))
                 return
+        from ..memory.retry import named_oom
+
         with self.op_timed("read", SCAN_TIME):
             table, pvals = self._read_split(index)
-        with self.op_timed("decode", DECODE_TIME):
+        with self.op_timed("decode", DECODE_TIME), \
+                named_oom(f"{self.node_name}.decode"):
+            # scan staging sits OUTSIDE the retry harness (there is no
+            # input batch to split yet): a device allocation failure
+            # uploading the decoded split surfaces as the named
+            # TpuOutOfDeviceMemory instead of a bare XLA traceback
             schema = self.output_schema
             # the schema only carries the partition keys common to every
             # file (scanner.partition_cols); a split may report extra keys

@@ -96,13 +96,10 @@ class TpuSortExec(TpuExec):
                 lens.append(max(4, choose_capacity(max(1, m), 4)))
         return tuple(lens)
 
-    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
-        batch = self._gather_input(index)
-        if batch is None:
-            return
-        from .base import materialized_batch
-
-        batch = materialized_batch(batch)  # chunk keys want plain bytes
+    def _sort_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """One sort dispatch over one batch (compiled per capacity/
+        signature — a split-and-retry half compiles its own half-capacity
+        program)."""
         cap = batch.capacity
         sml = self._str_lens(batch)
 
@@ -121,8 +118,29 @@ class TpuSortExec(TpuExec):
 
         fn = cached_pipeline(self._jits, key, "sort",
                              lambda: jax.jit(run))
+        vals = fn(
+            vals_of_batch(batch), count_scalar(batch.num_rows_lazy))
+        return batch_from_vals(
+            vals, self.output_schema, batch.num_rows_lazy)
+
+    def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        batch = self._gather_input(index)
+        if batch is None:
+            return
+        from ..memory.retry import concat_batches, with_oom_retry
+        from .base import materialized_batch
+
+        batch = materialized_batch(batch)  # chunk keys want plain bytes
+
+        def combine(pieces):
+            # split-and-retry re-join: the halves are each sorted but the
+            # stitch is not globally ordered — re-sort the concatenation
+            # (stable, so equal keys keep their piece order). The final
+            # program runs at the stitched capacity; if THAT still OOMs
+            # the harness escalates to the typed verdict.
+            return self._sort_batch(concat_batches(self.conf, pieces))
+
         with self.op_timed():
-            vals = fn(
-                vals_of_batch(batch), count_scalar(batch.num_rows_lazy))
-        yield self.record_batch(
-            batch_from_vals(vals, self.output_schema, batch.num_rows_lazy))
+            out = with_oom_retry(self.node_name, self._sort_batch, batch,
+                                 self.conf, combine=combine)
+        yield self.record_batch(out)

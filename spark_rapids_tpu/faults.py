@@ -1,0 +1,282 @@
+"""Deterministic, conf-gated fault injector (`spark.rapids.tpu.test.faults.*`).
+
+Reference analog: the RMM retry-OOM injection the reference's integration
+tests drive (`RmmSpark.forceRetryOOM` / `forceSplitAndRetryOOM` plus the
+spillable-store fault hooks) — the only honest way to exercise the OOM
+retry / split-and-retry plane (memory/retry.py) on a CPU-fallback box
+whose XLA backend never actually exhausts device memory.
+
+Four channels, each with its own conf of comma-separated site specs:
+
+  * ``oom``      — synthetic device-OOM raised at the top of a retry-
+                   harness attempt (the site is the exec's node name);
+  * ``transfer`` — host-link upload failure in ``packed_upload``;
+  * ``fetch``    — network shuffle fetch failure (shuffle/network.py);
+  * ``compile``  — pipeline-cache build failure (exec/base.py).
+
+Spec grammar (per entry, comma-separated; site matching is fnmatch so
+``*`` and prefixes work)::
+
+    site        fire on EVERY arrival at the site
+    site@N      fire on exactly the Nth arrival (1-based, once)
+    site%K      fire on every Kth arrival
+    site>C      fire while the attempt's batch capacity exceeds C rows
+                (the honest memory-exhaustion model: full batches fail,
+                split halves fit)
+    site?K      fire on ONE arrival in [1, K], chosen deterministically
+                from test.faults.seed (seeded chaos schedules)
+
+Zero-overhead-off contract (the events.py pattern): with the confs off —
+the default — ``enabled()`` is one module-global boolean read and
+``check()`` is never consulted; tests/test_retry.py pins this with a
+registry-style spy.
+"""
+from __future__ import annotations
+
+import fnmatch
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .conf import RapidsConf, conf
+
+FAULTS_ENABLED = conf(
+    "spark.rapids.tpu.test.faults.enabled", False,
+    "Install the deterministic fault injector (chaos testing; see the "
+    "channel confs test.faults.oom/transfer/fetch/compile). Off — the "
+    "default — keeps every injection site a single module-global boolean "
+    "read. Setting any channel spec implies this key.", internal=True)
+FAULTS_SEED = conf(
+    "spark.rapids.tpu.test.faults.seed", 0,
+    "Seed for the '?K' spec form: the firing arrival is derived "
+    "deterministically from (seed, channel, site), so a chaos schedule "
+    "replays exactly.", internal=True)
+FAULTS_OOM = conf(
+    "spark.rapids.tpu.test.faults.oom", "",
+    "Synthetic device-OOM specs for the retry-harness channel: "
+    "'site[@N|%K|>C|?K]' entries, comma-separated; sites are exec node "
+    "names (fnmatch patterns allowed). Injected errors carry the XLA "
+    "RESOURCE_EXHAUSTED pattern so the real classifier handles them.",
+    internal=True)
+FAULTS_TRANSFER = conf(
+    "spark.rapids.tpu.test.faults.transfer", "",
+    "Host-link transfer failure specs (site 'packed_upload').",
+    internal=True)
+FAULTS_FETCH = conf(
+    "spark.rapids.tpu.test.faults.fetch", "",
+    "Shuffle network fetch failure specs (site 'network_fetch'); the "
+    "injected error is a ConnectionError, so the client's backoff retry "
+    "path handles it like a real peer reset.", internal=True)
+FAULTS_COMPILE = conf(
+    "spark.rapids.tpu.test.faults.compile", "",
+    "Pipeline-cache build failure specs (sites are compile-cache site "
+    "names, e.g. 'fused_chain', 'agg_plan').", internal=True)
+
+_CHANNEL_CONFS = {
+    "oom": FAULTS_OOM,
+    "transfer": FAULTS_TRANSFER,
+    "fetch": FAULTS_FETCH,
+    "compile": FAULTS_COMPILE,
+}
+
+
+class InjectedFault(RuntimeError):
+    """Base of every injector-raised error (tests discriminate on it)."""
+
+
+class InjectedOOM(InjectedFault):
+    """Synthetic device OOM. The message deliberately carries the XLA
+    RESOURCE_EXHAUSTED pattern so memory/retry.py's classifier treats it
+    exactly like a real backend allocation failure."""
+
+
+class InjectedTransferError(InjectedFault, ConnectionError):
+    """Synthetic host-link transfer failure."""
+
+
+class InjectedFetchError(InjectedFault, ConnectionError):
+    """Synthetic shuffle fetch failure (a ConnectionError, so the
+    transport's retry loop treats it like a real peer reset)."""
+
+
+class InjectedCompileError(InjectedFault):
+    """Synthetic XLA compile failure."""
+
+
+_ERROR_OF = {
+    "oom": InjectedOOM,
+    "transfer": InjectedTransferError,
+    "fetch": InjectedFetchError,
+    "compile": InjectedCompileError,
+}
+
+
+class _Spec:
+    """One parsed site spec."""
+
+    __slots__ = ("pattern", "mode", "arg")
+
+    def __init__(self, pattern: str, mode: str, arg: int):
+        self.pattern = pattern
+        self.mode = mode  # "always" | "nth" | "every" | "cap_gt" | "seeded"
+        self.arg = arg
+
+    def fires(self, arrival: int, cap: Optional[int], seed_at: int) -> bool:
+        if self.mode == "always":
+            return True
+        if self.mode == "nth":
+            return arrival == self.arg
+        if self.mode == "every":
+            return arrival % self.arg == 0
+        if self.mode == "cap_gt":
+            return cap is not None and cap > self.arg
+        # seeded: one deterministic arrival in [1, arg]
+        return arrival == seed_at
+
+
+def _parse_specs(raw: str) -> List[_Spec]:
+    """Parse a channel's spec list, VALIDATING at construction (session
+    init) so a typo'd schedule is a clear conf error, never a
+    mid-query crash from inside the recovery plane (e.g. 'site%0'
+    would otherwise divide by zero at the injection site). Separators
+    split on their LAST occurrence, so fnmatch '?' inside a pattern
+    survives when a real separator follows; a bare trailing '?<K>' is
+    always the seeded spec — '?' as a trailing fnmatch wildcard is not
+    expressible (use '*')."""
+    out: List[_Spec] = []
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        for sep, mode in (("@", "nth"), ("%", "every"), (">", "cap_gt"),
+                          ("?", "seeded")):
+            if sep in entry:
+                pat, _, arg = entry.rpartition(sep)
+                try:
+                    n = int(arg)
+                except ValueError:
+                    raise ValueError(
+                        f"bad fault spec {entry!r}: expected an integer "
+                        f"after {sep!r}")
+                if mode == "cap_gt":
+                    if n < 0:
+                        raise ValueError(
+                            f"bad fault spec {entry!r}: capacity "
+                            "threshold must be >= 0")
+                elif n <= 0:
+                    raise ValueError(
+                        f"bad fault spec {entry!r}: argument must be "
+                        "positive")
+                out.append(_Spec(pat.strip(), mode, n))
+                break
+        else:
+            out.append(_Spec(entry, "always", 0))
+    return out
+
+
+class FaultInjector:
+    """Per-(channel, site) arrival counters driving the parsed specs —
+    deterministic by construction (counts, not clocks)."""
+
+    def __init__(self, conf_: RapidsConf):
+        self.seed = int(conf_.get(FAULTS_SEED))
+        self._specs: Dict[str, List[_Spec]] = {
+            ch: _parse_specs(conf_.get(centry))
+            for ch, centry in _CHANNEL_CONFS.items()
+        }
+        self._counts: Dict[Tuple[str, str], int] = {}
+        self._fired: List[Tuple[str, str, int]] = []
+        self._lock = threading.Lock()
+
+    def _seed_at(self, channel: str, site: str, k: int) -> int:
+        # xorshift-style mix of (seed, channel, site) -> [1, k]
+        h = (self.seed * 1_000_003) & 0xFFFFFFFF
+        for c in channel + ":" + site:
+            h = ((h ^ ord(c)) * 16_777_619) & 0xFFFFFFFF
+        return (h % max(1, k)) + 1
+
+    def check(self, channel: str, site: str,
+              cap: Optional[int] = None) -> None:
+        """Raise the channel's typed injected error if any spec fires on
+        this arrival at ``site``."""
+        specs = self._specs.get(channel)
+        if not specs:
+            return
+        with self._lock:
+            key = (channel, site)
+            arrival = self._counts.get(key, 0) + 1
+            self._counts[key] = arrival
+            hit = None
+            for s in specs:
+                if not fnmatch.fnmatch(site, s.pattern):
+                    continue
+                seed_at = (self._seed_at(channel, site, s.arg)
+                           if s.mode == "seeded" else 0)
+                if s.fires(arrival, cap, seed_at):
+                    hit = s
+                    break
+            if hit is None:
+                return
+            self._fired.append((channel, site, arrival))
+        if channel == "oom":
+            raise InjectedOOM(
+                f"RESOURCE_EXHAUSTED: injected synthetic device OOM at "
+                f"{site} (arrival {arrival}"
+                + (f", cap {cap}" if cap is not None else "") + ")")
+        raise _ERROR_OF[channel](
+            f"injected {channel} fault at {site} (arrival {arrival})")
+
+    def fired(self) -> List[Tuple[str, str, int]]:
+        """(channel, site, arrival) for every fault raised so far."""
+        with self._lock:
+            return list(self._fired)
+
+
+# ---------------------------------------------------------------------------
+# Process-global active injector (the events.py install pattern: injection
+# sites live deep in the engine where no session handle exists).
+# ---------------------------------------------------------------------------
+_ENABLED = False
+_ACTIVE: Optional[FaultInjector] = None
+_INSTALL_LOCK = threading.Lock()
+
+
+def enabled() -> bool:
+    """The hot-path guard — one module-global boolean read."""
+    return _ENABLED
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+def install(conf_: RapidsConf) -> Optional[FaultInjector]:
+    """Install the injector when the confs ask for one (idempotent per
+    conf; any nonempty channel spec implies faults.enabled). Returns
+    None — and installs NOTHING — with the confs off."""
+    want = conf_.get(FAULTS_ENABLED) or any(
+        conf_.get(c) for c in _CHANNEL_CONFS.values())
+    if not want:
+        return None
+    global _ENABLED, _ACTIVE
+    with _INSTALL_LOCK:
+        _ACTIVE = FaultInjector(conf_)
+        _ENABLED = True
+        return _ACTIVE
+
+
+def uninstall() -> None:
+    global _ENABLED, _ACTIVE
+    with _INSTALL_LOCK:
+        _ACTIVE = None
+        _ENABLED = False
+
+
+def check(channel: str, site: str, cap: Optional[int] = None) -> None:
+    """Consult the active injector; a no-op when injection is off. Call
+    sites guard on :func:`enabled` first so the off path stays one
+    boolean read."""
+    if not _ENABLED:
+        return
+    inj = _ACTIVE
+    if inj is not None:
+        inj.check(channel, site, cap)

@@ -140,7 +140,17 @@ def packed_upload(host_arrays: List[np.ndarray]):
     buf = np.zeros(pos, np.uint8)
     for a, (off, ln, _) in zip(host_arrays, layout):
         buf[off: off + a.nbytes] = a.view(np.uint8).reshape(-1)
-    dev = jnp.asarray(buf)
+    from .. import faults as _faults
+
+    if _faults.enabled():
+        # injected host-link transfer failure (chaos testing)
+        _faults.check("transfer", "packed_upload")
+    from ..memory.retry import named_oom
+
+    with named_oom("packed_upload"):
+        # the ONE h2d staging transfer: a device allocation failure here
+        # surfaces as TpuOutOfDeviceMemory naming the site + watermark
+        dev = jnp.asarray(buf)
     from .. import events as _events
 
     if _events.enabled():

@@ -117,23 +117,29 @@ def stage_sharded(
     staged_bytes = [0] * n_shards
 
     def upload_shard(s: int, payload: ShardPayload) -> None:
+        from ..memory.retry import named_oom
+
         t0 = time.perf_counter()
         n = int(payload.rows)
         counts[s] = n
         nbytes = 0
-        for j, f in enumerate(fields):
-            dt = f.dataType.to_numpy()
-            d = np.zeros(cap, dt)
-            v = np.zeros(cap, bool)
-            if n:
-                data, valid = payload.arrays[j]
-                d[:n] = data[:n]
-                v[:n] = valid[:n]
-            dd = jax.device_put(d, devices[s])
-            vv = jax.device_put(v, devices[s])
-            pieces[2 * j][s] = dd
-            pieces[2 * j + 1][s] = vv
-            nbytes += d.nbytes + v.nbytes
+        # a device allocation failure placing a shard's planes surfaces
+        # as TpuOutOfDeviceMemory naming the shard, never a raw XLA
+        # traceback mid-pipeline
+        with named_oom(f"mesh_stage[shard {s}]"):
+            for j, f in enumerate(fields):
+                dt = f.dataType.to_numpy()
+                d = np.zeros(cap, dt)
+                v = np.zeros(cap, bool)
+                if n:
+                    data, valid = payload.arrays[j]
+                    d[:n] = data[:n]
+                    v[:n] = valid[:n]
+                dd = jax.device_put(d, devices[s])
+                vv = jax.device_put(v, devices[s])
+                pieces[2 * j][s] = dd
+                pieces[2 * j + 1][s] = vv
+                nbytes += d.nbytes + v.nbytes
         staged_bytes[s] = nbytes
         if on_shard is not None:
             on_shard(s, n, nbytes, time.perf_counter() - t0)

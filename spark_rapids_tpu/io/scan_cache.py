@@ -133,6 +133,23 @@ class DeviceScanCache:
                 if _obs.enabled():
                     self._obs_note("evict", sz)
 
+    def drop_under_pressure(self) -> int:
+        """Drop EVERY resident entry (OOM recovery, memory/retry.py):
+        cached scan columns are pure re-derivable HBM residency, so under
+        device memory exhaustion they are the first thing to give back.
+        Returns bytes released. Entries re-fill lazily on the next scan."""
+        with self._lock:
+            freed = self._bytes
+            n = len(self._entries)
+            self._entries.clear()
+            self._bytes = 0
+            self.evictions += n
+            if freed and _events.enabled():
+                _events.emit("scan_cache", op="pressure_drop", bytes=freed)
+            if freed and _obs.enabled():
+                self._obs_note("evict", freed)
+            return freed
+
     def invalidate_path(self, path: str) -> None:
         """Drop every entry of one file (the writers' commit protocol
         calls this, io/commit.py — reads stay correct either way via the

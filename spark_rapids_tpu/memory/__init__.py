@@ -15,6 +15,17 @@ from .catalog import (
     TIER_DISK,
     TIER_HOST,
 )
+from .retry import (
+    TpuOOMError,
+    TpuOutOfDeviceMemory,
+    TpuRetryOOM,
+    TpuSplitAndRetryOOM,
+    classify_oom,
+    is_device_oom,
+    named_oom,
+    with_oom_retry,
+    with_oom_retry_nosplit,
+)
 from .semaphore import TpuSemaphore, TpuSemaphoreTimeout
 from .spillable import SpillableColumnarBatch, SpillableVals
 
@@ -29,6 +40,15 @@ __all__ = [
     "TIER_DEVICE",
     "TIER_DISK",
     "TIER_HOST",
+    "TpuOOMError",
+    "TpuOutOfDeviceMemory",
+    "TpuRetryOOM",
     "TpuSemaphore",
     "TpuSemaphoreTimeout",
+    "TpuSplitAndRetryOOM",
+    "classify_oom",
+    "is_device_oom",
+    "named_oom",
+    "with_oom_retry",
+    "with_oom_retry_nosplit",
 ]

@@ -202,52 +202,39 @@ class BufferCatalog:
         self.request(0, exclude=h)
 
     # -- pressure ----------------------------------------------------------
-    def request(self, nbytes: int, exclude: Optional["SpillableHandle"] = None
-                ) -> None:
-        """Make room for an upcoming allocation of ``nbytes`` (the
-        DeviceMemoryEventHandler analog, invoked proactively)."""
-        if self._budget is None or not self.conf.get(SPILL_ENABLED):
-            return
-        # victims are picked under the catalog lock but spilled OUTSIDE it:
-        # each spill takes the handle's own lock, and materialize() takes
-        # handle-then-catalog — never holding one while acquiring the other
-        # in the opposite order avoids a lock-order inversion
+    def _account_device_spill(self, freed: int, emergency: bool) -> None:
+        """THE device->host spill bookkeeping (byte counters, metrics,
+        spill event, obs twins, debug log) — one body shared by the
+        proactive path (:meth:`request`) and the OOM-recovery path
+        (:meth:`ensure_headroom`) so the two sets of books can never
+        diverge. Called after a successful ``spill_to_host``."""
         with self._lock:
-            need = self._device_bytes + nbytes - self._budget
-            victims = sorted(
-                (h for h in self._buffers.values()
-                 if h.tier == TIER_DEVICE and not h.pinned
-                 and h is not exclude),
-                key=lambda h: h.priority,
-            ) if need > 0 else []
-        for h in victims:
-            if need <= 0:
-                break
-            freed = h.spill_to_host()
-            if freed:
-                with self._lock:
-                    self._device_bytes -= freed
-                    self._host_bytes += freed
-                    self.metrics.device_to_host += 1
-                    self.metrics.spilled_bytes += freed
-                    if _events.enabled():
-                        _events.emit("spill", kind="device_to_host",
-                                     bytes=freed,
-                                     device_bytes=self._device_bytes)
-                    if _obs.enabled():
-                        _obs.inc("tpu_spills", 1, kind="device_to_host")
-                        _obs.inc("tpu_spill_bytes", freed,
-                                 kind="device_to_host")
-                        self._obs_watermark()
-                need -= freed
-                if self.conf.get(MEMORY_DEBUG):
-                    log.info("spilled %d B to host (device=%d B)",
-                             freed, self._device_bytes)
-        # host tier over its cap: push oldest to disk. The victim list is
-        # snapshotted under the lock, but the loop re-reads the LIVE byte
-        # count under the lock each iteration so concurrent spillers stop
-        # as soon as the tier is under cap instead of each pushing the full
-        # overage to disk.
+            self._device_bytes -= freed
+            self._host_bytes += freed
+            self.metrics.device_to_host += 1
+            self.metrics.spilled_bytes += freed
+            if _events.enabled():
+                _events.emit("spill", kind="device_to_host",
+                             bytes=freed,
+                             device_bytes=self._device_bytes)
+            if _obs.enabled():
+                _obs.inc("tpu_spills", 1, kind="device_to_host")
+                _obs.inc("tpu_spill_bytes", freed,
+                         kind="device_to_host")
+                self._obs_watermark()
+        if self.conf.get(MEMORY_DEBUG):
+            log.info("%sspilled %d B to host (device=%d B)",
+                     "emergency " if emergency else "", freed,
+                     self._device_bytes)
+
+    def _drain_host_overage(self) -> None:
+        """Push host-tier buffers to disk while the tier exceeds
+        host.spillStorageSize. The victim list is snapshotted under the
+        lock, but the loop re-reads the LIVE byte count under the lock
+        each iteration so concurrent spillers stop as soon as the tier
+        is under cap instead of each pushing the full overage to disk.
+        Deliberately budget-independent: a budget-less catalog's
+        emergency spills must still respect the HOST cap."""
         host_cap = self.conf.get(HOST_SPILL_STORAGE_SIZE)
         with self._lock:
             hosts = sorted(
@@ -272,6 +259,79 @@ class BufferCatalog:
                         _obs.inc("tpu_spills", 1, kind="host_to_disk")
                         _obs.inc("tpu_spill_bytes", freed,
                                  kind="host_to_disk")
+
+    def request(self, nbytes: int, exclude: Optional["SpillableHandle"] = None
+                ) -> None:
+        """Make room for an upcoming allocation of ``nbytes`` (the
+        DeviceMemoryEventHandler analog, invoked proactively)."""
+        if self._budget is None or not self.conf.get(SPILL_ENABLED):
+            return
+        # victims are picked under the catalog lock but spilled OUTSIDE it:
+        # each spill takes the handle's own lock, and materialize() takes
+        # handle-then-catalog — never holding one while acquiring the other
+        # in the opposite order avoids a lock-order inversion
+        with self._lock:
+            need = self._device_bytes + nbytes - self._budget
+            victims = sorted(
+                (h for h in self._buffers.values()
+                 if h.tier == TIER_DEVICE and not h.pinned
+                 and h is not exclude),
+                key=lambda h: h.priority,
+            ) if need > 0 else []
+        for h in victims:
+            if need <= 0:
+                break
+            freed = h.spill_to_host()
+            if freed:
+                self._account_device_spill(freed, emergency=False)
+                need -= freed
+        self._drain_host_overage()
+
+    def ensure_headroom(self, nbytes: Optional[int] = None,
+                        exclude: Optional["SpillableHandle"] = None) -> int:
+        """EMERGENCY spill for OOM recovery (memory/retry.py): drain
+        unpinned device-tier buffers to host until ``nbytes`` have been
+        freed — or ALL of them when ``nbytes`` is None (a real backend
+        OOM means XLA's allocator is full regardless of what the
+        accounting thinks, so the recovery path empties what it can).
+        Unlike :meth:`request` this ignores the device budget (a
+        budget-less catalog still frees memory) but keeps the same
+        victim order, lock discipline, and spill accounting — and the
+        HOST-tier cap still applies (the overage drain below runs
+        unconditionally, not behind the budget guard). Returns bytes
+        freed."""
+        if not self.conf.get(SPILL_ENABLED):
+            return 0
+        with self._lock:
+            victims = sorted(
+                (h for h in self._buffers.values()
+                 if h.tier == TIER_DEVICE and not h.pinned
+                 and h is not exclude),
+                key=lambda h: h.priority,
+            )
+        total = 0
+        for h in victims:
+            if nbytes is not None and total >= nbytes:
+                break
+            freed = h.spill_to_host()
+            if not freed:
+                continue
+            total += freed
+            self._account_device_spill(freed, emergency=True)
+        # unconditional (not gated on total): a recovery pass that freed
+        # nothing itself must still drain an overage a concurrent
+        # spiller left — the host cap holds on every exit path
+        self._drain_host_overage()
+        return total
+
+    def largest_spillable(self) -> int:
+        """Size of the largest unpinned device-tier buffer (0 when none)
+        — reported by TpuOutOfDeviceMemory so an OOM error names what a
+        spill could still have freed."""
+        with self._lock:
+            return max(
+                (h.size for h in self._buffers.values()
+                 if h.tier == TIER_DEVICE and not h.pinned), default=0)
 
     def _disk_dir(self) -> str:
         if self._spill_dir is None:

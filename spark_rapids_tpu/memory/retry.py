@@ -1,0 +1,381 @@
+"""OOM retry + split-and-retry harness: the runtime recovery plane.
+
+Reference analog: ``RmmRapidsRetryIterator.scala`` — the reference wraps
+every operator's batch work in ``withRetry``/``withRetryNoSplit``: a
+``RetryOOM`` spills spillable buffers and re-attempts, a
+``SplitAndRetryOOM`` halves the input and recurses, and only exhaustion
+surfaces to the task. Our static half (the serve scheduler admitting on
+the analyzer's peak-HBM forecast) queues work that predictably fits; this
+module is the dynamic half for when the forecast is WRONG — a mis-sized
+join, fragmentation, an un-modeled shape. A wrong forecast must degrade
+to spill -> retry -> half-capacity batches, never to a raw XLA
+``RESOURCE_EXHAUSTED`` killing the query.
+
+The harness is wired at the exec per-batch dispatch boundaries
+(exec/base.run_fused_chain, sort, aggregate update, join probe): the
+attempt runs, a classified device-OOM releases what the process can give
+back — spillable catalog buffers (``BufferCatalog.ensure_headroom``),
+device scan-cache residency, the caller's staged prefetch via
+``on_pressure`` — and re-attempts with bounded backoff. When retries
+exhaust, the input ``ColumnarBatch`` splits row-wise in half
+(columnar/split.py, preserving validity planes, dict aux planes, and
+capacity buckets) and both halves recurse with bounded depth; outputs
+re-join through the engine's existing multi-batch concat path, so
+aggregates/sorts/joins/projects complete on half-capacity programs.
+Final exhaustion raises a named :class:`TpuSplitAndRetryOOM` carrying op,
+watermark, budget, attempts, and split depth.
+
+Fault injection (faults.py) fires at the top of each attempt — the only
+way to drive these paths on a CPU-fallback box that never really OOMs.
+Zero-overhead-off: ``memory.oomRetry.enabled`` off short-circuits to a
+plain call; on (the default), the happy path costs one try/except frame.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Callable, List, Optional, Sequence, Union
+
+from .. import events as _events
+from .. import faults as _faults
+from .. import obs as _obs
+from ..conf import RapidsConf, conf
+
+log = logging.getLogger("spark_rapids_tpu.memory")
+
+OOM_RETRY_ENABLED = conf(
+    "spark.rapids.tpu.memory.oomRetry.enabled", True,
+    "Wrap per-batch exec dispatches in the OOM retry + split-and-retry "
+    "harness (memory/retry.py): a device allocation failure spills "
+    "spillable buffers, drops scan-cache residency, and re-attempts "
+    "with backoff; exhausted retries split the input batch in half and "
+    "recurse (bounded depth), so operators complete on half-capacity "
+    "programs instead of dying. Off restores the raw-failure behavior.")
+OOM_RETRY_MAX_ATTEMPTS = conf(
+    "spark.rapids.tpu.memory.oomRetry.maxAttempts", 2,
+    "Attempts per split level before the harness escalates to "
+    "split-and-retry (each failed attempt spills + backs off first).",
+    check=lambda v: None if v > 0 else "must be positive")
+OOM_RETRY_BACKOFF_MS = conf(
+    "spark.rapids.tpu.memory.oomRetry.backoffMs", 5,
+    "Base backoff before re-attempting after an OOM (doubles per "
+    "attempt; gives concurrent queries a window to release memory). "
+    "0 disables the sleep.", conf_type=int,
+    check=lambda v: None if v >= 0 else "must be >= 0")
+OOM_RETRY_MAX_SPLIT_DEPTH = conf(
+    "spark.rapids.tpu.memory.oomRetry.maxSplitDepth", 4,
+    "Split-and-retry recursion bound: each level halves the batch, so "
+    "depth 4 reaches 1/16 capacity before TpuSplitAndRetryOOM surfaces.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+
+# ---------------------------------------------------------------------------
+# Typed errors
+# ---------------------------------------------------------------------------
+class TpuOOMError(RuntimeError):
+    """Base of the typed device-memory failures. Carries the recovery
+    context so the error ALONE tells the story: the op, the catalog
+    watermark and derived budget at failure, how many attempts ran, and
+    how deep the split recursion went."""
+
+    def __init__(self, message: str, op: str = "",
+                 watermark: Optional[int] = None,
+                 budget: Optional[int] = None, attempts: int = 0,
+                 split_depth: int = 0):
+        super().__init__(message)
+        self.op = op
+        self.watermark = watermark
+        self.budget = budget
+        self.attempts = attempts
+        self.split_depth = split_depth
+
+
+class TpuRetryOOM(TpuOOMError):
+    """A classified device allocation failure on a non-splittable path
+    whose bounded retries exhausted (the reference's RetryOOM verdict)."""
+
+
+class TpuSplitAndRetryOOM(TpuOOMError):
+    """Retries AND split-and-retry exhausted — the operator cannot
+    complete even at 1/2^maxSplitDepth capacity."""
+
+
+class TpuOutOfDeviceMemory(TpuOOMError):
+    """A raw device allocation failure OUTSIDE the retry harness (scan
+    staging, exchange, mesh staging) wrapped with op, live watermark,
+    derived budget, and the largest spillable buffer — instead of a bare
+    XLA traceback."""
+
+
+#: substrings that identify a backend device-memory failure; XLA surfaces
+#: RESOURCE_EXHAUSTED on TPU/GPU, the CPU backend "Out of memory", and
+#: the injector (faults.py) deliberately carries the first pattern
+_OOM_PATTERNS = (
+    "RESOURCE_EXHAUSTED",
+    "Resource exhausted",
+    "resource exhausted",
+    "Out of memory",
+    "out of memory",
+    "OutOfMemory",
+    "Failed to allocate",
+    "failed to allocate",
+    "Allocation failure",
+)
+
+
+def is_device_oom(exc: BaseException) -> bool:
+    """True when ``exc`` looks like a device allocation failure worth
+    recovering from. Typed TpuOOMError verdicts return False — they are
+    FINAL (a nested harness or named wrapper already recovered as far as
+    recovery goes), except TpuOutOfDeviceMemory, which names a raw
+    failure a surrounding harness may still fix by spilling."""
+    if isinstance(exc, TpuOOMError):
+        return isinstance(exc, TpuOutOfDeviceMemory)
+    msg = str(exc)
+    if any(p in msg for p in _OOM_PATTERNS):
+        return True
+    # XlaRuntimeError without a message match: only the explicit
+    # RESOURCE_EXHAUSTED code counts (other runtime errors are bugs)
+    return False
+
+
+def _hbm_state() -> tuple:
+    from .catalog import BufferCatalog
+
+    cat = BufferCatalog.get()
+    return cat.device_bytes, cat.budget, cat.largest_spillable()
+
+
+def classify_oom(exc: BaseException, op: str) -> Optional[TpuRetryOOM]:
+    """Wrap a raw backend failure into the typed TpuRetryOOM (None when
+    ``exc`` is not a device OOM)."""
+    if not is_device_oom(exc):
+        return None
+    watermark, budget, _ = _hbm_state()
+    return TpuRetryOOM(
+        f"device OOM in {op}: {exc}", op=op, watermark=watermark,
+        budget=budget)
+
+
+def _emit_retry(op: str, kind: str, attempt: int, depth: int) -> None:
+    if _events.enabled() or _obs.enabled():
+        watermark, budget, _ = _hbm_state()
+        _events.emit("oom_retry", op=op, kind=kind, attempt=attempt,
+                     depth=depth, watermark=watermark, budget=budget)
+        if _obs.enabled():
+            _obs.note_oom_retry(op, kind)
+
+
+def _release_pressure(op: str,
+                      on_pressure: Optional[Callable[[], None]]) -> int:
+    """Give back what the process can: spill every spillable catalog
+    buffer, drop scan-cache residency, and run the caller's hook
+    (staged-prefetch invalidation). Returns bytes known released."""
+    from .catalog import BufferCatalog
+
+    freed = BufferCatalog.get().ensure_headroom()
+    from ..io.scan_cache import DeviceScanCache
+
+    cache = DeviceScanCache._instance
+    if cache is not None:
+        freed += cache.drop_under_pressure()
+    if on_pressure is not None:
+        try:
+            on_pressure()
+        except Exception:  # pragma: no cover - a hook must not mask OOM
+            log.exception("on_pressure hook failed during OOM recovery")
+    return freed
+
+
+def concat_batches(conf_: RapidsConf, batches: Sequence) -> "object":
+    """THE engine-wide multi-batch row stitch (the GpuCoalesceBatches
+    concat): dict columns materialize at the boundary, char pools
+    re-bucket, zero-column batches carry their summed row count.
+    Re-joins split-and-retry piece outputs here, and
+    exec/basic.TpuCoalesceBatchesExec._flush delegates to the same body
+    — one implementation, no drift. Schema taken from the pieces."""
+    batches = [b for b in batches if b is not None]
+    if len(batches) == 1:
+        return batches[0]
+    from ..columnar import ColumnarBatch
+    from ..columnar.column import choose_capacity
+
+    schema = batches[0].schema
+    if not batches[0].columns:
+        total = sum(b.num_rows for b in batches)
+        # same bucket rule as the columned branch below — a zero-column
+        # count(*) stitch must land on the bucket the planner forecasts
+        return ColumnarBatch(
+            [], schema, total,
+            capacity=choose_capacity(max(1, total),
+                                     conf_.shape_bucket_min))
+    from .. import types as T
+    from ..exec.base import batch_from_vals, materialized_batch, \
+        vals_of_batch
+    from ..ops import concat as concat_ops
+
+    pending = [materialized_batch(b) for b in batches]
+    lengths = [b.num_rows for b in pending]
+    total = sum(lengths)
+    out_cap = choose_capacity(max(1, total), conf_.shape_bucket_min)
+    str_cols = [
+        j for j, f in enumerate(schema.fields)
+        if isinstance(f.dataType, (T.StringType, T.BinaryType))
+    ]
+    byte_lengths = []
+    for b in pending:
+        bl = [int(b.columns[j].offsets[b.num_rows]) for j in str_cols]
+        byte_lengths.append(bl)
+    out_char_caps = [
+        choose_capacity(
+            max(1, sum(bl[k] for bl in byte_lengths)), 128)
+        for k in range(len(str_cols))
+    ]
+    cols, n = concat_ops.concat_batches_cols(
+        [vals_of_batch(b) for b in pending], lengths, byte_lengths,
+        out_cap, out_char_caps)
+    return batch_from_vals(cols, schema, n)
+
+
+def with_oom_retry(op: str, attempt_fn: Callable, batch,
+                   conf_: RapidsConf,
+                   combine: Union[str, Callable, None] = "concat",
+                   on_pressure: Optional[Callable[[], None]] = None):
+    """Run ``attempt_fn(batch)`` under the retry + split-and-retry
+    harness.
+
+    ``combine`` shapes the return value when a split happened:
+
+      * ``"concat"`` (default) — pieces re-join row-wise through the
+        multi-batch concat path; returns ONE batch (exact for row-local
+        operators: project/filter chains);
+      * ``"list"`` — returns the list of per-piece outputs in row order
+        (aggregate updates hand the pieces to their merge path, the join
+        probe streams them out as separate batches);
+      * a callable — custom re-join (the sort re-sorts the stitched
+        pieces); a device OOM inside it escalates to
+        TpuSplitAndRetryOOM like any other exhaustion.
+    """
+    if not conf_.get(OOM_RETRY_ENABLED):
+        out = attempt_fn(batch)
+        return [out] if combine == "list" else out
+    max_attempts = conf_.get(OOM_RETRY_MAX_ATTEMPTS)
+    backoff_ms = conf_.get(OOM_RETRY_BACKOFF_MS)
+    max_depth = conf_.get(OOM_RETRY_MAX_SPLIT_DEPTH)
+    total_attempts = [0]
+
+    def run(b, depth: int) -> List:
+        last: Optional[BaseException] = None
+        for attempt in range(1, max_attempts + 1):
+            total_attempts[0] += 1
+            try:
+                if _faults.enabled():
+                    _faults.check("oom", op, cap=b.capacity)
+                return [attempt_fn(b)]
+            except Exception as e:  # noqa: BLE001 - filtered below
+                if not is_device_oom(e):
+                    raise
+                last = e
+                _emit_retry(op, "retry", attempt, depth)
+                freed = _release_pressure(op, on_pressure)
+                log.warning(
+                    "device OOM in %s (attempt %d/%d, split depth %d): "
+                    "released %d B, retrying", op, attempt, max_attempts,
+                    depth, freed)
+                if backoff_ms:
+                    time.sleep(backoff_ms / 1e3 * (1 << (attempt - 1)))
+        # retries exhausted at this level: split and recurse
+        n = b.num_rows
+        if depth >= max_depth or n < 2:
+            watermark, budget, _ = _hbm_state()
+            raise TpuSplitAndRetryOOM(
+                f"device OOM in {op}: {total_attempts[0]} attempt(s) "
+                f"exhausted at split depth {depth} "
+                f"({n} row(s); watermark {watermark} B, budget "
+                f"{budget if budget is not None else 'unlimited'}) — "
+                f"last failure: {last}", op=op, watermark=watermark,
+                budget=budget, attempts=total_attempts[0],
+                split_depth=depth) from last
+        from ..columnar import split_batch
+
+        lo, hi = split_batch(b)
+        _emit_retry(op, "split", total_attempts[0], depth + 1)
+        if _events.enabled():
+            _events.emit("batch_split", op=op, depth=depth + 1, rows=n,
+                         rows_left=lo.num_rows, rows_right=hi.num_rows)
+        if _obs.enabled():
+            _obs.note_batch_split(op)
+        log.warning(
+            "split-and-retry in %s: %d rows -> %d + %d (depth %d)",
+            op, n, lo.num_rows, hi.num_rows, depth + 1)
+        return run(lo, depth + 1) + run(hi, depth + 1)
+
+    outs = run(batch, 0)
+    if combine == "list":
+        return outs
+    if len(outs) == 1:
+        return outs[0]
+    joiner = (combine if callable(combine)
+              else (lambda pieces: concat_batches(conf_, pieces)))
+    try:
+        return joiner(outs)
+    except Exception as e:  # noqa: BLE001 - filtered below
+        if not is_device_oom(e):
+            raise
+        watermark, budget, _ = _hbm_state()
+        raise TpuSplitAndRetryOOM(
+            f"device OOM in {op} while re-joining {len(outs)} split "
+            f"piece(s): {e}", op=op, watermark=watermark, budget=budget,
+            attempts=total_attempts[0]) from e
+
+
+def with_oom_retry_nosplit(op: str, fn: Callable, conf_: RapidsConf):
+    """Retry-only harness for non-splittable work (the aggregate's merge,
+    broadcast builds): spill + backoff between attempts, TpuRetryOOM on
+    exhaustion (the reference's withRetryNoSplit)."""
+    if not conf_.get(OOM_RETRY_ENABLED):
+        return fn()
+    max_attempts = conf_.get(OOM_RETRY_MAX_ATTEMPTS)
+    backoff_ms = conf_.get(OOM_RETRY_BACKOFF_MS)
+    last: Optional[BaseException] = None
+    for attempt in range(1, max_attempts + 1):
+        try:
+            if _faults.enabled():
+                _faults.check("oom", op)
+            return fn()
+        except Exception as e:  # noqa: BLE001 - filtered below
+            if not is_device_oom(e):
+                raise
+            last = e
+            _emit_retry(op, "retry", attempt, 0)
+            _release_pressure(op, None)
+            if backoff_ms:
+                time.sleep(backoff_ms / 1e3 * (1 << (attempt - 1)))
+    watermark, budget, _ = _hbm_state()
+    raise TpuRetryOOM(
+        f"device OOM in {op}: {max_attempts} attempt(s) exhausted on a "
+        f"non-splittable path (watermark {watermark} B) — last failure: "
+        f"{last}", op=op, watermark=watermark, budget=budget,
+        attempts=max_attempts) from last
+
+
+@contextlib.contextmanager
+def named_oom(op: str):
+    """Wrap raw device allocation failures OUTSIDE the retry harness
+    (scan staging, exchange staging, mesh staging) into a named
+    :class:`TpuOutOfDeviceMemory` reporting op, live watermark, derived
+    budget, and the largest spillable buffer — no more bare XLA
+    tracebacks."""
+    try:
+        yield
+    except Exception as e:  # noqa: BLE001 - filtered below
+        if isinstance(e, TpuOOMError) or not is_device_oom(e):
+            raise
+        watermark, budget, largest = _hbm_state()
+        raise TpuOutOfDeviceMemory(
+            f"device allocation failed in {op}: {e} "
+            f"(catalog watermark {watermark} B, budget "
+            f"{budget if budget is not None else 'unlimited'}, largest "
+            f"spillable {largest} B)", op=op, watermark=watermark,
+            budget=budget) from e

@@ -41,8 +41,9 @@ __all__ = [
     "Alert", "EVENT_BACKED_METRICS", "METRICS", "MetricsRegistry",
     "ObsPlane", "ProgressTracker", "Watchdog", "WatchdogRules",
     "active", "add_op_time", "enabled", "ensure_started", "inc",
-    "install", "note_compile_miss", "note_hlo_summary", "note_op_batch",
-    "note_program_cost",
+    "install", "note_batch_split", "note_compile_miss",
+    "note_hlo_summary", "note_oom_retry",
+    "note_op_batch", "note_program_cost",
     "note_query_end", "note_query_start", "observe", "plane",
     "replay_alerts",
     "set_gauge", "shutdown", "span_close", "span_open", "tracker",
@@ -89,6 +90,21 @@ def note_compile_miss(site: str) -> None:
     reg = active()
     if reg is not None:
         reg.note_compile_miss(site)
+
+
+def note_oom_retry(op: str, kind: str = "retry") -> None:
+    """Live twin of the oom_retry event (memory/retry.py): counter plus
+    the ring the watchdog's retry-storm window samples."""
+    reg = active()
+    if reg is not None:
+        reg.note_oom_retry(op, kind)
+
+
+def note_batch_split(op: str) -> None:
+    """Live twin of the batch_split event."""
+    reg = active()
+    if reg is not None:
+        reg.inc("tpu_batch_splits", 1, op=op)
 
 
 def note_program_cost(site: str, trace_s: float, compile_s: float,

@@ -157,6 +157,22 @@ METRICS: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     "tpu_hbm_reserved_bytes": (
         GAUGE, "Outstanding admission reservations (admitted peak-HBM "
         "forecasts not yet released)", ()),
+    "tpu_oom_retries": (
+        COUNTER, "OOM recovery actions by op and kind (retry = spill + "
+        "re-attempt, split = escalation to half capacity, requeue = the "
+        "serve scheduler re-admitting with an inflated forecast — "
+        "memory/retry.py; the oom_retry event's live twin). A nonzero "
+        "rate means forecasts are wrong or the budget is tight; the "
+        "watchdog's retry-storm rule alerts on a burst.", ("op", "kind")),
+    "tpu_batch_splits": (
+        COUNTER, "Split-and-retry halvings by op (the batch_split "
+        "event's live twin): each one means the op completed on "
+        "half-capacity programs instead of dying", ("op",)),
+    "tpu_shuffle_fetch_retries": (
+        COUNTER, "Network shuffle fetch transient-failure outcomes "
+        "(retry = backed off and re-fetched, failure = retries "
+        "exhausted, FetchFailedError raised — shuffle/network.py)",
+        ("outcome",)),
 }
 
 #: event type -> the live metric family that carries the same signal, so
@@ -183,6 +199,8 @@ EVENT_BACKED_METRICS: Dict[str, str] = {
     "pq_pipeline": "tpu_pq_pipeline_stages",
     "admission": "tpu_serve_admissions",
     "queue": "tpu_serve_queue",
+    "oom_retry": "tpu_oom_retries",
+    "batch_split": "tpu_batch_splits",
 }
 
 
@@ -220,6 +238,9 @@ class MetricsRegistry:
         self._span_seq = 0
         # recent compile misses (ts_ns, site) for live storm detection
         self._miss_ring: deque = deque(maxlen=4096)
+        # recent OOM recovery actions (ts_ns, op) — the retry-storm
+        # watchdog rule's window (same shape as the miss ring)
+        self._retry_ring: deque = deque(maxlen=4096)
 
     # -- writes ------------------------------------------------------------
     def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
@@ -284,6 +305,18 @@ class MetricsRegistry:
     def recent_compile_misses(self) -> List[Tuple[int, str]]:
         with self._lock:
             return list(self._miss_ring)
+
+    # -- OOM-retry ring (live retry-storm detection) -----------------------
+    def note_oom_retry(self, op: str, kind: str = "retry",
+                       ts_ns: Optional[int] = None) -> None:
+        self.inc("tpu_oom_retries", 1, op=op, kind=kind)
+        with self._lock:
+            self._retry_ring.append(
+                (ts_ns or time.perf_counter_ns(), op))
+
+    def recent_oom_retries(self) -> List[Tuple[int, str]]:
+        with self._lock:
+            return list(self._retry_ring)
 
     # -- reads -------------------------------------------------------------
     def value(self, name: str, **labels: str) -> float:

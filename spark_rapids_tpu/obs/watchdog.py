@@ -47,6 +47,7 @@ log = logging.getLogger("spark_rapids_tpu.obs")
 STALL = "stall"
 HBM_PRESSURE = "hbm_pressure"
 RECOMPILE_STORM = "recompile_storm"
+RETRY_STORM = "retry_storm"
 
 
 def _default_storm_threshold() -> int:
@@ -66,12 +67,18 @@ class WatchdogRules:
     storm_threshold: int = dataclasses.field(
         default_factory=_default_storm_threshold)
     storm_window_ns: int = 10_000 * 1_000_000
+    #: OOM-retry burst threshold (per op, inside storm_window_ns): a
+    #: storm means forecasts are systematically wrong or the budget is
+    #: too tight for the traffic — the query completes, but every batch
+    #: pays spill + backoff (+ half-capacity recompiles)
+    retry_storm_threshold: int = 8
 
     @classmethod
     def from_conf(cls, conf_) -> "WatchdogRules":
         from ..conf import (
             ANALYSIS_STORM_THRESHOLD,
             WATCHDOG_PRESSURE_FRACTION,
+            WATCHDOG_RETRY_STORM_THRESHOLD,
             WATCHDOG_STALL_MS,
             WATCHDOG_STORM_WINDOW_MS,
         )
@@ -84,6 +91,8 @@ class WatchdogRules:
             storm_threshold=conf_.get(ANALYSIS_STORM_THRESHOLD),
             storm_window_ns=int(
                 conf_.get(WATCHDOG_STORM_WINDOW_MS)) * 1_000_000,
+            retry_storm_threshold=conf_.get(
+                WATCHDOG_RETRY_STORM_THRESHOLD),
         )
 
 
@@ -107,6 +116,11 @@ class Alert:
             return (f"hbm_pressure: {self.detail} at "
                     f"{self.value / 1e6:.1f}MB, over "
                     f"{self.threshold / 1e6:.1f}MB")
+        if self.kind == RETRY_STORM:
+            return (f"retry_storm: op {self.detail} hit {self.value:g} "
+                    f"OOM recovery actions in window "
+                    f"(threshold {self.threshold:g}) — forecasts or the "
+                    "HBM budget need attention")
         return (f"recompile_storm: site {self.detail} compiled "
                 f"{self.value:g} times in window "
                 f"(threshold {self.threshold:g})")
@@ -182,6 +196,17 @@ class Watchdog:
                 found[(RECOMPILE_STORM, site)] = Alert(
                     RECOMPILE_STORM, site, n,
                     self.rules.storm_threshold, now)
+
+        # live retry storm: OOM recovery actions per op inside the window
+        per_op: Dict[str, int] = {}
+        for ts, op in self.registry.recent_oom_retries():
+            if ts >= lo:
+                per_op[op] = per_op.get(op, 0) + 1
+        for op, n in per_op.items():
+            if n >= self.rules.retry_storm_threshold:
+                found[(RETRY_STORM, op)] = Alert(
+                    RETRY_STORM, op, n,
+                    self.rules.retry_storm_threshold, now)
 
         new: List[Alert] = []
         with self._lock:
@@ -260,11 +285,16 @@ def replay_alerts(events: List[dict], rules: WatchdogRules,
       * recompile_storm  — per-site sliding window over
                            ``compile_miss`` events; one alert per
                            episode (the count must drop below the
-                           threshold before the same site alerts again).
+                           threshold before the same site alerts again);
+      * retry_storm      — the same sliding-window/episode rule over
+                           ``oom_retry`` events per op (the live rule
+                           samples the registry's retry ring).
     """
     out: List[Alert] = []
     site_win: Dict[str, deque] = {}
     site_storming: Dict[str, bool] = {}
+    retry_win: Dict[str, deque] = {}
+    retry_storming: Dict[str, bool] = {}
     pressure_active = False
     for r in events:
         ev = r.get("event")
@@ -306,4 +336,19 @@ def replay_alerts(events: List[dict], rules: WatchdogRules,
                 site_storming[site] = True
             else:
                 site_storming[site] = False
+        elif ev == "oom_retry":
+            op = r.get("op", "?")
+            win = retry_win.setdefault(op, deque())
+            win.append(ts)
+            lo = ts - rules.storm_window_ns
+            while win and win[0] < lo:
+                win.popleft()
+            if len(win) >= rules.retry_storm_threshold:
+                if not retry_storming.get(op):
+                    out.append(Alert(
+                        RETRY_STORM, op, len(win),
+                        rules.retry_storm_threshold, ts))
+                retry_storming[op] = True
+            else:
+                retry_storming[op] = False
     return out

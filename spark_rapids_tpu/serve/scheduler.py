@@ -152,6 +152,10 @@ class QueryScheduler:
         self.rejected = 0
         self.timeouts = 0
         self.bypass_admissions = 0
+        #: admitted queries that OOMed at runtime (a forecast MISS the
+        #: static plane couldn't see) and were requeued once with their
+        #: forecast inflated to the observed peak watermark
+        self.oom_requeues = 0
         #: max simultaneously-admitted queries — proof the scheduler
         #: actually overlaps work (the pipelining claim is structural)
         self.peak_active = 0
@@ -410,6 +414,33 @@ class QueryScheduler:
             t.event.wait()
         return t
 
+    def note_oom_requeue(self, session: str, digest: str,
+                         inflated_forecast: Optional[int]) -> None:
+        """Record one OOM-driven requeue (sql/session._collect_serve):
+        the admitted query failed with a typed device-OOM despite the
+        recovery plane, its reservation is already released, and it is
+        being resubmitted ONCE with its forecast inflated to the
+        observed peak watermark — forecast misses become queueing, not
+        crashes. Surfaced in stats()/'/status', the admission event
+        stream, and the oom_retry resilience events."""
+        with self._lock:
+            self.oom_requeues += 1
+        if _obs.enabled():
+            _obs.inc("tpu_serve_admissions", 1, verdict="requeue")
+            _obs.note_oom_retry(f"serve {session}", "requeue")
+        if _events.enabled():
+            _events.emit(
+                "admission", session=session, digest=digest,
+                verdict="requeue", forecast_bytes=inflated_forecast,
+                free_bytes=None,
+                reason="admitted query OOMed at runtime; requeued once "
+                       "with forecast inflated to the observed peak "
+                       "watermark")
+            _events.emit(
+                "oom_retry", op=f"serve {session}", kind="requeue",
+                attempt=1, depth=0, watermark=inflated_forecast,
+                budget=None)
+
     def _try_timeout(self, t: Ticket) -> bool:
         """Remove a still-queued ticket (timeout); False if it was
         admitted concurrently (the caller proceeds with it)."""
@@ -453,6 +484,7 @@ class QueryScheduler:
                 "admitted": self.admitted, "queued": self.queued,
                 "rejected": self.rejected, "timeouts": self.timeouts,
                 "bypass_admissions": self.bypass_admissions,
+                "oom_requeues": self.oom_requeues,
                 "peak_inflight_forecast": self.peak_inflight_forecast,
                 "peak_active": self.peak_active,
                 "active": len(self._active), "waiting": self._depth(),

@@ -208,15 +208,35 @@ class ShuffleClient:
     (reference: RapidsShuffleClient.scala:35-98 — metadata request then
     transfer; here the response carries both). Transient connection
     errors reconnect and retry the whole request (fetches are idempotent
-    reads); exhaustion raises FetchFailedError."""
+    reads) under EXPONENTIAL backoff with jitter, capped at
+    ``retry_wait_cap_s`` — the linear sleep synchronized a fleet of
+    reduce tasks into retry waves against a recovering server; jittered
+    exponential spreads them. Exhaustion raises FetchFailedError.
+    ``retry_count``/``failure_count`` feed the transport's stats() (and
+    the obs twins) so flaky peers are visible, not silent latency."""
 
     def __init__(self, address: Tuple[str, int], retries: int = 3,
-                 retry_wait_s: float = 0.2):
+                 retry_wait_s: float = 0.2,
+                 retry_wait_cap_s: float = 2.0):
         self._addr = tuple(address)
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._retries = retries
         self._retry_wait_s = retry_wait_s
+        self._retry_wait_cap_s = retry_wait_cap_s
+        #: cumulative transient-failure retries that later succeeded
+        self.retry_count = 0
+        #: cumulative fetches that exhausted retries (FetchFailedError)
+        self.failure_count = 0
+
+    def _backoff(self, attempt: int) -> float:
+        """Exponential backoff with full jitter, capped: attempt 0 waits
+        up to retry_wait_s, doubling per attempt, never above the cap."""
+        import random
+
+        span = min(self._retry_wait_cap_s,
+                   self._retry_wait_s * (1 << attempt))
+        return span * (0.5 + 0.5 * random.random())
 
     def _conn(self) -> socket.socket:
         if self._sock is None:
@@ -233,10 +253,17 @@ class ShuffleClient:
     def fetch_serialized(self, sid: int, rid: int) -> List[Tuple[int, bytes]]:
         import time as _time
 
+        from .. import faults as _faults
+        from .. import obs as _obs
+
         with self._lock:
             last: Optional[Exception] = None
             for attempt in range(self._retries):
                 try:
+                    if _faults.enabled():
+                        # injected transient fetch failure (a
+                        # ConnectionError): exercises THIS retry loop
+                        _faults.check("fetch", "network_fetch")
                     s = self._conn()
                     s.sendall(_U64x3.pack(OP_FETCH, sid, rid))
                     (n,) = _U64.unpack(_recv_exact(s, 8))
@@ -250,7 +277,15 @@ class ShuffleClient:
                     last = e
                     self._drop_conn()
                     if attempt + 1 < self._retries:
-                        _time.sleep(self._retry_wait_s * (attempt + 1))
+                        self.retry_count += 1
+                        if _obs.enabled():
+                            _obs.inc("tpu_shuffle_fetch_retries", 1,
+                                     outcome="retry")
+                        _time.sleep(self._backoff(attempt))
+            self.failure_count += 1
+            if _obs.enabled():
+                _obs.inc("tpu_shuffle_fetch_retries", 1,
+                         outcome="failure")
             raise FetchFailedError(
                 f"fetch (shuffle={sid}, reduce={rid}) from {self._addr} "
                 f"failed after {self._retries} attempts: {last}")
@@ -318,14 +353,32 @@ class NetworkShuffleTransport(SerializingTransportBase):
         else:
             raise RuntimeError("no local server and no push target")
 
+    def _all_clients(self) -> List[ShuffleClient]:
+        return self._clients + ([self._push] if self._push else [])
+
     def fetch(self, shuffle_id, reduce_id):
         raw: List[Tuple[int, bytes]] = []
+        before = sum(c.retry_count for c in self._clients)
         if self.server is not None:
             raw.extend(self.server.store.get(shuffle_id, reduce_id))
         for c in self._clients:
             raw.extend(c.fetch_serialized(shuffle_id, reduce_id))
         raw.sort(key=lambda e: e[0])
-        return self._decode_entries(raw, shuffle_id, reduce_id)
+        retries = sum(c.retry_count for c in self._clients) - before
+        return self._decode_entries(raw, shuffle_id, reduce_id,
+                                    retries=retries)
+
+    def stats(self):
+        """Base wire/codec counters plus the network-only retry story:
+        transient-failure retries paid and fetches that exhausted them
+        (surfaced as exchange metrics, obs twins, and the tpu_profile
+        shuffle-retry line)."""
+        st = super().stats()
+        st["fetch_retries"] = sum(
+            c.retry_count for c in self._all_clients())
+        st["fetch_failures"] = sum(
+            c.failure_count for c in self._all_clients())
+        return st
 
     def release(self, shuffle_id):
         if self.server is not None:

@@ -181,10 +181,13 @@ class SerializingTransportBase(ShuffleTransport):
         return data
 
     def _decode_entries(self, entries: Sequence[Tuple[int, bytes]],
-                        shuffle_id: int, reduce_id: int
+                        shuffle_id: int, reduce_id: int,
+                        retries: Optional[int] = None
                         ) -> List[ShufflePiece]:
         """map-ordered (map_id, wire bytes) -> pieces, accounting decode
-        time (incl. the device upload the decode implies) + fetched bytes."""
+        time (incl. the device upload the decode implies) + fetched bytes.
+        ``retries``: transient-failure retries this fetch paid (network
+        transport only; rides the event's optional field)."""
         from ..exec.base import vals_of_batch
         from .serializer import deserialize_batch
 
@@ -205,10 +208,11 @@ class SerializingTransportBase(ShuffleTransport):
             self._fetched += nb
             self._decode_ns += dec
         if _events.enabled():
+            extra = {} if retries is None else {"retries": retries}
             _events.emit("shuffle_fetch", shuffle_id=shuffle_id,
                          reduce_id=reduce_id, pieces=len(out),
                          rows=sum(p.n for p in out), bytes=nb,
-                         codec=self.codec)
+                         codec=self.codec, **extra)
         if _obs.enabled():
             _obs.inc("tpu_shuffle_pieces", len(out), direction="fetch",
                      codec=self.codec)

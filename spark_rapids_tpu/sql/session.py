@@ -13,6 +13,7 @@ import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from .. import events as _events  # registers the eventLog.* conf entries
+from .. import faults as _faults  # registers the test.faults.* entries
 from .. import obs as _obs
 from ..conf import RapidsConf
 from ..cpu import plan as C
@@ -276,6 +277,12 @@ class TpuSession:
         # is a no-op returning None with the confs off (the default) —
         # no registry, no threads, one boolean per emit site.
         self._obs_plane = _obs.ensure_started(self.conf)
+        # deterministic fault injector (faults.py, chaos testing): a
+        # no-op returning None with the test.faults.* confs off (the
+        # default) — nothing installed, injection sites stay one
+        # module-global boolean read. Never uninstalled implicitly;
+        # tests pair install with faults.uninstall().
+        _faults.install(self.conf)
 
     def close(self) -> None:
         """Flush/close the session's event sink (atexit also covers a
@@ -519,12 +526,52 @@ class TpuSession:
         return self._collect_serve(node)
 
     def _collect_serve(self, node: LNode) -> List[tuple]:
+        """Serve-path drain with the OOM requeue contract (ROADMAP item
+        4's failure mode): an admitted query whose runtime peak busts
+        its static forecast — and whose spill/retry/split recovery
+        (memory/retry.py) still couldn't complete it at the CURRENT
+        occupancy — releases its reservation (the finally below) and is
+        resubmitted exactly ONCE with its forecast inflated to the
+        observed peak watermark, so the scheduler queues it until that
+        much headroom is real. A second typed OOM propagates: forecast
+        misses degrade to queueing, genuine can't-fit degrades to a
+        named error, never a crash loop."""
+        from ..memory.retry import TpuOOMError
+        from ..serve import QueryScheduler
+
+        try:
+            return self._collect_serve_once(node)
+        except TpuOOMError as e:
+            from ..memory.catalog import BufferCatalog
+
+            # THIS query's observed need: the catalog watermark the
+            # typed error captured at its failure — NOT the process-
+            # lifetime peak_device_bytes, which an earlier heavy query
+            # pins forever and would inflate every later small query's
+            # requeue. Capped at the total budget so a transient OOM can
+            # never convert into a permanent ServeAdmissionRejected
+            # (acquire rejects forecasts above the budget outright).
+            observed = getattr(e, "watermark", None) or 0
+            budget, _, _ = BufferCatalog.get().admission_state()
+            if budget is not None:
+                observed = min(observed, budget)
+            QueryScheduler.get(self.conf).note_oom_requeue(
+                self.serve_id, self._last_digest or "", observed or None)
+            return self._collect_serve_once(
+                node, forecast_floor=observed or None)
+
+    def _collect_serve_once(self, node: LNode,
+                            forecast_floor: Optional[int] = None
+                            ) -> List[tuple]:
         """Submit-through-scheduler: plan on the calling thread (host
         work of a queued query overlaps the running query's device
         compute), admit against the peak-HBM forecast, host-prefetch
         scans after admission but BEFORE the device semaphore, then
         drain. The reservation releases in a finally so a failed query
-        frees its headroom."""
+        frees its headroom. ``forecast_floor``: the OOM-requeue path's
+        inflated forecast (the observed peak watermark of the failed
+        attempt) — admission then waits for headroom reality showed the
+        query needs, not what the analyzer guessed."""
         from ..serve import QueryScheduler, SharedPlanCache
         from ..serve.scheduler import SERVE_PRIORITY
 
@@ -541,6 +588,8 @@ class TpuSession:
         # admission check needs: parquet plans forecast a peak (footer-
         # derived residency) without being fully bounded
         forecast = analysis.peak_hbm if analysis is not None else None
+        if forecast_floor is not None:
+            forecast = max(forecast or 0, forecast_floor)
         try:
             # priority/timeout/depth are THIS session's settings — the
             # scheduler singleton may have been created by another one

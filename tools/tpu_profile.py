@@ -33,6 +33,12 @@ Report sections:
   * shuffle           — pieces/bytes/rows each way, per codec
   * spill timeline    — every spill/unspill with the live device-byte
                         watermark, plus the peak
+  * resilience        — OOM recovery actions (oom_retry events by
+                        op/kind: retry, split, requeue, fused-plan
+                        fallback) and split-and-retry halvings
+                        (batch_split events with max depth) — how often
+                        forecasts were wrong and what recovery cost;
+                        plus the shuffle section's fetch-retry line
   * scan cache        — hit/miss/evict counts and bytes
   * forecast vs actual— the static plan analyzer's bounds (plan_analysis
                         events) diffed against measured compile misses and
@@ -570,17 +576,35 @@ def forecast_vs_actual(queries: List[dict]) -> Tuple[List[str], int]:
             continue
         actual_sites: Dict[str, int] = defaultdict(int)
         actual_bytes: Dict[str, int] = defaultdict(int)
+        recovery = 0
         for r in q["events"]:
             if r.get("event") == "compile_miss":
                 actual_sites[r["site"]] += 1
             elif r.get("event") == "op_batch":
                 actual_bytes[r["op"]] += r.get("bytes") or 0
+            elif r.get("event") in ("oom_retry", "batch_split"):
+                recovery += 1
         forecast = an.get("site_forecast") or {}
         bounds = an.get("bytes_by_op") or {}
+        if recovery:
+            # OOM recovery degraded this query to half-capacity (or
+            # fallback-path) programs the STATIC plan never forecast:
+            # the compile bound is honestly waived — that's degradation
+            # doing its job, not emitter/analyzer drift (the resilience
+            # section reports the actions themselves)
+            lines.append(
+                f"  query {qid}: compile forecast waived — {recovery} "
+                "OOM recovery action(s) compiled degraded-capacity "
+                "programs (see == resilience ==)")
         for site in sorted(set(actual_sites) | set(forecast)):
             got, exp = actual_sites.get(site, 0), forecast.get(site, 0)
-            bad = got > exp
+            bad = got > exp and not recovery
             violations += bad
+            if recovery and got > exp:
+                lines.append(
+                    f"  query {qid} compile[{site}]: actual {got} > "
+                    f"forecast {exp} (waived: OOM recovery)")
+                continue
             lines.append(
                 f"  query {qid} compile[{site}]: actual {got} <= "
                 f"forecast {exp}" if not bad else
@@ -722,6 +746,13 @@ def build_report(events: List[dict], top_n: int = 10,
     for (ev, codec), (n, b, rows) in sorted(sh.items()):
         lines.append(f"  {ev}[{codec}]: {n} piece(s), {_mb(b)}, "
                      f"{rows} row(s)")
+    fetch_retries = sum(
+        r.get("retries") or 0 for r in events
+        if r.get("event") == "shuffle_fetch")
+    if fetch_retries:
+        lines.append(f"  fetch retries: {fetch_retries} transient "
+                     "failure(s) recovered by backoff "
+                     "(shuffle/network.py)")
 
     spills = [r for r in events if r.get("event") == "spill"]
     lines.append("== spill timeline ==")
@@ -737,6 +768,32 @@ def build_report(events: List[dict], top_n: int = 10,
                 f"{_mb(r['bytes'])} (device watermark "
                 f"{_mb(r['device_bytes'])})")
         lines.append(f"  peak device watermark: {_mb(peak)}")
+
+    # OOM recovery plane (memory/retry.py): how often forecasts were
+    # wrong and what the recovery cost — retries (spill + backoff),
+    # split-and-retry halvings (half-capacity recompiles, see the
+    # resilience markers beside the compile track in Perfetto), and
+    # serve requeues. A nonzero steady-state rate here means the HBM
+    # budget or the analyzer's forecasts need attention (the live twin
+    # is the watchdog's retry_storm alert).
+    lines.append("== resilience ==")
+    retries_by: Dict[Tuple[str, str], int] = defaultdict(int)
+    for r in events:
+        if r.get("event") == "oom_retry":
+            retries_by[(r.get("op", "?"), r.get("kind", "retry"))] += 1
+    splits_by: Dict[str, List[int]] = defaultdict(lambda: [0, 0])
+    for r in events:
+        if r.get("event") == "batch_split":
+            t = splits_by[r.get("op", "?")]
+            t[0] += 1
+            t[1] = max(t[1], r.get("depth") or 0)
+    if not retries_by and not splits_by:
+        lines.append("  none (no OOM recovery activity)")
+    for (op, kind), n in sorted(retries_by.items()):
+        lines.append(f"  {op}: {n} {kind} action(s)")
+    for op, (n, maxd) in sorted(splits_by.items()):
+        lines.append(f"  {op}: {n} batch split(s), max depth {maxd} "
+                     f"(completed at 1/{1 << maxd} capacity)")
 
     sc: Dict[str, List[int]] = defaultdict(lambda: [0, 0])
     for r in events:
