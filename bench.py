@@ -219,16 +219,11 @@ def _hlo_stats(hlo_snapshot):
     return {"hlo_top_fusion_bytes": top or None, "hlo_scatter_count": scat}
 
 
-def _agg_strategy_of(exec_):
-    """The aggregation strategy the plan's aggregate exec(s) resolved at
-    execution (conf sql.agg.strategy; exec/aggregate.resolved_strategy) —
-    None for shapes without a grouped aggregate. Emitted per shape so a
-    BENCH diff shows not just THAT a shape regressed but which lowering
-    it was running."""
+def _strategy_of(exec_, attr):
     found = []
 
     def walk(node):
-        c = getattr(node, "_strategy_choice", None)
+        c = getattr(node, attr, None)
         if c is not None:
             found.append(c[0])
         for k in getattr(node, "children", ()):
@@ -236,6 +231,24 @@ def _agg_strategy_of(exec_):
 
     walk(exec_)
     return found[0] if found else None
+
+
+def _agg_strategy_of(exec_):
+    """The aggregation strategy the plan's aggregate exec(s) resolved at
+    execution (conf sql.agg.strategy; exec/aggregate.resolved_strategy) —
+    None for shapes without a grouped aggregate. Emitted per shape so a
+    BENCH diff shows not just THAT a shape regressed but which lowering
+    it was running."""
+    return _strategy_of(exec_, "_strategy_choice")
+
+
+def _join_strategy_of(exec_):
+    """The join probe lowering the plan's join exec(s) resolved (conf
+    sql.join.strategy; exec/join.resolved_strategy) — None for shapes
+    without an equi-join. The --diff gates waive same-shape comparisons
+    when either strategy field flipped (a deliberate lowering change
+    owns its byte/temp/fusion profile)."""
+    return _strategy_of(exec_, "_join_strategy_choice")
 
 
 def _dev_stats(exec_, bytes_read, tpu_t):
@@ -256,7 +269,8 @@ def _dev_stats(exec_, bytes_read, tpu_t):
            "hbm_frac": round(gbps / HBM_GBPS, 3),
            "device_ms": round(dev_t * 1e3, 3),
            "predicted_hbm_bytes": predict_exec_hbm(exec_),
-           "agg_strategy": _agg_strategy_of(exec_)}
+           "agg_strategy": _agg_strategy_of(exec_),
+           "join_strategy": _join_strategy_of(exec_)}
     if dev_t >= 1e-4:
         dev_gbps = bytes_read / dev_t / 1e9
         out["hbm_gbps_device"] = round(dev_gbps, 1)

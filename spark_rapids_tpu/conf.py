@@ -131,13 +131,39 @@ REPLACE_SORT_MERGE_JOIN = conf(
     "Replace sort-merge joins with TPU hash joins (reference: RapidsConf.scala:476).")
 JOIN_PALLAS_PROBE = conf(
     "spark.rapids.tpu.sql.join.pallasProbe.enabled", False,
-    "Lower single-fixed-width-key hash-join probes to the hand-written "
-    "Pallas kernel (ops/pallas_join.py): each grid step compares one "
-    "probe block against one build tile entirely in VMEM — no "
-    "scatter-built direct-address table and no binary-search gather "
-    "chain. Work is O(probe x build) compares, so this wins only for "
-    "broadcast-class build sides; off by default. Off-TPU the same "
-    "kernel runs under the Pallas interpreter (the CPU CI path).")
+    "Legacy toggle (pre-round-14): lower single-fixed-width-key "
+    "hash-join probes to the hand-written Pallas kernel "
+    "(ops/pallas_join.py). Superseded by "
+    "spark.rapids.tpu.sql.join.strategy=PALLAS; when join.strategy is "
+    "AUTO, this flag still selects the PALLAS tier for the GENERAL "
+    "probe path while the DIRECT fused fast path keeps pre-empting it "
+    "where its table fits — exactly the pre-round-14 behavior. A "
+    "forced join.strategy=PALLAS disables the fast path too.")
+JOIN_STRATEGY = conf(
+    "spark.rapids.tpu.sql.join.strategy", "AUTO",
+    "Lowering strategy for equi-join probes (ops/join.py), the join "
+    "twin of sql.agg.strategy. SEARCH runs the vectorized lexicographic "
+    "binary search over the sorted build words (log2(build) gather "
+    "passes — the general fallback every other tier degrades to when "
+    "its shape preconditions fail); DIRECT builds scatter-built "
+    "direct-address (first,count) tables when the single fixed-width "
+    "key's value range fits 4x the build capacity, probing with two "
+    "gathers — and the whole join can then FUSE into its consumer "
+    "chain; RADIX co-radix-sorts build and probe rows by the shared "
+    "order-preserving key words (the sort IS the binning, exactly as "
+    "the RADIX aggregation tier) and derives every [lo,hi) match range "
+    "from segmented prefix sums over that order — zero scatter "
+    "instructions, no cap-sized table, bytes sized to the layout "
+    "bound; PALLAS runs the probe as the hand-written VMEM-tiled "
+    "jax.experimental.pallas kernel (interpret mode off-TPU). All "
+    "tiers produce bit-identical ranges and pair lists. AUTO picks per "
+    "plan from the static build layout (capacity, key widths, backend) "
+    "against the conf-declared roofline peaks "
+    "(spark.rapids.tpu.roofline.peakHbmGBps/.peakTflops) and records "
+    "its choice — with the reason — in describe()/explain_metrics() "
+    "and the event log ('join_strategy'), so a wrong prediction is "
+    "visible in tools/tpu_profile.py instead of only as wall-clock.",
+    valid_values=("AUTO", "SEARCH", "DIRECT", "RADIX", "PALLAS"))
 ENABLE_HASH_PARTIAL_AGG = conf(
     "spark.rapids.tpu.sql.hashAgg.replaceMode", "all",
     "Which aggregation modes to replace: all/partial/final.",

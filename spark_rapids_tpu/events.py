@@ -140,6 +140,10 @@ EVENT_TYPES: Dict[str, tuple] = {
     # reason — logged so tpu_profile can hold the chooser accountable
     # against the measured op spans of the SAME run
     "agg_strategy": ("op", "strategy", "reason", "cap"),
+    # per-plan join-strategy choice (exec/join.py): the AUTO chooser's
+    # probe-lowering pick (or the forced conf value) with its cost-model
+    # reason, keyed by the build side's capacity bucket
+    "join_strategy": ("op", "strategy", "reason", "build_cap"),
     # pipelined parquet decode stages (io/parquet_device.py): host chunk
     # decode, staged h2d upload, device unpack dispatch; ``dur`` is the
     # stage's host wall-clock (ns) so the overlap is visible in Perfetto
@@ -535,9 +539,9 @@ def chrome_trace(records: List[dict]) -> dict:
             out.append({"ph": "C", "pid": _PID,
                         "name": f"queue_depth {r['session']}",
                         "ts": us(ts), "args": {"depth": r["depth"]}})
-        # plan_tagged / plan_analysis / op_batch / agg_strategy carry no
-        # timeline shape; the offline profiler reads them from the JSONL
-        # log instead
+        # plan_tagged / plan_analysis / op_batch / agg_strategy /
+        # join_strategy carry no timeline shape; the offline profiler
+        # reads them from the JSONL log instead
     out.sort(key=lambda e: e["ts"])
     return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
 

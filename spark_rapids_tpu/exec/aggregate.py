@@ -68,8 +68,13 @@ _FIRST_TIER_B = 128
 #: round-1-measured win; at or above it the SCATTER dialect's byte
 #: amplification (the while-loop accumulator XLA charges per
 #: instruction — 19.4 GB vs a 772 MB bound at cap=2^24, BENCH_r09)
-#: is the dominant cost and the tiled RADIX lowering takes over
-_RADIX_CPU_MIN_CAP = 1 << 22
+#: is the dominant cost and the tiled RADIX lowering takes over.
+#: Lowered 2^22 -> 2^21 in round 14: the join and parquet bench shapes
+#: both feed a cap=2^21 aggregate whose scatter plan alone charged
+#: 2.36 GB / 1.77 GB (the bulk of those shapes' 29.8x / 15x
+#: amplification) — the byte model says the flip point sits below the
+#: old threshold, and the merge gate is bytes, not shared-box wall clock
+_RADIX_CPU_MIN_CAP = 1 << 21
 
 
 def _roofline_peaks(conf: RapidsConf, backend: str) -> Tuple[float, float]:
@@ -145,7 +150,7 @@ def choose_agg_strategy(
     if backend == "cpu":
         if cap >= _RADIX_CPU_MIN_CAP and radix_ok:
             return ("RADIX",
-                    "AUTO: CPU backend at cap>=2^22 — the scatter "
+                    "AUTO: CPU backend at cap>=2^21 — the scatter "
                     "dialect's while-loop accumulator amplifies "
                     "XLA-charged bytes ~25x past the layout bound "
                     "(BENCH_r09); the tiled radix lowering is sized to "

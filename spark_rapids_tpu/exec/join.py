@@ -64,6 +64,128 @@ from .base import (
 
 _JOIN_TYPES = ("inner", "left", "right", "full", "semi", "anti", "cross")
 
+# ---------------------------------------------------------------------------
+# Join strategy chooser (conf sql.join.strategy) — the join twin of
+# exec/aggregate.choose_agg_strategy. Reads the SAME conf-declared
+# roofline peaks the profiler's roofline report measures against, so a
+# calibrated deployment moves the chooser and the report together.
+# ---------------------------------------------------------------------------
+#: CPU-backend AUTO: below this build capacity the direct-address
+#: table's two scatters are cheap and the whole-join fusion into the
+#: consumer chain wins; at or above it the CPU scatter dialect's charged
+#: byte amplification dominates (BENCH_r10: the join shape's fused
+#: direct tables + downstream scatter agg touched 29.8x the layout
+#: bound) and the co-sorted RADIX merge takes over
+_RADIX_JOIN_CPU_MIN_BUILD = 1 << 16
+#: near-serial accelerator random-gather cost per element (the binary
+#: search's per-step price; same figure ops/join's docstrings cite)
+_GATHER_SEC_PER_ELEM = 15e-9
+
+
+def _key_word_count(key_dtypes) -> Tuple[int, bool]:
+    """(radix key words, fixed-width-only) for the chooser's static cost
+    model; strings price at their chunk granularity (~2 words/chunk)."""
+    words = 0
+    fixed = True
+    for dt in key_dtypes:
+        if isinstance(dt, (T.StringType, T.BinaryType)):
+            fixed = False
+            words += 4  # typical 16-byte chunk surface
+        else:
+            words += 2 if dt.to_numpy().itemsize == 8 else 1
+    return words, fixed
+
+
+def choose_join_strategy(
+    conf: RapidsConf,
+    build_cap: int,
+    key_dtypes,
+    join_type: str,
+    backend: "Optional[str]" = None,
+) -> "Tuple[str, str]":
+    """Pick the probe lowering for ONE join plan from its STATIC build
+    layout — build capacity bucket, key widths, backend — never from
+    data (the choice must be a trace-time constant or it would churn the
+    compile cache; the runtime fits/unique check inside the DIRECT tier
+    stays a lax.cond). Returns ``(strategy, reason)``; the reason rides
+    into describe()/explain_metrics and the 'join_strategy' event.
+
+    AUTO resolves:
+
+      * legacy sql.join.pallasProbe.enabled forces PALLAS (back compat);
+      * CPU backend -> DIRECT below _RADIX_JOIN_CPU_MIN_BUILD for
+        single fixed-width keys (two cheap scatters + consumer fusion),
+        RADIX at or above it (the scatter dialect's charged bytes
+        dominate — the r10 join shape's 29.8x amplification);
+      * otherwise the cheapest of DIRECT (near-serial scatter build +
+        two-gather probe), RADIX (bitonic co-sort passes at the derated
+        peak HBM rate) and SEARCH (log2(build) gather passes), with
+        DIRECT only priced for single fixed-width keys.
+    """
+    import math
+
+    from ..conf import JOIN_PALLAS_PROBE, JOIN_STRATEGY
+
+    mode = conf.get(JOIN_STRATEGY)
+    if mode != "AUTO":
+        return mode, "forced by spark.rapids.tpu.sql.join.strategy"
+    if conf.get(JOIN_PALLAS_PROBE):
+        return ("PALLAS",
+                "AUTO: sql.join.pallasProbe.enabled (legacy toggle) — "
+                "VMEM-tiled probe kernel")
+    if backend is None:
+        backend = jax.default_backend()
+    words, fixed = _key_word_count(key_dtypes)
+    direct_ok = fixed and 0 < words <= 2
+    if backend == "cpu":
+        if direct_ok and build_cap < _RADIX_JOIN_CPU_MIN_BUILD:
+            return ("DIRECT",
+                    "AUTO: CPU backend, single fixed-width key, build "
+                    f"cap {build_cap} < 2^16 — direct-address tables "
+                    "are two cheap scatters and the probe fuses into "
+                    "its consumer chain")
+        return ("RADIX",
+                "AUTO: CPU backend at build cap "
+                f"{build_cap} — the scatter dialect charges the "
+                "direct-address tables far past the layout bound "
+                "(BENCH_r10 join: 29.8x); the co-sorted merge is sized "
+                "to the bound")
+    from .aggregate import _HBM_DERATE, _roofline_peaks
+
+    if (direct_ok and build_cap <= (1 << 20)
+            and join_type in ("inner", "left", "semi", "anti")):
+        # the direct table probes with two gathers AND fuses the whole
+        # join into its consumer chain (one dispatch) — for the
+        # dense-dim-key case the fusion is worth more than any probe
+        # micro-cost; past ~2^20 the 4x-cap tables and their scatter
+        # build stop amortizing. Full joins can never fuse (the
+        # unmatched-build pass), so they fall to the cost comparison
+        # below instead of paying the scatter build for nothing
+        return ("DIRECT",
+                f"AUTO: single fixed-width key, build cap {build_cap} "
+                "<= 2^20 — the direct-address table probes with two "
+                "gathers and fuses into its consumer chain")
+    hbm_bps, _ = _roofline_peaks(conf, backend)
+    hbm_eff = _HBM_DERATE * hbm_bps
+    lg = max(1, math.ceil(math.log2(max(2, build_cap))))
+    key_bytes = 4 * max(1, words)
+    # probe capacity is not known at build time; a probe side at least
+    # as large as the build is the hash-join common case, so per-side
+    # costs use build_cap for both surfaces. The search's gather chain
+    # is priced at the chip's near-serial random-access gather rate —
+    # the reason the sequential-bandwidth merge exists at all
+    search_s = (2 * lg * build_cap * max(1, words)
+                * _GATHER_SEC_PER_ELEM)
+    sort_passes = lg * (lg + 1) / 2  # bitonic compare-exchange rounds
+    radix_s = (2 * build_cap * (key_bytes + 12) * sort_passes
+               + 4 * build_cap * 8) / hbm_eff
+    pick = "RADIX" if radix_s < search_s else "SEARCH"
+    return (pick,
+            f"AUTO: est radix {radix_s * 1e3:.1f}ms "
+            f"({sort_passes:.0f} passes) vs search "
+            f"{search_s * 1e3:.1f}ms ({2 * lg} gather passes) at build "
+            f"cap={build_cap}, {hbm_bps / 1e9:.0f}GB/s peak")
+
 
 def _concat_all(conf, exec_: TpuExec) -> Optional[ColumnarBatch]:
     """Materialize every partition of an exec into ONE batch (build side)."""
@@ -180,6 +302,12 @@ class TpuShuffledHashJoinExec(TpuExec):
         self._built = None  # lazy build-side state
         self._fast_built = None  # lazy direct-address build (None=untried)
         self._build_batch = None  # concatenated build input, shared by both paths
+        # join strategy (conf sql.join.strategy): resolved lazily per
+        # build capacity bucket — the choice must see the real build
+        # shape — and memoized so AUTO never flips mid-plan (same
+        # contract as the aggregate's _strategy_by_cap)
+        self._strategy_by_cap: dict = {}
+        self._join_strategy_choice: Optional[Tuple[str, str]] = None
 
     @property
     def output_schema(self):
@@ -194,7 +322,35 @@ class TpuShuffledHashJoinExec(TpuExec):
         return self._probe.num_partitions
 
     def describe(self):
-        return f"TpuShuffledHashJoinExec({self.join_type})"
+        strat = (f", strategy={self._join_strategy_choice[0]}"
+                 if self._join_strategy_choice is not None else "")
+        return f"TpuShuffledHashJoinExec({self.join_type}{strat})"
+
+    def resolved_strategy(self, build_cap: int) -> str:
+        """Resolve (and memoize per build capacity bucket) the probe
+        lowering for this plan. The choice lands in describe() — and
+        thus explain_metrics() — and emits ONE 'join_strategy' event per
+        (exec, build capacity), so tools/tpu_profile.py can hold the
+        chooser accountable against the measured op spans of the same
+        log (the agg resolved_strategy contract)."""
+        hit = self._strategy_by_cap.get(build_cap)
+        if hit is not None:
+            return hit
+        strategy, reason = choose_join_strategy(
+            self.conf, build_cap,
+            [k.dtype for k in self._build_keys], self._jt)
+        self._strategy_by_cap[build_cap] = strategy
+        self._join_strategy_choice = (strategy, reason)
+        from .. import events as _events
+        from .. import obs as _obs
+
+        if _events.enabled():
+            _events.emit("join_strategy", op=self.node_name,
+                         strategy=strategy, reason=reason,
+                         build_cap=build_cap)
+        if _obs.enabled():
+            _obs.inc("tpu_join_strategy", 1, strategy=strategy)
+        return strategy
 
     # -- build side --------------------------------------------------------
     def _key_str_lens(self, batch, keys) -> Tuple[int, ...]:
@@ -240,6 +396,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         cap = batch.capacity
         n = batch.num_rows
         sml = self._key_str_lens(batch, self._build_keys)
+        strategy = self.resolved_strategy(cap)
 
         def prep(cols, num_rows):
             live = filter_gather.live_of(num_rows, cap)
@@ -262,7 +419,7 @@ class TpuShuffledHashJoinExec(TpuExec):
             return sorted_cols, sorted_words, count, live_all
 
         fn = self._jit_cache_get(
-            ("build", batch_signature(batch), cap, sml), prep)
+            ("build", batch_signature(batch), cap, sml, strategy), prep)
         sorted_cols, sorted_words, count, live_all = fn(
             vals_of_batch(batch), count_scalar(n))
         # the build side is registered with the buffer catalog so memory
@@ -278,17 +435,30 @@ class TpuShuffledHashJoinExec(TpuExec):
             self._build_batch = None  # sorted spillable state replaces it
         return built
 
-    # -- direct-address fast path (fusable) --------------------------------
-    # When the build keys form a dense-enough range (TPC-DS dim-key case)
-    # AND are unique (or the join only needs a membership bit), the whole
-    # probe becomes a pure masked transform: one packed (first,count) table
-    # lookup + one packed build-row gather, no expansion plan, no output-
-    # size sync. The join then FUSES into the consumer chain (e.g.
-    # scan->join->aggregate is ONE XLA dispatch). Reference contract:
-    # GpuHashJoin.doJoinLeftRight (execution/GpuHashJoin.scala:265) — cudf
-    # probes a hash table; this is the TPU direct-address equivalent.
+    # -- fused fast paths (fusable) ----------------------------------------
+    # When the probe can run as a pure masked transform — no expansion
+    # plan, no output-size sync — the whole join FUSES into the consumer
+    # chain (e.g. scan->join->aggregate is ONE XLA dispatch). Two
+    # variants, picked by the resolved strategy:
+    #
+    #   * DIRECT: the build keys form a dense-enough range (TPC-DS
+    #     dim-key case) AND are unique (or the join only needs a
+    #     membership bit) — one packed (first,count) table lookup + one
+    #     packed build-row gather per probe batch;
+    #   * RADIX:  the build keys are UNIQUE (any fixed-width key set, no
+    #     density requirement; semi/anti need not even that) — the probe
+    #     co-sorts against the HBM-resident sorted build words
+    #     (ops/join.radix_probe_ranges) INSIDE the fused program, so no
+    #     scatter-built table and no cap-sized join output ever
+    #     materializes; a matched probe row gathers its single build row
+    #     at lo.
+    #
+    # Each syncs ONE feasibility word per build (fits/unique for DIRECT,
+    # unique for RADIX) — the only host round trip the fast paths take.
+    # Reference contract: GpuHashJoin.doJoinLeftRight
+    # (execution/GpuHashJoin.scala:265) — cudf probes a hash table.
 
-    def _fast_static_ok(self) -> bool:
+    def _fast_static_ok(self, strategy: str = "DIRECT") -> bool:
         if self.partitioned or self._jt not in ("inner", "left", "semi", "anti"):
             return False
         words = 0
@@ -296,8 +466,10 @@ class TpuShuffledHashJoinExec(TpuExec):
             if isinstance(k.dtype, (T.StringType, T.BinaryType)):
                 return False
             words += 2 if k.dtype.to_numpy().itemsize == 8 else 1
-        if words > 2 or len(self._build_keys) == 0:
+        if len(self._build_keys) == 0:
             return False
+        if strategy == "DIRECT" and words > 2:
+            return False  # the packed table key is one u64
         if self._jt in ("inner", "left"):
             # appended build columns gather as one packed matrix: fixed,
             # packable dtypes only (f64 has no lossless 32-bit split)
@@ -311,15 +483,40 @@ class TpuShuffledHashJoinExec(TpuExec):
         return True
 
     def _try_fast_build(self):
-        """Build the direct-address table once; returns the fast state dict
-        or False. Syncs ONE (fits, unique) pair per build — the only host
-        round trip the fast path ever takes."""
+        """Build the fused fast-path state once (see the section comment);
+        returns the state dict or False."""
         if self._fast_built is not None:
             return self._fast_built
-        if not self._fast_static_ok():
+        if not self._fast_static_ok("ANY"):
             self._fast_built = False
             return False
         batch = self._concat_build()
+        strategy = self.resolved_strategy(batch.capacity)
+        if strategy == "RADIX":
+            # no RADIX-specific static precondition beyond the common
+            # "ANY" gate above (any fixed-width key set qualifies)
+            self._fast_built = self._radix_fast_build(batch)
+            return self._fast_built
+        from ..conf import JOIN_PALLAS_PROBE, JOIN_STRATEGY
+
+        legacy_pallas = (strategy == "PALLAS"
+                         and self.conf.get(JOIN_STRATEGY) == "AUTO"
+                         and self.conf.get(JOIN_PALLAS_PROBE))
+        if strategy == "DIRECT" or legacy_pallas:
+            # the fused whole-join fast path. The legacy pallasProbe
+            # toggle only ever governed the GENERAL probe path — the
+            # direct fast path pre-empted it before the strategy conf
+            # existed, so under AUTO it still does (the conf's
+            # keep-their-behavior contract); a forced
+            # sql.join.strategy=PALLAS does disable it
+            if not self._fast_static_ok("DIRECT"):
+                self._fast_built = False
+                return False
+        else:
+            # SEARCH / forced PALLAS (and infeasible shapes) probe
+            # through the general per-batch path
+            self._fast_built = False
+            return False
         bcap = batch.capacity
         tbl = 4 * bcap
         need_mat = self._jt in ("inner", "left")
@@ -353,7 +550,8 @@ class TpuShuffledHashJoinExec(TpuExec):
             return outs
 
         fn = self._jit_cache_get(
-            ("fastbuild", batch_signature(batch), bcap, need_mat), prep)
+            ("fastbuild", batch_signature(batch), bcap, need_mat,
+             "DIRECT"), prep)
         res = fn(vals_of_batch(batch), count_scalar(batch.num_rows_lazy))
         packed_tbl, kmin, fits, unique = res[:4]
         from .base import host_pull
@@ -369,6 +567,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         if need_mat:
             arrays["mat"] = res[4]
         state = {
+            "kind": "direct",
             "handle": SpillableHandle(arrays, ACTIVE_BATCHING_PRIORITY),
             "has_mat": need_mat,
         }
@@ -380,6 +579,69 @@ class TpuShuffledHashJoinExec(TpuExec):
         # the raw concatenated batch is no longer needed: only the
         # spill-registered table/matrix state survives (holding both would
         # pin two copies of the build side in HBM)
+        self._build_batch = None
+        return state
+
+    def _radix_fast_build(self, batch):
+        """RADIX fused-probe state: the sorted build key words (+ packed
+        build-column matrix for inner/left). Inner/left require UNIQUE
+        build keys — a probe row then owns at most one output row and
+        the join stays a pure masked transform; semi/anti only need the
+        membership bit and take any build. Syncs ONE unique flag."""
+        bcap = batch.capacity
+        need_mat = self._jt in ("inner", "left")
+        kd = [k.dtype for k in self._build_keys]
+
+        def prep(cols, num_rows):
+            live = filter_gather.live_of(num_rows, bcap)
+            keys = [lower(k, cols, bcap) for k in self._build_keys]
+            words, any_null = join_ops.radix_key_words(keys, kd, ())
+            ok = live & ~any_null
+            perm, _ = sort_with_radix_keys(
+                keys, kd, [SortOrder(True, True) for _ in keys], ok, ())
+            sorted_words = [jnp.take(w, perm, mode="clip") for w in words]
+            count = jnp.sum(ok.astype(jnp.int32))
+            # unique = no adjacent equal keys among the joinable prefix
+            idx = jnp.arange(bcap, dtype=jnp.int32)
+            inner_pos = (idx >= 1) & (idx < count)
+            same = inner_pos
+            for w in sorted_words:
+                same = same & (w == jnp.concatenate([w[:1], w[:-1]]))
+            unique = ~jnp.any(same)
+            outs = (sorted_words, count, unique)
+            if need_mat:
+                from ..ops.filter_gather import pack_fixed_cols
+
+                live_all = jnp.take(live, perm, mode="clip")
+                sorted_cols = filter_gather.gather(cols, perm, live_all)
+                outs = outs + (pack_fixed_cols(list(sorted_cols)),)
+            return outs
+
+        fn = self._jit_cache_get(
+            ("fastbuild", batch_signature(batch), bcap, need_mat,
+             "RADIX"), prep)
+        res = fn(vals_of_batch(batch), count_scalar(batch.num_rows_lazy))
+        sorted_words, count, unique = res[:3]
+        from .base import host_pull
+
+        if need_mat and not bool(host_pull(unique)):
+            return False  # duplicate build keys: general RADIX path
+        from ..memory import ACTIVE_BATCHING_PRIORITY
+        from ..memory.catalog import SpillableHandle
+
+        arrays = {f"w{i}": w for i, w in enumerate(sorted_words)}
+        arrays["count"] = count
+        if need_mat:
+            arrays["mat"] = res[3]
+        state = {
+            "kind": "radix",
+            "handle": SpillableHandle(arrays, ACTIVE_BATCHING_PRIORITY),
+            "has_mat": need_mat,
+            "nwords": len(sorted_words),
+        }
+        if need_mat:
+            state["dtypes"] = tuple(
+                c.data.dtype for c in vals_of_batch(batch))
         self._build_batch = None
         return state
 
@@ -397,7 +659,7 @@ class TpuShuffledHashJoinExec(TpuExec):
     def fusion_key(self):
         st = self._fast_built if isinstance(self._fast_built, dict) else {}
         return (
-            "join_fast", self._jt, self._swap,
+            "join_fast", st.get("kind", "direct"), self._jt, self._swap,
             tuple(repr(k) for k in self._probe_keys), repr(self._cond),
             tuple(str(dt) for dt in st.get("dtypes", ())),
         )
@@ -406,7 +668,11 @@ class TpuShuffledHashJoinExec(TpuExec):
         st = self._try_fast_build()
         assert isinstance(st, dict)
         a = st["handle"].materialize()
-        out = (a["tbl"], a["kmin"])
+        if st["kind"] == "radix":
+            out = tuple(a[f"w{i}"] for i in range(st["nwords"]))
+            out = out + (a["count"],)
+        else:
+            out = (a["tbl"], a["kmin"])
         if st["has_mat"]:
             out = out + (a["mat"],)
         return out
@@ -414,21 +680,36 @@ class TpuShuffledHashJoinExec(TpuExec):
     def lower_batch(self, cols, live, cap, side=()):
         from ..expr.values import DictV as _DictV, as_plain_str
 
-        packed_tbl, kmin = side[0], side[1]
-        tbl = packed_tbl.shape[0]
+        st = self._fast_built
         keys = [lower(k, cols, cap) for k in self._probe_keys]
         # dict-encoded probe keys expand to bytes for the radix words;
         # non-key dict columns stream through encoded (mask-only path)
         keys = [as_plain_str(v) if isinstance(v, _DictV) else v for v in keys]
         words, any_null = join_ops.radix_key_words(
             keys, [k.dtype for k in self._probe_keys], ())
-        key64 = join_ops._pack_u64(words)
         ok = live & ~any_null
-        diffu = key64 - kmin
-        pin = ok & (key64 >= kmin) & (diffu < jnp.uint64(tbl))
-        pc = jnp.where(pin, diffu, jnp.uint64(0)).astype(jnp.int32)
-        fc = jnp.take(packed_tbl, pc, axis=0, mode="clip")
-        matched = pin & (fc[:, 1] > 0)
+        if st["kind"] == "radix":
+            # co-sorted merge against the HBM-resident sorted build
+            # words, INSIDE the fused program: zero scatters, no table
+            nw = st["nwords"]
+            bwords = list(side[:nw])
+            lo, hi, _ = join_ops.radix_probe_ranges(
+                bwords, side[nw].astype(jnp.int32), words, ok,
+                lo_matched_only=True)
+            matched = ok & (hi > lo)
+            brow = jnp.where(matched, lo, 0)
+            mat_idx = nw + 1
+        else:
+            packed_tbl, kmin = side[0], side[1]
+            tbl = packed_tbl.shape[0]
+            key64 = join_ops._pack_u64(words)
+            diffu = key64 - kmin
+            pin = ok & (key64 >= kmin) & (diffu < jnp.uint64(tbl))
+            pc = jnp.where(pin, diffu, jnp.uint64(0)).astype(jnp.int32)
+            fc = jnp.take(packed_tbl, pc, axis=0, mode="clip")
+            matched = pin & (fc[:, 1] > 0)
+            brow = jnp.where(matched, fc[:, 0], 0)
+            mat_idx = 2
         jt = self._jt
         if jt == "semi":
             return list(cols), live & matched
@@ -436,10 +717,8 @@ class TpuShuffledHashJoinExec(TpuExec):
             return list(cols), live & ~matched
         from ..ops.filter_gather import unpack_fixed_cols
 
-        st = self._fast_built
-        brow = jnp.where(matched, fc[:, 0], 0)
         bvals = unpack_fixed_cols(
-            jnp.take(side[2], brow, axis=0, mode="clip"),
+            jnp.take(side[mat_idx], brow, axis=0, mode="clip"),
             list(st["dtypes"]), matched)
         out = (
             list(bvals) + list(cols) if self._swap
@@ -507,45 +786,51 @@ class TpuShuffledHashJoinExec(TpuExec):
         cap = pbatch.capacity if pbatch.columns else 128
         psml = self._key_str_lens(pbatch, self._probe_keys)
         jt = self._jt
+        strategy = self.resolved_strategy(build_cap)
+        # full outer under RADIX derives the matched-build mask from the
+        # SAME co-sorted merge (scatter-free); other tiers keep the
+        # eager range-delta mask (one scatter pair)
+        radix_matched = self.join_type == "full" and strategy == "RADIX"
 
         # build words/count enter as jit ARGUMENTS (not closure constants):
         # with per-partition builds the same compiled probe must serve every
         # partition's build data
-        from ..conf import JOIN_PALLAS_PROBE
-
-        pallas_probe = self.conf.get(JOIN_PALLAS_PROBE)
-
         def count_phase(cols, num_rows, bwords, bcount):
             live = filter_gather.live_of(num_rows, cap)
             keys = [lower(k, cols, cap) for k in self._probe_keys]
             words, any_null = join_ops.radix_key_words(
                 keys, [k.dtype for k in self._probe_keys], psml)
             ok = live & ~any_null
-            lo, hi = join_ops.probe_ranges(
-                bwords, bcount.astype(jnp.int32), words, ok,
-                pallas=pallas_probe)
+            matched_b = None
+            if radix_matched:
+                lo, hi, matched_b = join_ops.radix_probe_ranges(
+                    bwords, bcount.astype(jnp.int32), words, ok,
+                    want_matched=True)
+            else:
+                lo, hi = join_ops.probe_ranges(
+                    bwords, bcount.astype(jnp.int32), words, ok,
+                    strategy=strategy)
             counts = hi - lo
             if jt in ("semi", "anti"):
                 keep = (counts > 0) if jt == "semi" else (live & (counts == 0))
                 if jt == "semi":
                     keep = keep & ok
-                return lo, counts, keep, live
+                return lo, counts, keep, live, matched_b
             if jt in ("left", "full"):
                 ex_counts = jnp.where(live & (counts == 0), 1, counts)
                 ex_counts = jnp.where(live, ex_counts, 0)
             else:  # inner probe side
                 ex_counts = jnp.where(live, counts, 0)
-            return lo, counts, ex_counts, live
+            return lo, counts, ex_counts, live, matched_b
 
         ckey = ("count", batch_signature(pbatch), cap, psml, build_cap,
-                len(build_words), pallas_probe)
+                len(build_words), strategy)
         fn = self._jit_cache_get(ckey, count_phase)
-        lo, counts, aux, live = fn(
+        lo, counts, aux, live, matched = fn(
             vals_of_batch(pbatch), count_scalar(pbatch.num_rows_lazy),
             list(build_words), jnp.int32(build_count))
 
-        matched = None
-        if self.join_type == "full":
+        if self.join_type == "full" and matched is None:
             matched = join_ops.matched_build_mask(lo, lo + counts, live, build_cap)
 
         if jt in ("semi", "anti"):
@@ -558,12 +843,17 @@ class TpuShuffledHashJoinExec(TpuExec):
             return None, matched
         out_cap = choose_capacity(total, self.conf.shape_bucket_min)
 
+        # the RADIX tier expands scatter-free (prefix-sum searchsorted);
+        # other tiers keep the two-repeat plan (scatter+cumsum under the
+        # hood, ~20x faster than the search on TPU)
+        expand_plan = (join_ops.radix_expansion_plan
+                       if strategy == "RADIX" else join_ops.expansion_plan)
         has_strings = any(isinstance(c, StrV) for c in build_cols) or any(
             c.is_string for c in pbatch.columns)
         if has_strings:
             # string outputs need host-synced byte capacities; keep the
             # original eager path for those
-            p, build_row, slot_live = join_ops.expansion_plan(aux, lo, out_cap)
+            p, build_row, slot_live = expand_plan(aux, lo, out_cap)
             pad_slot = slot_live & (jnp.take(counts, p, mode="clip") == 0)
             build_live = slot_live & ~pad_slot
 
@@ -588,8 +878,7 @@ class TpuShuffledHashJoinExec(TpuExec):
             # gathers) is ONE jitted program — eager per-op dispatch over
             # out_cap-sized arrays dominated join wallclock otherwise
             def expand_phase(pvals, bcols, lo_, counts_, aux_):
-                p, build_row, slot_live = join_ops.expansion_plan(
-                    aux_, lo_, out_cap)
+                p, build_row, slot_live = expand_plan(aux_, lo_, out_cap)
                 pad_slot = slot_live & (
                     jnp.take(counts_, p, mode="clip") == 0)
                 build_live = slot_live & ~pad_slot
@@ -600,7 +889,8 @@ class TpuShuffledHashJoinExec(TpuExec):
 
             ekey = ("expand", batch_signature(pbatch), out_cap,
                     len(build_cols),
-                    tuple(int(c.data.shape[0]) for c in build_cols))
+                    tuple(int(c.data.shape[0]) for c in build_cols),
+                    strategy)
             fne = self._jit_cache_get(ekey, expand_phase)
             probe_side, build_side = fne(
                 vals_of_batch(pbatch), list(build_cols), lo, counts, aux)

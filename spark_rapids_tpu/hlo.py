@@ -367,6 +367,25 @@ def _dus_update_sizes(mod: Module, ins: Instr) -> List[Optional[int]]:
 _PALLAS_TARGETS = ("tpu_custom_call", "mosaic", "pallas", "triton")
 
 
+def _feeds_iota(mod: Module, ins: Instr) -> bool:
+    """True when an operand (looking through one tuple/fusion hop — the
+    CPU while-lowering feeds its carry as one tuple) is an iota — the
+    signature of a ROW-INDEX update stream, which data scatters never
+    have."""
+    for op in ins.operands:
+        ref = mod.by_name.get(op)
+        if ref is None:
+            continue
+        if ref.opcode == "iota":
+            return True
+        if ref.opcode in ("tuple", "fusion"):
+            for op2 in ref.operands:
+                r2 = mod.by_name.get(op2)
+                if r2 is not None and r2.opcode == "iota":
+                    return True
+    return False
+
+
 def classify(mod: Module, ins: Instr) -> str:
     """Idiom name for one top-level instruction (priority order: the
     expensive amplifiers first, so a fusion that both scatters and
@@ -380,6 +399,14 @@ def classify(mod: Module, ins: Instr) -> str:
         # VMEM; it must never read as the scatter it replaced
         return "pallas"
     if "scatter" in bag:
+        if "minimum" in bag and _feeds_iota(mod, ins):
+            # a scatter-MIN whose update stream is an IOTA: the
+            # direct-address join-table build writing each key's FIRST
+            # build row (exec/join DIRECT tier) — its own class, so a
+            # deliberately chosen DIRECT join doesn't read as the
+            # scatter-add aggregation idiom (summarize_hlo pairs the
+            # count table with it by shape)
+            return "join-table"
         return "scatter-add" if "add" in bag else "scatter"
     if "dynamic-update-slice" in bag and ins.opcode in (
             "fusion", "while", "conditional"):
@@ -393,6 +420,8 @@ def classify(mod: Module, ins: Instr) -> str:
             return "radix-bin"
         # the CPU dialect's scatter lowering: a while/fusion updating
         # one slice per step against a full-size accumulator
+        if "minimum" in bag and _feeds_iota(mod, ins):
+            return "join-table"  # the while-lowered first-table build
         return "scatter-add" if "add" in bag else "scatter"
     if bag & _COLLECTIVES:
         return "collective"
@@ -501,6 +530,7 @@ def summarize_hlo(text: str, top_k: int = 5) -> Dict[str, Any]:
     ok = 0
     total_bytes = 0
     flops = 0.0
+    out_elems_by_name: Dict[str, int] = {}
     for ins in entry:
         if ins.ok:
             resolved = all(op in mod.by_name for op in ins.operands)
@@ -512,6 +542,60 @@ def summarize_hlo(text: str, top_k: int = 5) -> Dict[str, Any]:
             rows.append({"name": ins.name, "op": ins.opcode,
                          "class": classify(mod, ins), "bytes": int(b),
                          "out_bytes": int(out_b)})
+            out_elems_by_name[ins.name] = ins.out_elems
+    # the direct-address join-table build is a PAIR of scatters: the
+    # first-table scatter-min (classified join-table above, by its iota
+    # update) plus the count table's scatter-add over the SAME table
+    # shape AND the same scatter-index stream — pair the count scatter
+    # with it so a deliberately chosen DIRECT join contributes zero to
+    # scatter_count (the appearance gate's business is aggregation
+    # amplifiers sneaking back in). The shared-operand requirement keeps
+    # an UNRELATED same-sized aggregation scatter in the count: equal
+    # element counts alone collide across power-of-two caps.
+    jt_rows = [r for r in rows if r["class"] == "join-table"]
+    if jt_rows:
+        ins_by_name = {i.name: i for i in entry}
+
+        def _feed_names(name: str) -> set:
+            """The operand names that identify a scatter's DESTINATION
+            stream. For a true ``scatter`` opcode that is exactly the
+            indices operand (operand 1) — identical indices mean the
+            same table addresses, the pairing signal. For the CPU
+            while/fusion lowering (indices ride inside the carry
+            tuple), one hop through tuple/fusion minus the
+            trivially-shared producers — parameters INCLUDED in the
+            exclusions here, so a fused join+agg program whose agg
+            scatter merely reads the same key column cannot pair."""
+            trivial = ("constant", "broadcast", "iota")
+            out: set = set()
+            ins = ins_by_name.get(name)
+            if ins is None:
+                return out
+            if ins.opcode == "scatter":
+                if len(ins.operands) > 1:
+                    out.add(ins.operands[1])
+                return out
+            for op in ins.operands:
+                ref = mod.by_name.get(op)
+                if ref is None:
+                    continue
+                if ref.opcode in ("tuple", "fusion"):
+                    out.update(ref.operands)
+                if ref.opcode not in trivial:
+                    out.add(op)
+            return {o for o in out
+                    if mod.by_name.get(o) is not None
+                    and mod.by_name[o].opcode not in trivial
+                    + ("parameter",)}
+
+        for jt in jt_rows:
+            jt_feeds = _feed_names(jt["name"])
+            jt_n = out_elems_by_name.get(jt["name"])
+            for r in rows:
+                if (r["class"] == "scatter-add"
+                        and out_elems_by_name.get(r["name"]) == jt_n
+                        and jt_feeds & _feed_names(r["name"])):
+                    r["class"] = "join-table"
     # scatter programs are THE amplifier the roadmap hunts: count every
     # entry-level row the classifier binned as one (a while-lowered
     # scatter is one scatter, not its dozens of body instructions)

@@ -529,6 +529,20 @@ def _load_dictionary(plan: ChunkPlan, raw: bytes, count: int) -> None:
 # ---------------------------------------------------------------------------
 # device decode (XLA kernels)
 # ---------------------------------------------------------------------------
+#: stream the fixed-width unpack (bit-expand -> dictionary gather ->
+#: validity expand) through one tiled fori_loop instead of materializing
+#: full-width intermediate planes (the cap-sized widened-codes and
+#: present->row index planes). Module-level because plan_decode has no
+#: session conf in scope; tests flip it to diff the flat path.
+TILED_UNPACK = True
+#: below this output capacity the flat program's intermediates are noise
+#: and the loop only costs dispatch overhead
+TILED_UNPACK_MIN_CAP = 1 << 16
+#: test hook: force the unpack tile row count (0 = derive); rounded up
+#: to a multiple of 32 so validity-word slices stay aligned
+FORCE_UNPACK_TILE_ROWS = 0
+
+
 def unpack_bit_words(words, out_cap: int):
     """bits[j] = bit j of the LSB-first u32 word stream — pure reshape/
     elementwise, ZERO gathers (TPU gathers cost ~15ns/elem)."""
@@ -544,6 +558,78 @@ def unpack_bit_words(words, out_cap: int):
     shifts = jnp.arange(32, dtype=jnp.uint32)
     bits = ((w[:, None] >> shifts[None, :]) & jnp.uint32(1)) != 0
     return bits.reshape(need_w * 32)[:out_cap]
+
+
+def _unpack_tile_rows(cap: int) -> int:
+    if FORCE_UNPACK_TILE_ROWS:
+        return -(-FORCE_UNPACK_TILE_ROWS // 32) * 32
+    from ..ops.radix_bin import default_tile_rows
+
+    # the loop body's working set is ~3 tile-sized planes; reuse the
+    # radix-bin sizing rule (fast-memory-resident tiles, 2^12..2^16).
+    # Rounded up to a multiple of 32 so validity-word slices align —
+    # default_tile_rows' own results are powers of two >= 2^12, but a
+    # test driving radix_bin.FORCE_TILE_ROWS (the AGG tiling hook) can
+    # leak a non-multiple through it
+    return -(-max(32, default_tile_rows(cap, 3)) // 32) * 32
+
+
+def tiled_fixed_unpack(vwords, out_dt, n: int, cap: int, has_def: bool,
+                       take_codes):
+    """The streamed fixed-width unpack: ONE ``lax.fori_loop`` walks the
+    output in validity-word-aligned tiles; each trip bit-expands its
+    slice of the packed validity words, derives the present->row index
+    stream IN the tile (a carried present-count + tile-local prefix
+    sum), gathers the narrow codes/values straight from their
+    HBM-resident upload arrays, and writes (data, validity) through a
+    sliding dynamic-update-slice window — the radix-bin loop pattern
+    (ops/radix_bin.py). No cap-sized widened-code plane, no cap-sized
+    cumsum plane, no full-width bit matrix.
+
+    ``take_codes(vidx_tile, valid_tile)`` maps the tile's present-value
+    indices to output values of dtype ``out_dt`` (dictionary gather, or
+    a gather into the bitcast PLAIN value array)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    tile = min(_unpack_tile_rows(cap), -(-cap // 32) * 32)
+    trips = -(-cap // tile)
+    wpad = -(-(trips * tile) // 32)
+    if has_def:
+        w = vwords
+        if w.shape[0] < wpad:
+            w = jnp.concatenate(
+                [w, jnp.zeros(wpad - w.shape[0], jnp.uint32)])
+        else:
+            w = w[:wpad]
+    row_ids = jnp.arange(tile, dtype=jnp.int32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+
+    def body(t, carry):
+        nseen, data_buf, valid_buf = carry
+        start = t * tile
+        in_n = (start + row_ids) < n
+        if has_def:
+            ws = lax.dynamic_slice(w, (start // 32,), (tile // 32,))
+            bits = ((ws[:, None] >> shifts[None, :]) & jnp.uint32(1)) != 0
+            valid_t = bits.reshape(tile) & in_n
+            vidx_t = nseen + jnp.cumsum(valid_t.astype(jnp.int32)) - 1
+        else:
+            valid_t = in_n
+            vidx_t = start + row_ids
+        data_t = take_codes(jnp.clip(vidx_t, 0, None), valid_t)
+        data_t = jnp.where(valid_t, data_t, jnp.zeros((), out_dt))
+        data_buf = lax.dynamic_update_slice(data_buf, data_t, (start,))
+        valid_buf = lax.dynamic_update_slice(valid_buf, valid_t, (start,))
+        return ((nseen + jnp.sum(valid_t.astype(jnp.int32))).astype(
+                    jnp.int32),
+                data_buf, valid_buf)
+
+    init = (jnp.int32(0),
+            jnp.zeros(trips * tile, out_dt),
+            jnp.zeros(trips * tile, jnp.bool_))
+    _, data, validity = lax.fori_loop(0, trips, body, init)
+    return data[:cap], validity[:cap]
 
 
 def _pack_validity_words(validity: np.ndarray) -> np.ndarray:
@@ -608,9 +694,16 @@ def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int,
         return [], ("pqdec0", str(dt), cap), run_empty
 
     keep_dict = bool(dict_strings) and is_str and is_dict
+    # streamed fixed-width unpack (tiled_fixed_unpack): bit-expand ->
+    # dictionary gather -> validity expand fuse into one fori_loop over
+    # output tiles, so no full-width intermediate plane (widened codes,
+    # present->row cumsum, bit matrix) ever materializes
+    tiled = (TILED_UNPACK and not is_str
+             and (cap >= TILED_UNPACK_MIN_CAP or FORCE_UNPACK_TILE_ROWS))
     args: List[Any] = []
     key: List[Any] = ["pqdec", plan.phys, str(dtype_tpu), cap, n, has_def,
-                      is_dict, keep_dict]
+                      is_dict, keep_dict,
+                      ("tile", _unpack_tile_rows(cap)) if tiled else False]
 
     if has_def:
         vwords = _pack_validity_words(plan.validity)
@@ -653,6 +746,47 @@ def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int,
         key.append(int(words.shape[0]))
 
     phys = plan.phys
+
+    def run_tiled(arglist):
+        """Streamed fixed-width unpack (see `tiled` above)."""
+        ai = 0
+        vwords = None
+        if has_def:
+            vwords = arglist[ai]
+            ai += 1
+        if is_dict:
+            codes_n = arglist[ai]  # narrowest dtype, gathered per tile
+            dvals_ = arglist[ai + 1]
+            D_ = dvals_.shape[0]
+
+            def take_codes(vidx_t, valid_t):
+                ct = jnp.take(codes_n, jnp.clip(
+                    vidx_t, 0, codes_n.shape[0] - 1), mode="clip")
+                return jnp.take(dvals_, jnp.clip(
+                    ct.astype(jnp.int32), 0, D_ - 1), mode="clip")
+
+            out_dt = dvals_.dtype
+        else:
+            words_ = arglist[ai]
+            # the bitcast view of the uploaded payload is the INPUT
+            # surface itself, not an amplified plane — tiles gather
+            # straight from it
+            if phys in ("INT32", "FLOAT"):
+                arr = jax.lax.bitcast_convert_type(words_, _PHYS_NP[phys])
+            else:  # INT64
+                from ..ops.filter_gather import _join64
+
+                lo = jax.lax.bitcast_convert_type(words_[0::2], jnp.int32)
+                hi = jax.lax.bitcast_convert_type(words_[1::2], jnp.int32)
+                arr = _join64(lo, hi, jnp.int64)
+
+            def take_codes(vidx_t, valid_t):
+                return jnp.take(arr, jnp.clip(
+                    vidx_t, 0, arr.shape[0] - 1), mode="clip")
+
+            out_dt = arr.dtype
+        return tiled_fixed_unpack(vwords, out_dt, n, cap, has_def,
+                                  take_codes)
 
     def run(arglist):
             ai = 0
@@ -721,7 +855,7 @@ def plan_decode(plan: ChunkPlan, dtype_tpu, cap: int,
             arr = jnp.where(validity, arr, jnp.zeros((), arr.dtype))
             return arr, validity
 
-    return args, tuple(key), run
+    return args, tuple(key), (run_tiled if tiled else run)
 
 
 def stage_decode_args(per_col_args: Sequence[Sequence[np.ndarray]]):

@@ -134,6 +134,10 @@ METRICS: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     "tpu_agg_strategy": (
         COUNTER, "Aggregation lowering choices by resolved strategy "
         "(MATMUL/SCATTER/SORT — conf sql.agg.strategy)", ("strategy",)),
+    "tpu_join_strategy": (
+        COUNTER, "Join probe lowering choices by resolved strategy "
+        "(SEARCH/DIRECT/RADIX/PALLAS — conf sql.join.strategy; the "
+        "join_strategy event's live twin)", ("strategy",)),
     "tpu_pq_pipeline_stages": (
         COUNTER, "Pipelined parquet decode stages completed "
         "(decode/upload/unpack)", ("stage",)),
@@ -196,6 +200,7 @@ EVENT_BACKED_METRICS: Dict[str, str] = {
     "scan_cache": "tpu_scan_cache_ops",
     "alert": "tpu_watchdog_alerts",
     "agg_strategy": "tpu_agg_strategy",
+    "join_strategy": "tpu_join_strategy",
     "pq_pipeline": "tpu_pq_pipeline_stages",
     "admission": "tpu_serve_admissions",
     "queue": "tpu_serve_queue",

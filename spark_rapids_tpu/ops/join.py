@@ -1,15 +1,36 @@
-"""Equi-join kernels: sorted build side + vectorized binary search +
-static-shape pair expansion.
+"""Equi-join kernels: the tiered probe lowerings behind
+``spark.rapids.tpu.sql.join.strategy`` (exec/join.choose_join_strategy).
 
 Reference analog: the cudf join family called from GpuHashJoin.doJoinLeftRight
 (execution/GpuHashJoin.scala:265) — innerJoin/leftJoin/leftSemi/leftAnti/
 fullOuter hash joins. cudf probes a GPU hash table; on TPU the build side is
-radix-sorted once and every probe row finds its match range [lo, hi) with a
-vectorized lexicographic binary search (log2(build) steps, pure VPU math, no
-scatter/gather in the hot loop). The pair expansion computes, for output
-slot j, its (probe row, match ordinal) with a searchsorted over the count
-prefix sums — all static shapes; only the total match count syncs to pick
-the output capacity bucket (cudf syncs for output sizes at the same spot).
+radix-sorted once and every probe batch finds its match range [lo, hi)
+through one of four lowerings, all bit-identical:
+
+  * SEARCH — vectorized lexicographic binary search over the sorted build
+    words (log2(build) gather passes, the general fallback);
+  * DIRECT — scatter-built direct-address (first, count) tables when the
+    build keys' value range fits 4x the build capacity (the TPC-DS
+    dense-dim-key case); probing is two gathers and the whole join can
+    fuse into its consumer chain (exec/join fast path);
+  * RADIX — :func:`radix_probe_ranges`: build and probe rows co-sort by
+    the SAME order-preserving radix words the build sort already uses
+    (the sort IS the binning, exactly as ops/radix_bin.py bins rows for
+    the RADIX aggregation tier), and every [lo, hi) falls out of
+    segmented prefix sums over the co-sorted order — zero scatter
+    instructions, no cap-sized table, no log2(build) gather chain. The
+    r10 cost plane showed the join shape touching 29.8x its layout
+    bound; the sorted-merge planes are O(build + probe) words, i.e. the
+    bound itself;
+  * PALLAS — the hand-written VMEM-tiled kernel (ops/pallas_join.py) for
+    broadcast-class single-key builds.
+
+The pair expansion computes, for output slot j, its (probe row, match
+ordinal); the default lowering is two jnp.repeat passes (scatter+cumsum
+under the hood), the RADIX tier uses :func:`radix_expansion_plan`
+(prefix-sum searchsorted — scatter-free) instead. All static shapes;
+only the total match count syncs to pick the output capacity bucket
+(cudf syncs for output sizes at the same spot).
 
 Null join keys never match (SQL equi-join); NaN matches NaN (Spark).
 """
@@ -64,6 +85,29 @@ def radix_key_words(
     return words, any_null
 
 
+def pad_key_words(
+    build_words: Sequence[jax.Array],
+    probe_words: Sequence[jax.Array],
+) -> Tuple[List[jax.Array], List[jax.Array]]:
+    """Zero-pad the shorter side's word list to the longer count.
+
+    String keys derive their chunk-word count from each SIDE's OWN max
+    byte length bucket (exec/join._key_str_lens), so build and probe
+    word counts legitimately differ. Every string on the shorter-
+    bucketed side fits inside its bucket, so its chunks BEYOND the
+    bucket are exactly zero — appending zero words reconstructs the
+    true encoding at the longer width (joins compare ascending, so no
+    order flip ever touches the padding). Comparing only the common
+    prefix instead would falsely match keys that differ past it."""
+    bw = list(build_words)
+    pw = list(probe_words)
+    while len(bw) < len(pw):
+        bw.append(jnp.zeros(bw[0].shape[0], jnp.uint32))
+    while len(pw) < len(bw):
+        pw.append(jnp.zeros(pw[0].shape[0], jnp.uint32))
+    return bw, pw
+
+
 def _lex_less(a_words, b_words, i, j):
     """a[i] < b[j] lexicographically over word arrays (broadcast-safe)."""
     lt = jnp.zeros(jnp.broadcast_shapes(i.shape, j.shape), jnp.bool_)
@@ -88,25 +132,43 @@ def probe_ranges(
     probe_words: Sequence[jax.Array],
     probe_live: jax.Array,
     pallas: bool = False,
+    strategy: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """[lo, hi) of build matches per probe row.
+    """[lo, hi) of build matches per probe row, per the lowering tier.
 
-    Fast path (single key, i.e. <=2 radix words): a DIRECT-ADDRESS table —
+    ``strategy`` (trace-time static, from exec/join.resolved_strategy) is
+    one of SEARCH / DIRECT / RADIX / PALLAS; ``None`` keeps the legacy
+    resolution: the ``pallas`` flag (conf sql.join.pallasProbe.enabled)
+    or the DIRECT tier. All tiers return bit-identical ranges.
+
+    DIRECT (single key, i.e. <=2 radix words): a direct-address table —
     when the build keys' value range fits a 4x-build-capacity table (the
     TPC-DS dense-dim-key case), per-key (first, count) tables are built
-    with two scatters and probing is two gathers. The general path is the
-    vectorized binary search, whose log2(build) gather passes are ~20x
-    slower on TPU. A lax.cond picks at runtime; only the taken branch
-    executes. ``pallas`` (conf sql.join.pallasProbe.enabled, trace-time
-    static) lowers single-key probes to the VMEM-tiled Pallas kernel
-    instead (ops/pallas_join.py) — no scatter-built table, no gather
-    chain."""
-    if pallas and len(build_words) <= 2 and len(probe_words) <= 2:
+    with two scatters and probing is two gathers. Its general fallback is
+    the vectorized binary search (SEARCH), whose log2(build) gather
+    passes are ~20x slower on TPU. A lax.cond picks at runtime; only the
+    taken branch executes. PALLAS lowers single-key probes to the
+    VMEM-tiled kernel (ops/pallas_join.py) — no scatter-built table, no
+    gather chain. RADIX is the co-sorted merge
+    (:func:`radix_probe_ranges`) — zero scatters at any key width."""
+    if strategy is None:
+        strategy = "PALLAS" if pallas else "DIRECT"
+    build_words, probe_words = pad_key_words(build_words, probe_words)
+    if strategy == "RADIX":
+        lo, hi, _ = radix_probe_ranges(
+            build_words, build_count, probe_words, probe_live)
+        return lo, hi
+    if strategy == "SEARCH":
+        return _probe_binary_search(
+            build_words, build_count, probe_words, probe_live)
+    if (strategy == "PALLAS" and len(build_words) <= 2
+            and len(probe_words) <= 2):
         from .pallas_join import pallas_probe_ranges
 
         return pallas_probe_ranges(
             build_words, build_count, probe_words, probe_live)
-    if len(build_words) <= 2 and len(probe_words) <= 2:
+    if (strategy == "DIRECT" and len(build_words) <= 2
+            and len(probe_words) <= 2):
         nb = build_words[0].shape[0]
         tbl = 4 * nb
         bkey = _pack_u64(build_words)
@@ -149,6 +211,7 @@ def _probe_binary_search(
 ) -> Tuple[jax.Array, jax.Array]:
     """General path: vectorized lexicographic binary search over the
     radix-sorted build words (build rows sorted live-first)."""
+    build_words, probe_words = pad_key_words(build_words, probe_words)
     m = probe_words[0].shape[0]
     nb = build_words[0].shape[0]
     steps = max(1, (nb).bit_length())
@@ -179,6 +242,165 @@ def _probe_binary_search(
     first = jnp.where(probe_live, first, 0)
     last = jnp.where(probe_live, last, 0)
     return first, jnp.maximum(first, last)
+
+
+# ---------------------------------------------------------------------------
+# RADIX tier: co-sorted merge over the radix-binned build+probe order
+# ---------------------------------------------------------------------------
+def radix_probe_ranges(
+    build_words: Sequence[jax.Array],
+    build_count: jax.Array,
+    probe_words: Sequence[jax.Array],
+    probe_live: jax.Array,
+    want_matched: bool = False,
+    lo_matched_only: bool = False,
+) -> Tuple[jax.Array, jax.Array, Optional[jax.Array]]:
+    """[lo, hi) per probe row by ONE merge over the co-radix-sorted
+    build+probe order — the RADIX join tier.
+
+    The build side arrives already radix-sorted (joinable rows first,
+    ``[0, build_count)``); probe rows carry the SAME order-preserving u32
+    key words. One stable sort over the union (u64-packed key words;
+    build rows concatenated first, so builds precede probes of an equal
+    key by stability) makes every equal-key run contiguous, and the
+    ranges fall out of segmented prefix sums over that order
+    (ops/radix_bin.py's boundary-flag pattern, here over the whole plane
+    instead of a tile window):
+
+      * ``hi``  = running count of joinable build rows at the probe's
+        position (builds of its run all precede it);
+      * ``lo``  = that running count at the probe's RUN START, broadcast
+        by a cumulative max over the boundary-flagged exclusive counts
+        (:func:`radix_bin.segment_start_broadcast`);
+      * ``matched`` (full outer, ``want_matched``) = a reverse segmented
+        OR of live-probe presence: a build row matched iff a live probe
+        follows it inside its run.
+
+    A second sort by original slot restores row order (the scatter-free
+    inverse permutation). No scatter instruction, no direct-address
+    table, no log2(build) gather chain — every plane is
+    O(build_cap + probe_cap) words, which IS the probe's layout bound.
+    Bit-identical to :func:`_probe_binary_search` for every tier of
+    torture input (null keys never match upstream via ``probe_live``;
+    NaN==NaN and -0.0==0.0 are properties of the shared radix words).
+    """
+    from .radix_bin import segment_start_broadcast
+
+    build_words, probe_words = pad_key_words(build_words, probe_words)
+    nb = build_words[0].shape[0]
+    m = probe_words[0].shape[0]
+    n = nb + m
+    bidx = jnp.arange(nb, dtype=jnp.int32)
+    # key words pack in u64 PAIRS (half the key columns the comparator
+    # walks). No side key and no park rank: the sort is STABLE and
+    # build rows precede probe rows in the concatenation, so within an
+    # equal-key run every build row lands before every probe row for
+    # free — and dead/null rows may land wherever their garbage words
+    # fall (their flags exclude them from every count and the caller
+    # masks their outputs)
+    packed: List[jax.Array] = []
+    for i in range(0, len(build_words), 2):
+        hi_w = jnp.concatenate([
+            build_words[i].astype(jnp.uint64),
+            probe_words[i].astype(jnp.uint64)]) << 32
+        if i + 1 < len(build_words):
+            hi_w = hi_w | jnp.concatenate([
+                build_words[i + 1].astype(jnp.uint64),
+                probe_words[i + 1].astype(jnp.uint64)])
+        packed.append(hi_w)
+    # original slot: build rows keep their build index, probe rows park
+    # after them — doubling as the is-build discriminator (joinable
+    # build rows are exactly slots < build_count: the build sort puts
+    # them first) and as the unsort key
+    slot = jnp.concatenate([bidx, nb + jnp.arange(m, dtype=jnp.int32)])
+    sorted_all = lax.sort(packed + [slot], num_keys=len(packed),
+                          is_stable=True)
+    s_words = sorted_all[:len(packed)]
+    s_slot = sorted_all[len(packed)]
+    is_build = s_slot < build_count.astype(jnp.int32)
+    # run boundaries: position 0, or any key word differing from the
+    # previous row's
+    pos = jnp.arange(n, dtype=jnp.int32)
+    f = pos == 0
+    for w in s_words:
+        prev = jnp.concatenate([w[:1], w[:-1]])
+        f = f | (w != prev)
+    c_incl = jnp.cumsum(is_build.astype(jnp.int32))
+    c_excl = c_incl - is_build.astype(jnp.int32)
+    # lo = running build count at the run START (builds in earlier runs
+    # = builds with a smaller key = the binary search's 'first');
+    # hi = the running count AT the probe's own position — every build
+    # of its run already precedes it (stability + concat order), so
+    # this is the binary search's 'last' (builds with key <= probe key)
+    lo_s = segment_start_broadcast(f, c_excl)
+    hi_s = c_incl
+    matched_s = None
+    if want_matched:
+        # reverse segmented suffix-OR of live-probe presence: a build
+        # row matched iff a live probe follows it inside its run (all
+        # of the run's probes DO follow it — stability again). Pack
+        # (run id from the end, probe flag) so one cumulative max over
+        # the REVERSED order is that suffix-OR
+        seg = jnp.cumsum(f.astype(jnp.int32))
+        h = (seg[-1] - seg).astype(jnp.int64)
+        is_probe_live = (s_slot >= nb) & jnp.take(
+            probe_live, jnp.clip(s_slot - nb, 0, m - 1), mode="clip")
+        packed_m = h * 2 + is_probe_live.astype(jnp.int64)
+        rmax = jnp.flip(lax.cummax(jnp.flip(packed_m)))
+        matched_s = is_build & ((rmax & 1) == 1) & (rmax // 2 == h)
+    # unsort: one sort by original slot (builds land at [0, nb), probes
+    # at [nb, nb+m)) — the scatter-free inverse permutation; slots are
+    # unique, so stability is again irrelevant
+    if lo_matched_only:
+        # fused-probe variant (exec/join.lower_batch): the caller only
+        # consumes (lo, matched) for its single-build-row gather, so lo
+        # and the matched bit pack into ONE unsort payload — a third of
+        # the payload bytes
+        # NOTE: the returned hi is lo + the MATCH BIT (not the true run
+        # end) — callers on this path either need only membership
+        # (semi/anti) or have a uniqueness guarantee (inner/left)
+        packed_lm = (lo_s << 1) | (hi_s > lo_s).astype(jnp.int32)
+        back = lax.sort([s_slot, packed_lm], num_keys=1, is_stable=False)
+        plm = back[1][nb:]
+        lo = jnp.where(probe_live, plm >> 1, 0)
+        matched = probe_live & ((plm & 1) == 1)
+        return lo, jnp.where(matched, lo + 1, lo), None
+    outs = [s_slot, lo_s, hi_s]
+    if want_matched:
+        outs.append(matched_s.astype(jnp.int32))
+    back = lax.sort(outs, num_keys=1, is_stable=False)
+    lo = back[1][nb:]
+    hi = back[2][nb:]
+    # unmatched live rows report their insertion point (lo == hi), the
+    # exact value the binary search returns — bit-identity holds on the
+    # whole (lo, hi) surface, not just matched rows
+    lo = jnp.where(probe_live, lo, 0)
+    hi = jnp.where(probe_live, hi, 0)
+    matched = (back[3][:nb] > 0) if want_matched else None
+    return lo, jnp.maximum(lo, hi), matched
+
+
+def radix_expansion_plan(
+    counts: jax.Array, lo: jax.Array, out_cap: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Scatter-free :func:`expansion_plan` for the RADIX tier: the probe
+    row of output slot j is a searchsorted over the count prefix sums
+    (log2(probe) compare/gather passes — vs jnp.repeat's scatter+cumsum,
+    which would put the one scatter family right back into the zero-
+    scatter tier). Same (probe_row, build_row, slot_live) contract and
+    the same output order: probe rows ascending, match ordinals ascending
+    within a probe row."""
+    counts = counts.astype(jnp.int32)
+    csum = jnp.cumsum(counts)
+    total = csum[-1]
+    starts = csum - counts
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    p = jnp.searchsorted(csum, j, side="right").astype(jnp.int32)
+    pc = jnp.clip(p, 0, counts.shape[0] - 1)
+    ordinal = j - jnp.take(starts, pc, mode="clip")
+    build_row = jnp.take(lo, pc, mode="clip").astype(jnp.int32) + ordinal
+    slot_live = j < total
+    return pc, jnp.where(slot_live, build_row, 0), slot_live
 
 
 def expansion_plan(

@@ -1,4 +1,6 @@
-"""Pallas hash-join probe kernel (conf sql.join.pallasProbe.enabled).
+"""Pallas hash-join probe kernel — the PALLAS tier of
+``spark.rapids.tpu.sql.join.strategy`` (the legacy
+``sql.join.pallasProbe.enabled`` toggle still forces it under AUTO).
 
 The general probe is a vectorized binary search over the sorted build
 words — log2(build) gather passes, each at HBM-random-access speed, and
@@ -9,9 +11,9 @@ u32 words): each grid step holds one (probe-block x build-tile) equality
 mask in VMEM, reduces it to per-probe (first match, match count) there,
 and accumulates across build tiles — the mask never exists in HBM and
 no gather chain is emitted. Work is O(probe x build) compares, which
-beats the search only while the build side is VMEM-tile small; the conf
-keeps it opt-in and :func:`ops.join.probe_ranges` falls back to the
-search for multi-word keys.
+beats the search only while the build side is VMEM-tile small; the
+strategy conf keeps it forced-only and :func:`ops.join.probe_ranges`
+falls back to the search for multi-word keys.
 
 Build rows [0, build_count) are the sorted JOINABLE rows (exec/join
 sorts null-key and dead rows past the count), so equal keys are

@@ -465,6 +465,23 @@ def tiled_segment_groupby(
 
 
 # ---------------------------------------------------------------------------
+# shared segmented-scan helpers (the RADIX join tier's co-sorted merge in
+# ops/join.py reuses the same boundary-flag machinery this module's tile
+# loop is built from)
+# ---------------------------------------------------------------------------
+def segment_start_broadcast(flags: jax.Array,
+                            values: jax.Array) -> jax.Array:
+    """Broadcast ``values`` at segment-start positions (``flags``) to
+    every later row of the segment, via one cumulative max — valid
+    whenever the flagged values are NONDECREASING across segment starts
+    (true for any prefix-sum-derived stream over a sorted order, e.g.
+    the join merge's running build counts). Rows before the first flag
+    report -1."""
+    marked = jnp.where(flags, values.astype(jnp.int32), -1)
+    return lax.cummax(marked)
+
+
+# ---------------------------------------------------------------------------
 # tile-local stream pieces (used by the groupby plan builder's closures)
 # ---------------------------------------------------------------------------
 def float_sum_streams(data, consider):

@@ -610,12 +610,44 @@ class _Analyzer:
             notes.append(
                 f"{node.join_type} join: output shapes depend on match "
                 "counts (not statically bounded)")
+            self._note_join_strategy(node, kids, notes)
         self.exact_all = False
         return _Result(
             parts=None, layout=layout,
             report=OpReport(node.node_name, "", layout, None, {}, False,
                             notes, [k.report for k in kids]),
             exact=False)
+
+    def _note_join_strategy(self, node: C.CpuJoinExec,
+                            kids: List["_Result"],
+                            notes: List[str]) -> None:
+        """Forecast the join probe lowering by calling the RUNTIME's own
+        chooser (exec/join.choose_join_strategy) over the statically
+        known build capacity — the agg-strategy-note contract: a wrong
+        forecast surfaces as a mismatch against the 'join_strategy'
+        event, never as silent drift. AUTO with no static build shape
+        (file scans, exchanges below the build side) must not guess."""
+        from ..conf import JOIN_STRATEGY
+        from ..exec.join import choose_join_strategy
+
+        swap = node.join_type == "right"
+        build_kid = kids[0] if swap else kids[1]
+        build_keys = node._bl if swap else node._br
+        jt = "left" if swap else node.join_type
+        build_cap = None
+        if build_kid.parts is not None:
+            rows = sum(b.rows or 0 for p in build_kid.parts for b in p)
+            build_cap = self._bucket(max(1, rows))
+        if build_cap is None and self.conf.get(JOIN_STRATEGY) == "AUTO":
+            notes.append(
+                "join strategy: AUTO — resolved per build capacity at "
+                "run time (build side not statically bounded); see the "
+                "'join_strategy' event for the actual choice")
+            return
+        strat, reason = choose_join_strategy(
+            self.conf, build_cap if build_cap is not None else 128,
+            [k.dtype for k in build_keys], jt)
+        notes.append(f"join strategy: {strat} — {reason}")
 
     def _join_layout(self, node: C.CpuJoinExec,
                      kids: List[_Result]) -> List[ColState]:
@@ -732,61 +764,29 @@ class _Analyzer:
 
     def _model_parquet_scan(self, node, schema: StructType,
                             notes: List[str]) -> None:
-        import pyarrow.parquet as pq
-
         from ..conf import PARQUET_PIPELINE_MAX_IN_FLIGHT
 
-        scanner = node.scanner
-        file_cols = set(getattr(scanner, "columns", ()) or ())
-        pcols = set(getattr(scanner, "partition_cols", ()) or ())
-        wanted = file_cols - pcols
-        fixed_row = 0
-        has_strings = False
-        for f in schema.fields:
-            if f.name in pcols or (wanted and f.name not in wanted):
-                continue
-            if isinstance(f.dataType, (T.StringType, T.BinaryType)):
-                fixed_row += 5  # offsets+validity; chars pool added below
-                has_strings = True
-            else:
-                fixed_row += _storage_bytes(f.dataType) + 1
-        decoded = 0
-        max_upload = 0
-        nrg = 0
-        pfs: Dict[str, object] = {}
-        for s in scanner.splits():
-            pf = pfs.get(s.path)
-            if pf is None:
-                pf = pfs[s.path] = pq.ParquetFile(s.path)
-            md = pf.metadata
-            for rg in s.row_groups:
-                rgmd = md.row_group(rg)
-                nrg += 1
-                upload = 0
-                chars = 0
-                for ci in range(rgmd.num_columns):
-                    col = rgmd.column(ci)
-                    if wanted and col.path_in_schema not in wanted:
-                        continue
-                    upload += int(col.total_uncompressed_size)
-                    if has_strings and col.physical_type == "BYTE_ARRAY":
-                        chars += int(col.total_uncompressed_size)
-                cap = self._bucket(max(1, rgmd.num_rows))
-                self.max_cap = max(self.max_cap, cap)
-                decoded += cap * fixed_row + chars
-                max_upload = max(max_upload, upload)
-        if not nrg:
+        fp = parquet_scan_footprint(node.scanner, schema)
+        if fp is None:
             return
+        for cap in fp["caps"]:
+            self.max_cap = max(self.max_cap, cap)
+        decoded, max_upload = fp["decoded"], fp["max_upload"]
         window = 2 * max_upload  # double-buffered staged transfers
         mif = self.conf.get(PARQUET_PIPELINE_MAX_IN_FLIGHT)
         self.scan_resident += decoded
         self._note_working(window)
         notes.append(
-            f"pipelined device decode: {nrg} row group(s), decoded "
+            f"pipelined device decode: {fp['nrg']} row group(s), decoded "
             f"batches ~{_pretty_bytes(decoded)} resident (scan cache), "
             f"double-buffered upload window <= {_pretty_bytes(window)} "
             f"device, host staging <= "
             f"{_pretty_bytes(mif * max_upload)} (maxInFlight={mif})")
+        notes.append(
+            "unpack layout bound: uploaded payloads "
+            f"<= {_pretty_bytes(fp['upload_total'])} + decoded planes "
+            f"{_pretty_bytes(decoded)} — the denominator of the parquet "
+            "shape's byte_amplification (bench.py)")
 
     def _range(self, node: C.CpuRangeExec) -> _Result:
         schema = node.output_schema
@@ -1443,12 +1443,92 @@ def analysis_enabled(conf: RapidsConf) -> bool:
     return conf.get(ANALYSIS_ENABLED)
 
 
+def parquet_scan_footprint(scanner, schema: StructType) -> Optional[dict]:
+    """Footer-derived layout bound of a parquet scan's device-decode
+    (unpack) site, shared by the analyzer's ``_model_parquet_scan`` and
+    :func:`predict_exec_hbm` (one implementation, so the explain() note
+    and the bench denominator can never drift):
+
+      * ``decoded``      — every selected row group's capacity bucket x
+        schema row width (+ string chunk pools at uncompressed size),
+        the planes the unpack programs must WRITE (and the scan cache
+        pins resident);
+      * ``upload_total`` — the encoded payloads the unpack programs must
+        READ (sum of selected chunks' uncompressed bytes);
+      * ``max_upload``/``nrg``/``caps`` — the pipelined reader's
+        double-buffer sizing inputs.
+
+    Returns None when the footers are unreadable (missing files, exotic
+    formats) — consumers degrade to "no bound" rather than fake one; a
+    genuine programming error still raises (the analyzer's call site
+    keeps its own never-fail-a-query blanket, bench's does not)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ..utils.bucketing import bucket_rows
+
+    try:
+        file_cols = set(getattr(scanner, "columns", ()) or ())
+        pcols = set(getattr(scanner, "partition_cols", ()) or ())
+        wanted = file_cols - pcols
+        fixed_row = 0
+        has_strings = False
+        for f in schema.fields:
+            if f.name in pcols or (wanted and f.name not in wanted):
+                continue
+            if isinstance(f.dataType, (T.StringType, T.BinaryType)):
+                fixed_row += 5  # offsets+validity; chars pool added below
+                has_strings = True
+            else:
+                fixed_row += _storage_bytes(f.dataType) + 1
+        decoded = 0
+        upload_total = 0
+        max_upload = 0
+        nrg = 0
+        caps: List[int] = []
+        pfs: Dict[str, object] = {}
+        for s in scanner.splits():
+            pf = pfs.get(s.path)
+            if pf is None:
+                pf = pfs[s.path] = pq.ParquetFile(s.path)
+            md = pf.metadata
+            for rg in s.row_groups:
+                rgmd = md.row_group(rg)
+                nrg += 1
+                upload = 0
+                chars = 0
+                for ci in range(rgmd.num_columns):
+                    col = rgmd.column(ci)
+                    if wanted and col.path_in_schema not in wanted:
+                        continue
+                    upload += int(col.total_uncompressed_size)
+                    if has_strings and col.physical_type == "BYTE_ARRAY":
+                        chars += int(col.total_uncompressed_size)
+                cap = bucket_rows(max(1, rgmd.num_rows))
+                caps.append(cap)
+                decoded += cap * fixed_row + chars
+                upload_total += upload
+                max_upload = max(max_upload, upload)
+    except (OSError, ValueError, KeyError, pa.lib.ArrowException):
+        return None  # missing files, exotic footers: no bound
+    if not nrg:
+        return None
+    return {"decoded": decoded, "upload_total": upload_total,
+            "max_upload": max_upload, "nrg": nrg, "caps": caps}
+
+
 def predict_exec_hbm(exec_) -> Optional[int]:
     """Forecast the HBM bytes a LIVE TpuExec tree will touch: resident
     source batches plus each operator's output-layout bound. Used by
     bench.py to emit predicted_hbm_bytes next to the measured roofline
-    (BENCH tracks forecast accuracy across rounds)."""
+    (BENCH tracks forecast accuracy across rounds).
+
+    Parquet file scans bound through :func:`parquet_scan_footprint`
+    (uploaded payloads + decoded planes — the unpack site's layout
+    bound), so the parquet shape's byte_amplification is no longer null
+    and the --diff amplification-growth gate actually binds there."""
     from ..exec.base import TpuExec, batch_bytes
+    from ..exec.scan import TpuFileSourceScanExec
 
     if not isinstance(exec_, TpuExec):
         return None
@@ -1461,6 +1541,14 @@ def predict_exec_hbm(exec_) -> Optional[int]:
             for p in parts:
                 for b in p:
                     total += batch_bytes(b)
+            return True
+        if isinstance(node, TpuFileSourceScanExec):
+            if getattr(node, "fmt", None) != "parquet":
+                return False
+            fp = parquet_scan_footprint(node.scanner, node.output_schema)
+            if fp is None:
+                return False
+            total += fp["upload_total"] + fp["decoded"]
             return True
         ok = True
         for c in node.children:

@@ -54,6 +54,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "cpu_only: needs the multi-device virtual CPU mesh; "
         "skipped when SRTPU_TEST_TPU=1 runs the suite on the real chip")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 budgeted run "
+        "(-m 'not slow'); dedicated CI jobs run these files unfiltered")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -627,8 +627,8 @@ def test_diff_bench_gates_hlo_fields():
         shape(1 << 20, 3, strategy="SCATTER"), threshold=0.2)
     assert n == 0, text
     # ... and its fusion-map delta: the radix loop compiles as ONE big
-    # fusion, so a flip's top-fusion growth is owned too (total bytes
-    # stay gated by byte_amplification)
+    # fusion, so a flip's top-fusion growth is owned too (the committed
+    # rounds' absolute amplification levels are pinned in CI instead)
     text, n = tpu_profile.diff_bench(
         shape(1 << 20, 2, strategy="SCATTER"),
         shape(10 << 20, 0, strategy="RADIX"), threshold=0.2)
@@ -668,6 +668,20 @@ def test_diff_bench_gates_byte_amplification():
     text, n = tpu_profile.diff_bench(
         shape(), shape(byte_amplification=9.9), threshold=0.2)
     assert n == 0, text
+    # a deliberate lowering flip (agg OR join strategy) owns its
+    # amplification — AUTO resolves different tiers at different
+    # scales, so a scale-mismatched smoke must not false-fire; the
+    # committed absolute levels are pinned by the events CI job
+    text, n = tpu_profile.diff_bench(
+        shape(byte_amplification=9.8, agg_strategy="RADIX"),
+        shape(byte_amplification=31.0, agg_strategy="SCATTER"),
+        threshold=0.2)
+    assert n == 0 and "agg.agg_strategy: RADIX -> SCATTER" in text, text
+    text, n = tpu_profile.diff_bench(
+        shape(byte_amplification=9.8, join_strategy="RADIX"),
+        shape(byte_amplification=31.0, join_strategy="DIRECT"),
+        threshold=0.2)
+    assert n == 0 and "agg.join_strategy: RADIX -> DIRECT" in text, text
     # and bench.py's own helper is the same ratio (shared definition)
     import importlib.util
 
@@ -788,3 +802,90 @@ def test_conf_top_k_controls_summary_width():
     assert sums
     assert all(len(r["top_fusions"]) <= 1 for r in sums)
     hlo._TOP_K = None  # don't leak the narrowed width into later tests
+
+
+# ---------------------------------------------------------------------------
+# 9. direct-address join-table idiom (round 14): its own class
+# ---------------------------------------------------------------------------
+def test_join_table_build_classified_distinct_from_scatter():
+    """The DIRECT join tier builds its (first, count) tables with a
+    scatter-MIN of an IOTA (row indices) plus a scatter-ADD of ones over
+    the same table shape. Both must classify 'join-table' — a
+    deliberately chosen DIRECT join is not the scatter-add aggregation
+    amplifier, and must contribute ZERO to scatter_count (the --diff
+    appearance gate's subject)."""
+    text = """\
+HloModule jit_fastbuild
+
+%min_s32 (a: s32[], b: s32[]) -> s32[] {
+  %a = s32[] parameter(0)
+  %b = s32[] parameter(1)
+  ROOT %m = s32[] minimum(s32[] %a, s32[] %b)
+}
+
+%add_s32 (a2: s32[], b2: s32[]) -> s32[] {
+  %a2 = s32[] parameter(0)
+  %b2 = s32[] parameter(1)
+  ROOT %s = s32[] add(s32[] %a2, s32[] %b2)
+}
+
+ENTRY %main (off: s64[4096,1], finit: s32[16384], cinit: s32[16384], ones: s32[4096]) -> (s32[16384], s32[16384]) {
+  %off = s64[4096,1]{1,0} parameter(0)
+  %finit = s32[16384]{0} parameter(1)
+  %cinit = s32[16384]{0} parameter(2)
+  %ones = s32[4096]{0} parameter(3)
+  %bidx = s32[4096]{0} iota(), iota_dimension=0
+  %first = s32[16384]{0} scatter(s32[16384]{0} %finit, s64[4096,1]{1,0} %off, s32[4096]{0} %bidx), update_window_dims={}, inserted_window_dims={0}, scatter_dims_to_operand_dims={0}, index_vector_dim=1, to_apply=%min_s32
+  %cnt = s32[16384]{0} scatter(s32[16384]{0} %cinit, s64[4096,1]{1,0} %off, s32[4096]{0} %ones), update_window_dims={}, inserted_window_dims={0}, scatter_dims_to_operand_dims={0}, index_vector_dim=1, to_apply=%add_s32
+  ROOT %out = (s32[16384]{0}, s32[16384]{0}) tuple(s32[16384]{0} %first, s32[16384]{0} %cnt)
+}
+"""
+    s = hlo.summarize_hlo(text)
+    assert s["coverage"] == 1.0
+    by_name = {r["name"]: r for r in s["top_fusions"]}
+    assert by_name["first"]["class"] == "join-table", by_name
+    assert by_name["cnt"]["class"] == "join-table", by_name
+    assert s["scatter_count"] == 0, s["top_fusions"]
+
+
+def test_compiled_direct_join_build_classifies_join_table():
+    """The REAL compiled direct-address build (this backend's dialect —
+    on CPU a pair of while/DUS loops) must classify join-table end to
+    end, and a min+count scatter AGGREGATION over data values must NOT
+    (the iota update stream is the discriminator)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def build_tables(key64, ok):
+        nb = key64.shape[0]
+        tbl = 4 * nb
+        kmin = jnp.min(jnp.where(ok, key64, jnp.uint64(2 ** 64 - 1)))
+        diffu = key64 - kmin
+        off = jnp.where(ok & (diffu < jnp.uint64(tbl)), diffu,
+                        jnp.uint64(tbl)).astype(jnp.int64)
+        bidx = jnp.arange(nb, dtype=jnp.int32)
+        first = jnp.full(tbl, nb, jnp.int32).at[off].min(bidx, mode="drop")
+        cnt = jnp.zeros(tbl, jnp.int32).at[off].add(1, mode="drop")
+        return first, cnt
+
+    k = jnp.asarray(np.arange(2048, dtype=np.uint64))
+    ok = jnp.ones(2048, bool)
+    c = jax.jit(build_tables).lower(k, ok).compile()
+    s = hlo.summarize_hlo(c.as_text(), top_k=16)
+    assert s["scatter_count"] == 0, s["top_fusions"]
+    assert any(r["class"] == "join-table" for r in s["top_fusions"])
+
+    def agg_scatters(seg, vals):
+        B = 128
+        mn = jnp.full(B, 2 ** 31 - 1, jnp.int32).at[seg].min(
+            vals, mode="drop")
+        cnt = jnp.zeros(B, jnp.int32).at[seg].add(1, mode="drop")
+        return mn, cnt
+
+    seg = jnp.asarray((np.arange(2048) % 128).astype(np.int32))
+    vals = jnp.asarray((np.arange(2048) * 7 % 999).astype(np.int32))
+    c2 = jax.jit(agg_scatters).lower(seg, vals).compile()
+    s2 = hlo.summarize_hlo(c2.as_text(), top_k=16)
+    assert s2["scatter_count"] == 2, s2["top_fusions"]
+    assert not any(r["class"] == "join-table" for r in s2["top_fusions"])

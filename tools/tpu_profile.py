@@ -823,6 +823,21 @@ def build_report(events: List[dict], top_n: int = 10,
         times = f" x{n}" if n > 1 else ""
         lines.append(f"  {op}[cap={cap}]: {s}{times} — {reason}")
 
+    # join strategy choices (one 'join_strategy' event per exec per
+    # BUILD capacity): the probe-lowering twin of the section above
+    jstrat: Dict[Tuple[str, str, int], Tuple[int, str]] = {}
+    for r in events:
+        if r.get("event") == "join_strategy":
+            k = (r.get("op"), r.get("strategy"), r.get("build_cap"))
+            n, _ = jstrat.get(k, (0, ""))
+            jstrat[k] = (n + 1, r.get("reason", ""))
+    lines.append("== join strategy ==")
+    if not jstrat:
+        lines.append("  none recorded (no equi-joins ran)")
+    for (op, s, cap), (n, reason) in sorted(jstrat.items()):
+        times = f" x{n}" if n > 1 else ""
+        lines.append(f"  {op}[build_cap={cap}]: {s}{times} — {reason}")
+
     # pipelined parquet decode stages: per-stage totals; overlapping
     # decode/upload spans are visible in the Perfetto export
     pipe: Dict[str, List[int]] = defaultdict(lambda: [0, 0, 0])
@@ -967,6 +982,19 @@ def diff_bench(old: dict, new: dict, threshold: float
         if sa != sb and (sa or sb):
             lines.append(f"  {shape}.agg_strategy: {sa} -> {sb} "
                          "(lowering changed — compare device_ms)")
+        ja, jb = a.get("join_strategy"), b.get("join_strategy")
+        if ja != jb and (ja or jb):
+            lines.append(f"  {shape}.join_strategy: {ja} -> {jb} "
+                         "(join lowering changed — compare device_ms)")
+        # the same-lowering waiver below covers BOTH strategy fields: a
+        # deliberate agg OR join flip redraws the compiled-byte profile
+        # (incl. total bytes — AUTO legitimately resolves different
+        # tiers at different scales), so every byte gate binds only
+        # when neither changed; the flip itself is flagged above, and
+        # CI pins the committed rounds' ABSOLUTE amplification levels
+        # (events job: agg <= r09/5, join <= r10/3) so a flip that
+        # blows up bytes still cannot land
+        same_lowering = sa == sb and ja == jb
         for field in ("tpu_ms", "device_ms"):
             va, vb = a.get(field), b.get(field)
             if va is None or vb is None or va <= 0:
@@ -991,7 +1019,7 @@ def diff_bench(old: dict, new: dict, threshold: float
         # reads as a frac drop while being the fix itself
         fa, fb = a.get("hbm_frac_xla"), b.get("hbm_frac_xla")
         if fa is not None and fb is not None and fa > DIFF_MIN_FRAC \
-                and sa == sb:
+                and same_lowering:
             # same unbounded ratio form as the tpu_ms/device_ms gates: a
             # drop-fraction ((fa-fb)/fa) saturates at 1.0 and can never
             # clear CI's threshold 2.0, so a full collapse would pass
@@ -1009,7 +1037,7 @@ def diff_bench(old: dict, new: dict, threshold: float
         # not grow beyond the threshold, and the scatter count must not
         # rise (both shape-derived — meaningful across environments)
         ta, tb = a.get("hlo_top_fusion_bytes"), b.get("hlo_top_fusion_bytes")
-        if ta and tb and sa == sb:
+        if ta and tb and same_lowering:
             # a deliberate lowering flip redraws the fusion map (the
             # radix loop IS one big fusion); its TOTAL bytes are gated
             # by byte_amplification above, so the per-fusion gate only
@@ -1025,9 +1053,14 @@ def diff_bench(old: dict, new: dict, threshold: float
         # number of the round-12 kernel rewrite. Growth beyond the
         # threshold means the compiled programs started touching bytes
         # the layout never demanded — a regression even when wall clock
-        # on a noisy shared box hides it (backfilled for older jsons)
+        # on a noisy shared box hides it (backfilled for older jsons).
+        # Same-lowering only: AUTO resolves different tiers at
+        # different scales (a scale-0.1 smoke legitimately runs the
+        # SCATTER agg the committed scale-0.25 round replaced), and a
+        # deliberate flip owns its amplification — the committed-round
+        # ABSOLUTE levels are pinned by the events job instead
         aa, ab = _byte_amp(a), _byte_amp(b)
-        if aa and ab:
+        if aa and ab and same_lowering:
             if ab > aa * (1.0 + threshold):
                 regressions += 1
                 lines.append(f"  {shape}.byte_amplification: REGRESSION "
@@ -1042,7 +1075,7 @@ def diff_bench(old: dict, new: dict, threshold: float
         # materializing bigger intermediates; a strategy flip owns its
         # temp profile (flagged above)
         pa, pb = a.get("xla_peak_temp_bytes"), b.get("xla_peak_temp_bytes")
-        if pa and pb and sa == sb:
+        if pa and pb and same_lowering:
             if pb > pa * (1.0 + threshold):
                 regressions += 1
                 lines.append(f"  {shape}.xla_peak_temp_bytes: REGRESSION "
@@ -1053,10 +1086,11 @@ def diff_bench(old: dict, new: dict, threshold: float
                              f"{pa} -> {pb}")
         ka, kb = a.get("hlo_scatter_count"), b.get("hlo_scatter_count")
         if ka is not None and kb is not None:
-            # growth is gated only when the agg lowering did NOT change:
-            # a deliberate strategy flip (already flagged above) owns its
-            # scatter-count delta, a same-strategy rise is a regression
-            if kb > ka and sa == sb:
+            # growth is gated only when NEITHER lowering changed (agg
+            # and join strategy alike): a deliberate flip (already
+            # flagged above) owns its scatter-count delta, a
+            # same-strategy rise is a regression
+            if kb > ka and same_lowering:
                 regressions += 1
                 lines.append(f"  {shape}.hlo_scatter_count: REGRESSION "
                              f"{ka} -> {kb} (a scatter lowering appeared)")
