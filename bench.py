@@ -1164,6 +1164,137 @@ def run_serve_lane(args) -> None:
     }))
 
 
+# ---------------------------------------------------------------------------
+# Cold-start lane: the serving-restart story in numbers. Each shape runs
+# THREE times in FRESH subprocesses — (1) AOT program cache off: the
+# full compile bill a restarted server pays today (compile_s_cold);
+# (2) cache on over an empty directory: same bill + the store cost,
+# populating the cache; (3) cache on over the now-warm directory:
+# compile_s_warm, which the ROADMAP 5(a) exit criterion demands be
+# ~zero (target warm_ratio <= 0.1; tpu_profile --diff gates the
+# structural failures — warm compile misses, a ratio collapsed past
+# 0.5, grown compile_s_warm vs the old round). Compile seconds come
+# from the harvested xla_cost records (trace_ms + compile_ms per
+# program — the same figures the roofline report sums), so cold and
+# warm measure the identical definition.
+# ---------------------------------------------------------------------------
+def run_cold_start_child(args) -> None:
+    """One shape, once, in this (fresh) process; prints one JSON line
+    with the compile bill actually paid. SRTPU_AOT_DIR (set by the
+    parent lane) turns the program cache on."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu import xla_cost
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.exec import (
+        InMemoryScanExec,
+        TpuFilterExec,
+        TpuHashAggregateExec,
+        TpuProjectExec,
+    )
+    from spark_rapids_tpu.exec import base as EB
+    from spark_rapids_tpu.expr import aggregates as A
+    from spark_rapids_tpu.expr import expressions as E
+
+    class X:
+        pass
+
+    X.InMemoryScanExec = InMemoryScanExec
+    X.TpuFilterExec = TpuFilterExec
+    X.TpuProjectExec = TpuProjectExec
+    X.TpuHashAggregateExec = TpuHashAggregateExec
+
+    conf_dict = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True}
+    aot_dir = os.environ.get("SRTPU_AOT_DIR", "")
+    if aot_dir:
+        conf_dict["spark.rapids.tpu.aotCache.dir"] = aot_dir
+    conf = RapidsConf(conf_dict)
+    if aot_dir:
+        from spark_rapids_tpu.serve import program_cache
+
+        program_cache.install(conf)
+    xla_cost.FORCE_HARVEST = True
+    name = args.cold_start_child
+    fn = SHAPES[name]
+    t0 = time.perf_counter()
+    _cpu_t, tpu_t, _extra = fn(
+        args.scale, 1, conf_dict if name == "parquet" else conf,
+        T, E, A, X)
+    wall_s = time.perf_counter() - t0
+    recs = xla_cost.records_since(0)
+    print(json.dumps({
+        "shape": name,
+        "compile_s": round(sum(
+            (r.get("trace_ms") or 0) + (r.get("compile_ms") or 0)
+            for r in recs) / 1e3, 3),
+        "compile_miss": EB.COMPILE_COUNTER.total,
+        "from_cache": sum(1 for r in recs if r.get("from_cache")),
+        "programs": len(recs),
+        "tpu_ms": round(tpu_t * 1e3, 1),
+        "wall_s": round(wall_s, 3),
+    }))
+
+
+def _cold_start_spawn(name: str, args, aot_dir: str) -> dict:
+    import subprocess
+
+    env = dict(os.environ)
+    if aot_dir:
+        env["SRTPU_AOT_DIR"] = aot_dir
+    else:
+        env.pop("SRTPU_AOT_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--cold-start-child", name, "--scale", str(args.scale)],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"cold-start child {name} failed:\n{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def run_cold_start_lane(args) -> None:
+    from spark_rapids_tpu import envinfo
+
+    env = envinfo.environment_info()
+    print("env: " + envinfo.describe(env), file=sys.stderr)
+    cache_dir = args.cold_start_dir or tempfile.mkdtemp(
+        prefix="srtpu-aot-bench-")
+    results = {}
+    for name in (s.strip() for s in args.shapes.split(",")):
+        cold = _cold_start_spawn(name, args, "")
+        seed = _cold_start_spawn(name, args, cache_dir)
+        warm = _cold_start_spawn(name, args, cache_dir)
+        ratio = (round(warm["compile_s"] / cold["compile_s"], 4)
+                 if cold["compile_s"] else None)
+        results[name] = {
+            "compile_s_cold": cold["compile_s"],
+            "compile_s_seed": seed["compile_s"],
+            "compile_s_warm": warm["compile_s"],
+            "warm_ratio": ratio,
+            "compile_miss_cold": cold["compile_miss"],
+            "compile_miss_warm": warm["compile_miss"],
+            "from_cache_warm": warm["from_cache"],
+            "programs": cold["programs"],
+            "tpu_ms_cold": cold["tpu_ms"],
+            "tpu_ms_warm": warm["tpu_ms"],
+        }
+        print(
+            f"{name}: compile cold={cold['compile_s']:.2f}s "
+            f"warm={warm['compile_s']:.2f}s"
+            + (f" (ratio {ratio})" if ratio is not None else "")
+            + f" misses {cold['compile_miss']}->{warm['compile_miss']}"
+            f" from_cache={warm['from_cache']}",
+            file=sys.stderr)
+    print(json.dumps({
+        "metric": "cold_start_compile_seconds",
+        "unit": f"s (fresh subprocess per lane; scale={args.scale})",
+        "env": env,
+        "cache_dir": cache_dir,
+        "cold_start": results,
+    }))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0)
@@ -1183,12 +1314,38 @@ def main() -> None:
              "prints queries/sec + p50/p95 latency vs serialized "
              "one-at-a-time submission (the BENCH json's 'serve' lane)")
     ap.add_argument(
+        "--cold-start", action="store_true",
+        help="run the cold-start lane instead of the shapes: each shape "
+             "three times in FRESH subprocesses (AOT program cache off / "
+             "populating / warm — spark.rapids.tpu.aotCache.dir) and "
+             "report compile_s_cold vs compile_s_warm per shape (the "
+             "BENCH json's 'cold_start' lane; the ROADMAP 5a target is "
+             "warm/cold <= 0.1 with zero warm compile misses — "
+             "tpu_profile --diff gates misses, a >0.5 ratio collapse, "
+             "and compile_s_warm growth)")
+    ap.add_argument(
+        "--cold-start-dir", type=str, default="",
+        help="reuse this AOT cache directory for the cold-start lane "
+             "(default: a fresh temp dir, so 'warm' means warmed by the "
+             "lane's own populating run)")
+    ap.add_argument(
+        "--cold-start-child", type=str, default="",
+        help=argparse.SUPPRESS)  # internal: one fresh-process shape run
+    ap.add_argument(
         "--event-log", type=str, default="",
         help="directory for a structured JSONL event log of the bench run "
              "(spark.rapids.tpu.eventLog.dir); inspect it offline with "
              "tools/tpu_profile.py, or --diff the emitted BENCH json "
              "against a previous round's")
     args = ap.parse_args()
+
+    if args.cold_start_child:
+        run_cold_start_child(args)
+        return
+
+    if args.cold_start:
+        run_cold_start_lane(args)
+        return
 
     if args.serve:
         run_serve_lane(args)

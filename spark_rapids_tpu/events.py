@@ -135,6 +135,16 @@ EVENT_TYPES: Dict[str, tuple] = {
                       "codec"),
     # device scan-cache activity (io/scan_cache.py)
     "scan_cache": ("op", "bytes"),
+    # persistent AOT program cache (serve/program_cache.py): ``op`` is
+    # hit (entry deserialized at lookup) / miss (no entry — the plain
+    # compile path runs and stores) / put (entry written atomically) /
+    # deserialize (first-call compile of a deserialized program; its
+    # near-zero cost rides in the optional ``ms``) / evict (size-capped
+    # LRU) / corrupt (poisoned entry deleted, plain compile fallback) /
+    # write_error (store failed, query unaffected). ``key`` is the same
+    # 12-hex signature digest program_cost carries, so the profiler can
+    # join the two event families per program.
+    "program_cache": ("op", "site", "key", "bytes"),
     # per-plan aggregation-strategy choice (exec/aggregate.py): the AUTO
     # chooser's pick (or the forced conf value) with its cost-model
     # reason — logged so tpu_profile can hold the chooser accountable
@@ -187,9 +197,15 @@ EVENT_OPTIONAL_FIELDS: Dict[str, tuple] = {
     # one; ``generated_code_bytes``: memory_analysis code size;
     # ``peak_hbm_gbps``/``peak_tflops``: explicitly conf-declared
     # roofline peaks riding to the offline profiler (absent when the
-    # confs are 0.0 and per-backend defaults apply)
+    # confs are 0.0 and per-backend defaults apply);
+    # ``from_cache``/``saved_ms``: set when the AOT program cache
+    # (serve/program_cache.py) re-emitted a PERSISTED cost payload on a
+    # deserialize hit — bytes/flops are the original harvest,
+    # trace_ms/compile_ms are this process's near-zero deserialize +
+    # cached-compile cost, saved_ms the original bill avoided
     "program_cost": ("op", "out_bytes", "generated_code_bytes",
-                     "peak_hbm_gbps", "peak_tflops"),
+                     "peak_hbm_gbps", "peak_tflops", "from_cache",
+                     "saved_ms"),
     # ``retries``: transient-failure retries the network transport paid
     # before this fetch succeeded (shuffle/network.py exponential
     # backoff; absent on the in-process transports, 0 on a clean fetch)
@@ -198,8 +214,14 @@ EVENT_OPTIONAL_FIELDS: Dict[str, tuple] = {
     # summary's total_bytes / the program's cost_analysis bytes accessed
     # (absent when the backend reported no byte cost) — XLA applies
     # utilization weighting inside fusions, so the ratio reports how
-    # much of the compiler's figure the shape-level attribution explains
-    "hlo_summary": ("op", "accounted_frac"),
+    # much of the compiler's figure the shape-level attribution explains;
+    # ``from_cache``: the summary was re-emitted from an AOT
+    # program-cache entry's persisted payload (the program's HLO was
+    # parsed in the process that originally compiled it)
+    "hlo_summary": ("op", "accounted_frac", "from_cache"),
+    # ``ms``: deserialize(+cached-compile) duration on hit/deserialize
+    # records; ``detail``: human-readable cause on corrupt/write_error
+    "program_cache": ("ms", "detail"),
 }
 
 
@@ -512,6 +534,15 @@ def chrome_trace(records: List[dict]) -> dict:
         elif ev == "scan_cache":
             out.append({"ph": "i", "pid": _PID, "tid": tid_of("scan_cache"),
                         "name": f"{r['op']}", "ts": us(ts), "s": "t"})
+        elif ev == "program_cache":
+            # the AOT cache's lifecycle lands on the compile track: a
+            # deserialize marker where a multi-second compile span would
+            # otherwise sit is the visual proof of a warm start
+            out.append({"ph": "i", "pid": _PID, "tid": tid_of("compile"),
+                        "name": f"aot_{r['op']}:{r.get('site') or ''}",
+                        "ts": us(ts), "s": "t",
+                        "args": {"key": r.get("key"),
+                                 "bytes": r.get("bytes")}})
         elif ev == "alert":
             out.append({"ph": "i", "pid": _PID, "tid": tid_of("watchdog"),
                         "name": f"{r['kind']}: {r.get('detail', '')}",

@@ -19,6 +19,7 @@ from .. import events as _events
 from .. import faults as _faults
 from .. import obs as _obs
 from .. import xla_cost as _xla_cost
+from ..serve import program_cache as _progcache
 from ..columnar import ColumnarBatch, DeviceColumn
 from ..conf import RapidsConf
 from ..expr.eval import ColV, DictV, StrV, Val
@@ -83,6 +84,33 @@ COMPILE_COUNTER = CompileCounter()
 # ---------------------------------------------------------------------------
 _PIPELINE_CACHE_LOCK = threading.RLock()
 
+#: cache dicts that have passed through cached_pipeline (dedup by
+#: identity, O(1) via the id set) — the clear_pipeline_caches() sweep
+#: set. BOUNDED: most caches are module globals (~15 across the
+#: engine), but sort/window/join/exchange also route per-INSTANCE
+#: ``self._jits`` dicts through here, and registering those forever
+#: would pin every exec instance's compiled executables for the
+#: process lifetime (dicts aren't weakref-able). Past the cap new
+#: dicts simply aren't registered — they stay collectable with their
+#: owners, and the sweep (a test/maintenance helper) loses nothing it
+#: needs: a fresh session builds fresh exec instances anyway.
+_PIPELINE_CACHE_REGISTRY_CAP = 64
+_ALL_PIPELINE_CACHES: List[dict] = []
+_ALL_PIPELINE_CACHE_IDS: set = set()
+
+
+def clear_pipeline_caches() -> int:
+    """Drop every in-memory compiled-pipeline entry (returns how many).
+    Test/maintenance helper: a cleared process re-enters the compile
+    path on its next batch — with the persistent AOT program cache
+    (serve/program_cache.py) enabled that path is a disk lookup, which
+    is exactly how the warm-hit tests exercise it in-process."""
+    with _PIPELINE_CACHE_LOCK:
+        n = sum(len(c) for c in _ALL_PIPELINE_CACHES)
+        for c in _ALL_PIPELINE_CACHES:
+            c.clear()
+        return n
+
 
 def cached_pipeline(cache: dict, key, site: Optional[str],
                     build: Callable[[], Callable],
@@ -93,23 +121,50 @@ def cached_pipeline(cache: dict, key, site: Optional[str],
     with _PIPELINE_CACHE_LOCK:
         fn = cache.get(key)
         if fn is None:
+            if (id(cache) not in _ALL_PIPELINE_CACHE_IDS
+                    and len(_ALL_PIPELINE_CACHES)
+                    < _PIPELINE_CACHE_REGISTRY_CAP):
+                _ALL_PIPELINE_CACHES.append(cache)
+                _ALL_PIPELINE_CACHE_IDS.add(id(cache))
             if len(cache) > max_entries:
                 cache.clear()
-            if _faults.enabled():
-                # injected compile failure (chaos testing): raised BEFORE
-                # the miss is counted or the entry installed, so a failed
-                # build never pollutes the cache or the miss accounting
-                _faults.check("compile", site or "<anon>")
-            if site is not None:
-                note_compile_miss(site)
-            # compiled-program cost plane (xla_cost.py): while a cost
-            # consumer is active (events / obs / the bench-harness
-            # FORCE_HARVEST hook), the fresh jit callable is wrapped so
-            # its first call times trace+compile separately and harvests
-            # cost_analysis()/memory_analysis() into ONE program_cost
-            # record; with everything off (the default) wrap() returns
-            # the value untouched and cost_analysis is never called
-            fn = cache[key] = _xla_cost.wrap(build(), site, key)
+            pc = (_progcache.active()
+                  if site is not None and _progcache.enabled() else None)
+            if pc is not None:
+                # persistent AOT program cache (serve/program_cache.py):
+                # a disk hit deserializes the executable — no trace, no
+                # backend compile, no compile_miss — and re-emits the
+                # persisted cost payload flagged from_cache at first
+                # call. Anything else (entry absent, corrupt, identity
+                # mismatch) returns None and the plain path below runs.
+                fn = pc.lookup(site, key, build)
+            if fn is None:
+                if _faults.enabled():
+                    # injected compile failure (chaos testing): raised
+                    # BEFORE the miss is counted or the entry installed,
+                    # so a failed build never pollutes the cache or the
+                    # miss accounting
+                    _faults.check("compile", site or "<anon>")
+                if site is not None:
+                    note_compile_miss(site)
+                if pc is not None:
+                    # miss with the cache on: the store probe exports +
+                    # persists at first call AND subsumes the cost-plane
+                    # harvest (it falls back to xla_cost.wrap itself for
+                    # programs that cannot participate)
+                    fn = pc.wrap_store(build(), site, key)
+                else:
+                    # compiled-program cost plane (xla_cost.py): while a
+                    # cost consumer is active (events / obs / the
+                    # bench-harness FORCE_HARVEST hook), the fresh jit
+                    # callable is wrapped so its first call times
+                    # trace+compile separately and harvests
+                    # cost_analysis()/memory_analysis() into ONE
+                    # program_cost record; with everything off (the
+                    # default) wrap() returns the value untouched and
+                    # cost_analysis is never called
+                    fn = _xla_cost.wrap(build(), site, key)
+            cache[key] = fn
     return fn
 
 

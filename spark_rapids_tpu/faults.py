@@ -6,13 +6,16 @@ spillable-store fault hooks) — the only honest way to exercise the OOM
 retry / split-and-retry plane (memory/retry.py) on a CPU-fallback box
 whose XLA backend never actually exhausts device memory.
 
-Four channels, each with its own conf of comma-separated site specs:
+Five channels, each with its own conf of comma-separated site specs:
 
   * ``oom``      — synthetic device-OOM raised at the top of a retry-
                    harness attempt (the site is the exec's node name);
   * ``transfer`` — host-link upload failure in ``packed_upload``;
   * ``fetch``    — network shuffle fetch failure (shuffle/network.py);
-  * ``compile``  — pipeline-cache build failure (exec/base.py).
+  * ``compile``  — pipeline-cache build failure (exec/base.py);
+  * ``aotcache`` — persistent AOT program-cache I/O failure
+                   (serve/program_cache.py; sites ``read:<site>`` /
+                   ``write:<site>``).
 
 Spec grammar (per entry, comma-separated; site matching is fnmatch so
 ``*`` and prefixes work)::
@@ -70,12 +73,22 @@ FAULTS_COMPILE = conf(
     "spark.rapids.tpu.test.faults.compile", "",
     "Pipeline-cache build failure specs (sites are compile-cache site "
     "names, e.g. 'fused_chain', 'agg_plan').", internal=True)
+FAULTS_AOTCACHE = conf(
+    "spark.rapids.tpu.test.faults.aotcache", "",
+    "Persistent AOT program-cache I/O failure specs "
+    "(serve/program_cache.py): sites are 'read:<compile-site>' / "
+    "'write:<compile-site>' (fnmatch, so 'read:*' corrupts every "
+    "lookup). A read fault is handled as a corrupt entry (deleted, "
+    "plain compile fallback); a write fault skips the store — either "
+    "way the query must succeed, which is exactly what the chaos CI "
+    "job asserts.", internal=True)
 
 _CHANNEL_CONFS = {
     "oom": FAULTS_OOM,
     "transfer": FAULTS_TRANSFER,
     "fetch": FAULTS_FETCH,
     "compile": FAULTS_COMPILE,
+    "aotcache": FAULTS_AOTCACHE,
 }
 
 
@@ -102,11 +115,19 @@ class InjectedCompileError(InjectedFault):
     """Synthetic XLA compile failure."""
 
 
+class InjectedCacheError(InjectedFault, OSError):
+    """Synthetic AOT program-cache I/O failure (an OSError, so the
+    cache's defensive read/write paths treat it exactly like a real
+    disk fault: corrupt-entry deletion on read, skipped store on
+    write)."""
+
+
 _ERROR_OF = {
     "oom": InjectedOOM,
     "transfer": InjectedTransferError,
     "fetch": InjectedFetchError,
     "compile": InjectedCompileError,
+    "aotcache": InjectedCacheError,
 }
 
 

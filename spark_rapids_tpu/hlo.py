@@ -702,3 +702,47 @@ def harvest_hlo(compiled, site: str, digest: str,
         top = rec["top_fusions"][0]["bytes"] if rec["top_fusions"] else 0
         _obs.note_hlo_summary(site, rec["scatter_count"], top)
     return rec
+
+
+def note_cached_summary(site: str, digest: str, payload: Dict[str, Any],
+                        op: Optional[str] = None) -> Optional[dict]:
+    """Re-emit a PERSISTED hlo_summary payload on an AOT program-cache
+    deserialize hit (serve/program_cache.py): the program's HLO was
+    parsed by the process that originally compiled it, and a warm
+    process that never compiled anything must still report the same
+    per-fusion attribution (flagged ``from_cache``) so the '== hlo =='
+    section and the --diff scatter/fusion gates stay truthful. Rides
+    the caller's harvesting() gate; a malformed payload records
+    nothing and never fails a query."""
+    global _SEQ
+    try:
+        import jax
+
+        rec: Dict[str, Any] = {
+            "site": site, "digest": digest, "op": op,
+            "backend": jax.default_backend(),
+            "accounted_frac": payload.get("accounted_frac"),
+            "from_cache": True,
+        }
+        for k in SUMMARY_FIELDS:
+            rec[k] = payload[k]
+    except Exception:
+        return None
+    with _LOCK:
+        _SEQ += 1
+        rec["seq"] = _SEQ
+        _RECORDS.append(rec)
+    if _events.enabled():
+        ev = {k: rec[k] for k in ("site", "digest", "backend")
+              + SUMMARY_FIELDS}
+        ev["from_cache"] = True
+        for k in ("op", "accounted_frac"):
+            if rec.get(k) is not None:
+                ev[k] = rec[k]
+        _events.emit("hlo_summary", **ev)
+    from . import obs as _obs
+
+    if _obs.enabled():
+        top = rec["top_fusions"][0]["bytes"] if rec["top_fusions"] else 0
+        _obs.note_hlo_summary(site, rec["scatter_count"], top)
+    return rec

@@ -111,6 +111,21 @@ METRICS: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     "tpu_scan_cache_ops": (
         COUNTER, "Device scan-cache operations (hit/miss/put/evict)",
         ("op",)),
+    "tpu_program_cache": (
+        COUNTER, "Persistent AOT program-cache operations "
+        "(hit/miss/put/deserialize/evict/corrupt/write_error — "
+        "serve/program_cache.py; the program_cache event's live twin). "
+        "A warm process shows hits ~= deserializes and zero compile "
+        "misses; corrupt entries are deleted and fall through to plain "
+        "compiles.", ("op",)),
+    "tpu_program_cache_resident_bytes": (
+        GAUGE, "Bytes resident in the AOT program-cache directory "
+        "(updated after each store's size-capped LRU sweep)", ()),
+    "tpu_program_cache_saved_seconds": (
+        COUNTER, "Original trace+compile seconds the persisted cost "
+        "payloads say deserialize hits avoided (the compile-seconds-"
+        "avoided estimate tpu_profile's program-cache section reports)",
+        ()),
     "tpu_scan_cache_hit_ratio": (
         GAUGE, "hits / (hits + misses) of the device scan cache", ()),
     "tpu_scan_cache_resident_bytes": (
@@ -198,6 +213,7 @@ EVENT_BACKED_METRICS: Dict[str, str] = {
     "shuffle_write": "tpu_shuffle_bytes",
     "shuffle_fetch": "tpu_shuffle_bytes",
     "scan_cache": "tpu_scan_cache_ops",
+    "program_cache": "tpu_program_cache",
     "alert": "tpu_watchdog_alerts",
     "agg_strategy": "tpu_agg_strategy",
     "join_strategy": "tpu_join_strategy",

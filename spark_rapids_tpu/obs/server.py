@@ -53,6 +53,10 @@ def build_status(registry: MetricsRegistry, progress: ProgressTracker,
             "queue": sched.queue_status(),
             "active": sched.active_status(),
         }
+    # persistent AOT program cache (serve/program_cache.py) — peek only:
+    # stats() is None in a process that never installed one
+    from ..serve import program_cache
+
     # environment provenance (envinfo — the same helper bench.py stamps
     # into BENCH_*.json): a live operator must be able to tell at a
     # glance whether the numbers on screen are device-backed or the CPU
@@ -65,6 +69,7 @@ def build_status(registry: MetricsRegistry, progress: ProgressTracker,
         "env": envinfo.environment_info(),
         "hbm": hbm,
         "serve": serve,
+        "program_cache": program_cache.stats(),
         "alerts": [a.to_json() for a in watchdog.alerts()]
         if watchdog is not None else [],
         "metrics": registry.snapshot(),

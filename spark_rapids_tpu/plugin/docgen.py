@@ -29,6 +29,7 @@ def _import_conf_modules() -> None:
                 "spark_rapids_tpu.hlo",
                 "spark_rapids_tpu.memory.catalog",
                 "spark_rapids_tpu.ml.columnar_rdd",
+                "spark_rapids_tpu.serve.program_cache",
                 "spark_rapids_tpu.serve.scheduler",
                 "spark_rapids_tpu.xla_cost"):
         try:

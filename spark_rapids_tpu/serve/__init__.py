@@ -7,6 +7,11 @@ execution (admitted queries host-prefetch scans before taking the device
 semaphore). ``plan_cache.SharedPlanCache`` — one static analysis / warm
 compile set per plan digest across all sessions. Sessions route through
 here when ``spark.rapids.tpu.serve.enabled`` is set (sql/session.py).
+``program_cache.ProgramCache`` — the persistent AOT program store
+(compile once, serve everywhere) riding the ``exec/base.cached_pipeline``
+chokepoint; imported lazily by its consumers (NOT re-exported here:
+exec/base imports this package, and pulling program_cache in at package
+import would make that import order-sensitive).
 """
 from .plan_cache import SharedPlanCache, conf_fingerprint
 from .scheduler import (

@@ -1,0 +1,842 @@
+"""Persistent AOT program cache: compile once, serve everywhere.
+
+Serving cold-start is the integral of compile seconds the cost plane
+(xla_cost.py) measures: every fresh process re-traces and re-compiles
+every program at the ``exec/base.cached_pipeline`` chokepoint, so a
+restarted server pays the full compile bill before its first query
+returns. This module is the disk half of that chokepoint — the analog of
+the reference plugin's digest-keyed compiled-kernel cache shared across
+executors, built on the TPU-native pair of mechanisms:
+
+  * ``jax.export`` — the traced + lowered program serializes to a
+    portable StableHLO artifact, so a warm process never re-runs the
+    engine's Python tracing (for the big fused chains, seconds of
+    expression lowering);
+  * the JAX **persistent compilation cache** — ``install()`` points
+    ``jax_compilation_cache_dir`` at ``<dir>/xla``, and the store path
+    compiles the *exported* module (the exact module a warm process will
+    compile), so the backend-compile of a deserialized program is a
+    cache **read**, not a multi-second XLA run.
+
+Entry anatomy: one ``<sha256>.aot`` file per program, named by the full
+cache identity — (format version, compile site, pipeline-key repr
+digest, backend, device kind + count, jax version, conf fingerprint) —
+so flipping ANY component is a natural miss (a new jax version or a
+different layout conf can never deserialize a stale executable). The
+file holds a JSON header (the identity spelled out, the harvested
+``program_cost`` payload, the ``hlo_summary`` payload, pickled mesh aux)
+followed by the serialized artifact, written atomically
+(write-then-rename) under a best-effort cross-process lockfile — the
+single-flight pattern of ``serve/plan_cache.py`` extended from analyses
+to programs (in-process single-flight is the pipeline-cache lock
+itself; cross-process, a loser compiles for itself but skips the
+duplicate write — a store must never block a query).
+
+The cost plane survives caching: the harvested ``cost_analysis`` /
+``hlo_summary`` payloads persist beside the executable and re-emit on a
+deserialize hit flagged ``from_cache`` (with ``saved_ms`` naming the
+original trace+compile bill avoided), so the roofline report, ``--diff``
+gates, bench ``hbm_frac_xla``, and the live obs twins stay truthful for
+a process that never compiled anything.
+
+Negative paths never fail a query: a corrupt/truncated entry, a
+``jax.export`` version mismatch, or an executable that rejects this
+call's signature logs, deletes the poisoned entry, and falls through to
+a plain compile. The ``aotcache`` fault channel (faults.py,
+``read:<site>`` / ``write:<site>`` specs) drives both deterministically.
+
+Zero-overhead contract (the events.py pattern): with the confs off —
+the default — ``enabled()`` is one module-global boolean read on the
+pipeline-cache SLOW path only, no directory is touched, no thread is
+started, and ``cached_pipeline``'s fast path is byte-for-byte unchanged
+(tests/test_program_cache.py pins this with a spy).
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import pickle
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import events as _events
+from .. import faults as _faults
+from .. import obs as _obs
+from ..conf import RapidsConf, conf
+
+AOT_CACHE_ENABLED = conf(
+    "spark.rapids.tpu.aotCache.enabled", False,
+    "Enable the persistent AOT program cache: every compile miss at the "
+    "pipeline-cache chokepoint serializes its program (jax.export) to "
+    "aotCache.dir keyed by (site, signature digest, backend, device "
+    "kind, jax version, conf fingerprint), and a later process "
+    "deserializes instead of tracing + compiling — near-zero cold-start "
+    "compile seconds for a warmed cache directory (the harvested cost "
+    "payloads re-emit flagged from_cache so the roofline report stays "
+    "truthful). Setting aotCache.dir implies this key. Off by default — "
+    "the off path is a single boolean read and touches no disk.")
+AOT_CACHE_DIR = conf(
+    "spark.rapids.tpu.aotCache.dir", "",
+    "Directory for the persistent AOT program cache (one <digest>.aot "
+    "entry per program + the JAX persistent compilation cache under "
+    "<dir>/xla). Setting a directory turns the cache on; with "
+    "aotCache.enabled true and no directory, entries land under "
+    "~/.cache/spark-rapids-tpu/aot. Share a directory only between "
+    "processes on identical hardware/jax/conf (mismatches are safe — "
+    "they key apart — but never hit); see docs/tuning.md.")
+AOT_CACHE_MAX_BYTES = conf(
+    "spark.rapids.tpu.aotCache.maxBytes", 1 << 30,
+    "Size cap for the AOT program-cache directory. After each store the "
+    "directory is scanned and least-recently-USED entries (hits bump an "
+    "entry's mtime) are evicted until under the cap. The JAX persistent "
+    "compilation cache under <dir>/xla is bounded separately by jax "
+    "itself.", conf_type=int,
+    check=lambda v: None if v > 0 else "must be positive")
+
+#: bump to invalidate every existing entry (header + filename component,
+#: so old-format files simply stop being addressed AND are rejected if
+#: hand-renamed into place)
+FORMAT_VERSION = 1
+
+#: conf prefixes excluded from the cache-key fingerprint: observability,
+#: chaos and the cache's own knobs cannot change WHAT a program computes,
+#: and including them would make a warm bench subprocess (different
+#: eventLog.dir) miss on every entry. Everything else explicitly set —
+#: layout, memory, strategy, analysis confs — keys the entry apart.
+_FINGERPRINT_EXCLUDE = (
+    "spark.rapids.tpu.aotCache.",
+    "spark.rapids.tpu.eventLog.",
+    "spark.rapids.tpu.metrics.",
+    "spark.rapids.tpu.watchdog.",
+    "spark.rapids.tpu.hlo.",
+    "spark.rapids.tpu.roofline.",
+    "spark.rapids.tpu.tools.",
+    "spark.rapids.tpu.test.faults.",
+)
+
+#: lockfiles older than this are presumed abandoned (a crashed writer)
+_LOCK_STALE_S = 120.0
+
+#: persisted program_cost payload fields (the COST_FIELDS superset that
+#: rides in the header and re-emits on a deserialize hit)
+_COST_KEYS = ("flops", "bytes_accessed", "temp_bytes", "argument_bytes",
+              "output_bytes", "out_bytes", "generated_code_bytes",
+              "peak_hbm_gbps", "peak_tflops", "trace_ms", "compile_ms",
+              "op")
+
+
+def program_conf_fingerprint(conf_: RapidsConf) -> str:
+    """sha256 of the explicitly-set conf values that can shape compiled
+    programs (see _FINGERPRINT_EXCLUDE) — the disk twin of
+    serve/plan_cache.conf_fingerprint, filtered so observability-only
+    settings don't shatter the key space."""
+    import hashlib
+
+    items = tuple(sorted(
+        (k, repr(v)) for k, v in conf_._values.items()
+        if not any(k.startswith(p) for p in _FINGERPRINT_EXCLUDE)))
+    return hashlib.sha256(repr(items).encode()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# pytree serialization registration: the engine's column values (ColV /
+# StrV / DictV) cross the jit boundary as custom pytree nodes, and
+# jax.export refuses to serialize unregistered types. Registered once,
+# lazily, at first install; programs carrying any OTHER custom node
+# simply fall back to plain compilation (store() is best-effort).
+# ---------------------------------------------------------------------------
+_PYTREES_REGISTERED = False
+
+
+def _register_pytree_serialization() -> None:
+    global _PYTREES_REGISTERED
+    if _PYTREES_REGISTERED:
+        return
+    _PYTREES_REGISTERED = True
+    try:
+        from jax import export as _export
+
+        from ..expr.values import ColV, DictV, StrV
+
+        _export.register_namedtuple_serialization(
+            ColV, serialized_name="srtpu.ColV")
+        _export.register_namedtuple_serialization(
+            StrV, serialized_name="srtpu.StrV")
+        _export.register_pytree_node_serialization(
+            DictV, serialized_name="srtpu.DictV",
+            serialize_auxdata=lambda aux: json.dumps(list(aux)).encode(),
+            deserialize_auxdata=lambda b: tuple(json.loads(b.decode())))
+    except Exception:
+        # older jax without the registration API: string/dict programs
+        # fall back to plain compilation, fixed-width ones still cache
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Stats: the /status + tpu_top + profiler-section feed (module-level so
+# the engine's deep call sites need no handle)
+# ---------------------------------------------------------------------------
+class ProgramCacheStats:
+    """Thread-safe counters for one installed cache."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.puts = 0
+        self.evictions = 0
+        self.corrupt = 0
+        self.write_errors = 0
+        self.deserialized = 0
+        #: original trace+compile milliseconds the persisted payloads say
+        #: the hits avoided (the compile-seconds-avoided estimate)
+        self.saved_ms = 0.0
+        #: trace+compile milliseconds warm programs actually paid
+        #: (deserialize + cached backend compile)
+        self.warm_ms = 0.0
+
+    def bump(self, field: str, amount: float = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + amount)
+
+    def to_json(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "hits": self.hits, "misses": self.misses,
+                "puts": self.puts, "evictions": self.evictions,
+                "corrupt": self.corrupt,
+                "write_errors": self.write_errors,
+                "deserialized": self.deserialized,
+                "saved_ms": round(self.saved_ms, 3),
+                "warm_ms": round(self.warm_ms, 3),
+            }
+
+
+class ProgramCache:
+    """One disk-backed AOT program store (install() makes it active)."""
+
+    def __init__(self, conf_: RapidsConf):
+        import jax
+
+        from .. import envinfo
+
+        d = conf_.get(AOT_CACHE_DIR) or os.path.expanduser(
+            "~/.cache/spark-rapids-tpu/aot")
+        self.dir = os.path.abspath(d)
+        self.max_bytes = conf_.get(AOT_CACHE_MAX_BYTES)
+        env = envinfo.environment_info()
+        # identity components — instance attributes so the key-flip tests
+        # can construct a cache claiming different hardware
+        self.backend = env.get("backend")
+        self.device_kind = env.get("device_kind")
+        self.device_count = env.get("device_count")
+        self.jax_version = jax.__version__
+        self.conf_fp = program_conf_fingerprint(conf_)
+        self.stats = ProgramCacheStats()
+        #: sites whose programs proved non-exportable this process (an
+        #: unregistered pytree, a shard_map dialect export rejects):
+        #: skip the export attempt instead of re-failing per key
+        self._unexportable: set = set()
+        os.makedirs(self.dir, exist_ok=True)
+        os.makedirs(os.path.join(self.dir, "xla"), exist_ok=True)
+
+    # -- keying ------------------------------------------------------------
+    def entry_name(self, site: str, key: Any) -> Optional[str]:
+        """Filename for one program's full cache identity, or None when
+        the pipeline key's repr is not process-stable (a default object
+        repr leaks an address — such a key could never hit across
+        processes and must not pollute the directory)."""
+        import hashlib
+
+        key_repr = repr(key)
+        if " at 0x" in key_repr:
+            return None
+        ident = repr((FORMAT_VERSION, site,
+                      hashlib.sha256(key_repr.encode()).hexdigest(),
+                      self.backend, self.device_kind, self.device_count,
+                      self.jax_version, self.conf_fp))
+        return hashlib.sha256(ident.encode()).hexdigest()[:40] + ".aot"
+
+    def entry_path(self, site: str, key: Any) -> Optional[str]:
+        name = self.entry_name(site, key)
+        return None if name is None else os.path.join(self.dir, name)
+
+    def header_identity(self, site: str) -> Dict[str, Any]:
+        return {
+            "version": FORMAT_VERSION, "site": site,
+            "backend": self.backend, "device_kind": self.device_kind,
+            "device_count": self.device_count,
+            "jax_version": self.jax_version, "conf_fp": self.conf_fp,
+        }
+
+    # -- disk I/O ----------------------------------------------------------
+    def _read_entry(self, path: str) -> Tuple[Dict[str, Any], bytes]:
+        """Parse one entry file; raises on any corruption (caller turns
+        that into delete + plain compile)."""
+        with open(path, "rb") as f:
+            raw = f.read()
+        if len(raw) < 8:
+            raise ValueError("truncated entry (no header length)")
+        (hlen,) = struct.unpack(">Q", raw[:8])
+        if hlen <= 0 or 8 + hlen > len(raw):
+            raise ValueError("truncated entry (header)")
+        header = json.loads(raw[8:8 + hlen].decode())
+        blob = raw[8 + hlen:]
+        if header.get("blob_len") != len(blob):
+            raise ValueError(
+                f"truncated entry (blob {len(blob)} != "
+                f"{header.get('blob_len')})")
+        return header, blob
+
+    def _poison(self, path: str, site: str, detail: str) -> None:
+        """A corrupt/mismatched entry: delete it (it can only ever fail
+        again), count it, log it — and let the caller fall through to a
+        plain compile."""
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        self.stats.bump("corrupt")
+        if _events.enabled():
+            _events.emit("program_cache", op="corrupt", site=site,
+                         key=os.path.basename(path)[:12], bytes=size,
+                         detail=detail[:200])
+        if _obs.enabled():
+            _obs.inc("tpu_program_cache", 1, op="corrupt")
+
+    def lookup(self, site: str, key: Any, build: Callable[[], Any]):
+        """Disk probe for one pipeline-cache miss. Returns a callable
+        (or the mesh ``(callable, aux...)`` tuple) serving the entry, or
+        None — and on None the caller compiles exactly as before. Never
+        raises."""
+        path = self.entry_path(site, key)
+        if path is None:
+            return None
+        kd = _digest_of(key)
+        try:
+            if _faults.enabled():
+                _faults.check("aotcache", "read:" + site)
+            if not os.path.exists(path):
+                self.stats.bump("misses")
+                if _events.enabled():
+                    _events.emit("program_cache", op="miss", site=site,
+                                 key=kd, bytes=0)
+                if _obs.enabled():
+                    _obs.inc("tpu_program_cache", 1, op="miss")
+                return None
+            t0 = time.perf_counter_ns()
+            header, blob = self._read_entry(path)
+            ident = self.header_identity(site)
+            mismatched = [k for k, v in ident.items()
+                          if header.get(k) != v]
+            if mismatched:
+                raise ValueError("identity mismatch on " +
+                                 ",".join(mismatched))
+            from jax import export as _export
+
+            _register_pytree_serialization()
+            exported = _export.deserialize(blob)
+            # the mesh tuple path's aux decodes INSIDE the corruption
+            # guard: a bit-flipped/stale aux pickle must poison the
+            # entry and fall through, never raise out of lookup()
+            aux_b64 = header.get("aux")
+            aux = (tuple(pickle.loads(base64.b64decode(aux_b64)))
+                   if aux_b64 is not None else None)
+            deser_ns = time.perf_counter_ns() - t0
+        except Exception as e:
+            if os.path.exists(path):
+                self._poison(path, site, f"{type(e).__name__}: {e}")
+            return None
+        try:
+            os.utime(path)  # LRU touch: hits protect an entry
+        except OSError:
+            pass
+        self.stats.bump("hits")
+        self.stats.bump("saved_ms",
+                        (header.get("cost") or {}).get("trace_ms", 0.0)
+                        + (header.get("cost") or {}).get("compile_ms", 0.0))
+        if _events.enabled():
+            _events.emit("program_cache", op="hit", site=site, key=kd,
+                         bytes=len(blob), ms=round(deser_ns / 1e6, 3))
+        if _obs.enabled():
+            _obs.inc("tpu_program_cache", 1, op="hit")
+        probe = _LoadProbe(self, exported, header, site, key, kd, path,
+                           build, deser_ns)
+        if aux is not None:
+            return (probe,) + aux
+        return probe
+
+    def wrap_store(self, built: Any, site: str, key: Any):
+        """Miss path: arrange for the freshly-built program to be
+        exported + persisted at its first call. Falls back to the plain
+        cost-plane wrap (xla_cost.wrap) whenever this program cannot
+        participate — the cost plane must keep working either way."""
+        from .. import xla_cost as _xla_cost
+
+        path = self.entry_path(site, key)
+        aux: Tuple = ()
+        fn = built
+        if isinstance(built, tuple):
+            if not built or not callable(built[0]):
+                path = None
+            else:
+                fn, aux = built[0], tuple(built[1:])
+        if (path is None or site in self._unexportable
+                or not callable(fn) or not hasattr(fn, "lower")):
+            return _xla_cost.wrap(built, site, key)
+        try:
+            aux_b64 = (base64.b64encode(pickle.dumps(aux)).decode()
+                       if aux else None)
+        except Exception:
+            return _xla_cost.wrap(built, site, key)
+        probe = _StoreProbe(self, fn, site, key, _digest_of(key), path,
+                            aux_b64)
+        if aux:
+            return (probe,) + aux
+        return probe
+
+    # -- store + eviction --------------------------------------------------
+    def store(self, site: str, key_digest: str, path: str,
+              header: Dict[str, Any], blob: bytes) -> None:
+        """Atomic write-then-rename under a best-effort cross-process
+        lockfile. A racing writer in another process makes this a no-op
+        (it is writing the same bytes); any failure counts + logs and
+        the query proceeds on the in-memory executable."""
+        try:
+            if _faults.enabled():
+                _faults.check("aotcache", "write:" + site)
+            lock = path + ".lock"
+            fd = None
+            try:
+                try:
+                    fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                except FileExistsError:
+                    try:
+                        fresh = (time.time() - os.path.getmtime(lock)
+                                 < _LOCK_STALE_S)
+                    except OSError:
+                        fresh = False
+                    if fresh:
+                        return  # single-flight: the other process writes
+                    try:
+                        os.unlink(lock)  # stale lock from a dead writer
+                    except OSError:
+                        pass
+                    fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                hdr = json.dumps(header, separators=(",", ":"),
+                                 sort_keys=True).encode()
+                tmp = path + f".tmp.{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    f.write(struct.pack(">Q", len(hdr)))
+                    f.write(hdr)
+                    f.write(blob)
+                os.replace(tmp, path)
+            finally:
+                if fd is not None:
+                    os.close(fd)
+                    try:
+                        os.unlink(lock)
+                    except OSError:
+                        pass
+        except Exception as e:
+            self.stats.bump("write_errors")
+            if _events.enabled():
+                _events.emit("program_cache", op="write_error", site=site,
+                             key=key_digest, bytes=0,
+                             detail=f"{type(e).__name__}: {e}"[:200])
+            if _obs.enabled():
+                _obs.inc("tpu_program_cache", 1, op="write_error")
+            return
+        self.stats.bump("puts")
+        if _events.enabled():
+            _events.emit("program_cache", op="put", site=site,
+                         key=key_digest, bytes=len(blob))
+        if _obs.enabled():
+            _obs.inc("tpu_program_cache", 1, op="put")
+        self._evict_if_needed()
+
+    def _entries(self) -> List[Tuple[str, float, int]]:
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for n in names:
+            if not n.endswith(".aot"):
+                continue
+            p = os.path.join(self.dir, n)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            out.append((p, st.st_mtime, st.st_size))
+        return out
+
+    def resident_bytes(self) -> int:
+        return sum(sz for _, _, sz in self._entries())
+
+    def _evict_if_needed(self) -> None:
+        """Size-capped LRU over entry mtimes (hits os.utime their entry,
+        so 'oldest mtime' = least recently used)."""
+        entries = self._entries()
+        total = sum(sz for _, _, sz in entries)
+        if total > self.max_bytes:
+            for p, _, sz in sorted(entries, key=lambda t: t[1]):
+                if total <= self.max_bytes:
+                    break
+                try:
+                    os.unlink(p)
+                except OSError:
+                    continue
+                total -= sz
+                self.stats.bump("evictions")
+                if _events.enabled():
+                    _events.emit("program_cache", op="evict", site="",
+                                 key=os.path.basename(p)[:12], bytes=sz)
+                if _obs.enabled():
+                    _obs.inc("tpu_program_cache", 1, op="evict")
+        if _obs.enabled():
+            _obs.set_gauge("tpu_program_cache_resident_bytes", total)
+
+
+def _digest_of(key: Any) -> str:
+    """The 12-hex signature digest program_cost events carry — reused so
+    the profiler can join program_cache and program_cost records."""
+    from .. import xla_cost as _xla_cost
+
+    return _xla_cost.digest_of(key)
+
+
+# ---------------------------------------------------------------------------
+# The probes. Both defer real work to the FIRST call (the only moment
+# concrete arguments exist), exactly like xla_cost.CostProbe — and both
+# are defensive by design: no failure in here may fail a query.
+# ---------------------------------------------------------------------------
+class _StoreProbe:
+    """Miss-side shim: first call exports the jitted program, compiles
+    the *exported* module (seeding the JAX persistent compilation cache
+    with the very module a warm process will compile), harvests the cost
+    plane from it, persists everything, then serves every call from the
+    kept executable. Cold-path cost is the same one trace + one backend
+    compile a plain jit would have paid lazily."""
+
+    __slots__ = ("_cache", "_fn", "_site", "_key", "_digest", "_path",
+                 "_aux_b64", "_compiled", "_done", "_lock")
+
+    def __init__(self, cache: ProgramCache, fn: Callable, site: str,
+                 key: Any, digest: str, path: str,
+                 aux_b64: Optional[str]):
+        self._cache = cache
+        self._fn = fn
+        self._site = site
+        self._key = key
+        self._digest = digest
+        self._path = path
+        self._aux_b64 = aux_b64
+        self._compiled = None
+        self._done = False
+        self._lock = threading.Lock()
+
+    def __call__(self, *args, **kwargs):
+        if not self._done:
+            with self._lock:
+                if not self._done:
+                    try:
+                        self._export_compile_store(args, kwargs)
+                    except Exception:
+                        # program not exportable with this jax/backend:
+                        # permanent per-site fallback to the plain path.
+                        # Re-wrap in the cost plane so the site's
+                        # program_cost harvest (one per compile miss)
+                        # survives losing the cache.
+                        from .. import xla_cost as _xla_cost
+
+                        self._cache._unexportable.add(self._site)
+                        self._compiled = None
+                        self._fn = _xla_cost.wrap(
+                            self._fn, self._site, self._key)
+                    self._done = True
+        c = self._compiled
+        if c is not None:
+            try:
+                return c(*args, **kwargs)
+            except (TypeError, ValueError):
+                # signature the cache key under-captured: serve from the
+                # plain jit path from now on (the CostProbe contract)
+                self._compiled = None
+        return self._fn(*args, **kwargs)
+
+    def _export_compile_store(self, args, kwargs) -> None:
+        import jax
+        from jax import export as _export
+
+        from .. import hlo as _hlo
+        from .. import xla_cost as _xla_cost
+
+        _register_pytree_serialization()
+        t0 = time.perf_counter_ns()
+        exported = _export.export(self._fn)(*args, **kwargs)
+        blob = exported.serialize()
+        t1 = time.perf_counter_ns()
+        compiled = jax.jit(exported.call).lower(*args, **kwargs).compile()
+        t2 = time.perf_counter_ns()
+        cost = _xla_cost.harvest_compiled(compiled)
+        hlo_rec = None
+        if _xla_cost.harvesting():
+            rec = _xla_cost.note_program_cost(
+                self._site, self._digest, t1 - t0, t2 - t1, cost,
+                op=_xla_cost.current_op())
+            hlo_rec = _hlo.harvest_hlo(
+                compiled, self._site, self._digest, op=rec.get("op"),
+                xla_bytes=rec.get("bytes_accessed"))
+        self._compiled = compiled
+        header = self._cache.header_identity(self._site)
+        cost_payload = {k: v for k, v in cost.items() if v is not None}
+        cost_payload["trace_ms"] = round((t1 - t0) / 1e6, 3)
+        cost_payload["compile_ms"] = round((t2 - t1) / 1e6, 3)
+        op = _xla_cost.current_op()
+        if op:
+            cost_payload["op"] = op
+        header["cost"] = cost_payload
+        if hlo_rec is not None:
+            header["hlo"] = {
+                k: hlo_rec[k] for k in _hlo.SUMMARY_FIELDS}
+            if hlo_rec.get("accounted_frac") is not None:
+                header["hlo"]["accounted_frac"] = hlo_rec["accounted_frac"]
+        header["aux"] = self._aux_b64
+        header["blob_len"] = len(blob)
+        header["created"] = round(time.time(), 3)
+        self._cache.store(self._site, self._digest, self._path, header,
+                          blob)
+
+
+class _LoadProbe:
+    """Hit-side shim: the entry deserialized at lookup time; the first
+    call compiles the exported module (a JAX persistent-cache read when
+    the store side seeded it), re-emits the persisted cost + HLO
+    payloads flagged ``from_cache``, and serves every later call from
+    the kept executable. Any failure deletes the entry and falls back
+    to building + compiling the program exactly as a plain miss would
+    have — a poisoned cache can cost time, never correctness."""
+
+    __slots__ = ("_cache", "_exp", "_header", "_site", "_key", "_digest",
+                 "_path", "_build", "_deser_ns", "_compiled", "_fallback",
+                 "_done", "_lock")
+
+    def __init__(self, cache: ProgramCache, exported, header: dict,
+                 site: str, key: Any, digest: str, path: str,
+                 build: Callable[[], Any], deser_ns: int):
+        self._cache = cache
+        self._exp = exported
+        self._header = header
+        self._site = site
+        self._key = key
+        self._digest = digest
+        self._path = path
+        self._build = build
+        self._deser_ns = deser_ns
+        self._compiled = None
+        self._fallback: Optional[Callable] = None
+        self._done = False
+        self._lock = threading.Lock()
+
+    def __call__(self, *args, **kwargs):
+        if not self._done:
+            with self._lock:
+                if not self._done:
+                    try:
+                        self._compile_deserialized(args, kwargs)
+                    except Exception as e:
+                        self._to_fallback(
+                            f"{type(e).__name__}: {e}")
+                    self._done = True
+        c = self._compiled
+        if c is not None:
+            try:
+                return c(*args, **kwargs)
+            except (TypeError, ValueError) as e:
+                # args the entry's signature won't take (key drift):
+                # the real build handles them — and the entry is wrong
+                # for this key, so it goes. Under the lock: a racing
+                # caller must never observe _compiled cleared while
+                # _fallback is still unset.
+                with self._lock:
+                    self._compiled = None
+                    self._to_fallback(f"signature drift: {e}")
+        fb = self._fallback
+        if fb is None:
+            # concurrent caller caught mid-transition (another thread
+            # cleared _compiled and is building the fallback): wait on
+            # the lock, then the fallback is guaranteed present
+            with self._lock:
+                self._to_fallback("concurrent fallback")
+                fb = self._fallback
+        return fb(*args, **kwargs)
+
+    def _compile_deserialized(self, args, kwargs) -> None:
+        import jax
+
+        from .. import hlo as _hlo
+        from .. import xla_cost as _xla_cost
+
+        t0 = time.perf_counter_ns()
+        compiled = jax.jit(self._exp.call).lower(
+            *args, **kwargs).compile()
+        t1 = time.perf_counter_ns()
+        self._compiled = compiled
+        self._cache.stats.bump("deserialized")
+        self._cache.stats.bump(
+            "warm_ms", (self._deser_ns + t1 - t0) / 1e6)
+        if _events.enabled():
+            _events.emit("program_cache", op="deserialize",
+                         site=self._site, key=self._digest,
+                         bytes=self._header.get("blob_len", 0),
+                         ms=round((self._deser_ns + t1 - t0) / 1e6, 3))
+        if _obs.enabled():
+            _obs.inc("tpu_program_cache", 1, op="deserialize")
+        if not _xla_cost.harvesting():
+            return
+        # re-emit the PERSISTED cost payload so the roofline report /
+        # bench hbm_frac_xla / obs twins of a process that compiled
+        # nothing stay truthful: XLA bytes/flops come from the original
+        # harvest, trace/compile ms are THIS process's (near-zero)
+        # deserialize + cached-compile cost, saved_ms names the bill
+        # avoided, from_cache flags the provenance
+        persisted = self._header.get("cost") or {}
+        cost = {k: persisted.get(k) for k in _xla_cost.COST_FIELDS}
+        for k in ("out_bytes", "generated_code_bytes", "peak_hbm_gbps",
+                  "peak_tflops"):
+            if persisted.get(k) is not None:
+                cost[k] = persisted[k]
+        cost["from_cache"] = True
+        cost["saved_ms"] = round(
+            (persisted.get("trace_ms") or 0.0)
+            + (persisted.get("compile_ms") or 0.0), 3)
+        _xla_cost.note_program_cost(
+            self._site, self._digest, self._deser_ns, t1 - t0, cost,
+            op=_xla_cost.current_op() or persisted.get("op"))
+        if _obs.enabled():
+            _obs.inc("tpu_program_cache_saved_seconds",
+                     cost["saved_ms"] / 1e3)
+        hlo_payload = self._header.get("hlo")
+        if hlo_payload:
+            _hlo.note_cached_summary(
+                self._site, self._digest, dict(hlo_payload),
+                op=_xla_cost.current_op() or persisted.get("op"))
+
+    def _to_fallback(self, detail: str) -> None:
+        """The negative path: poison the entry, pay the plain compile
+        this process would have paid on a miss, keep serving. Caller
+        must hold ``self._lock`` (first-call path holds it; the drift
+        path takes it) — idempotent, so late racers are no-ops."""
+        from ..exec import base as _base
+        from .. import xla_cost as _xla_cost
+
+        self._compiled = None
+        if self._fallback is None:
+            self._cache._poison(self._path, self._site, detail)
+            _base.note_compile_miss(self._site)
+            built = self._build()
+            if isinstance(built, tuple):  # mesh aux rode the header;
+                built = built[0]          # callers already hold it
+            self._fallback = _xla_cost.wrap(built, self._site, self._key)
+
+
+# ---------------------------------------------------------------------------
+# Process-global active cache (the events/faults install pattern: the
+# pipeline-cache chokepoint lives where no session handle exists).
+# install() also hands the JAX persistent compilation cache its
+# directory — that is what turns a warm process's backend compile of a
+# deserialized module into a disk read.
+# ---------------------------------------------------------------------------
+_ENABLED = False
+_ACTIVE: Optional[ProgramCache] = None
+_INSTALL_LOCK = threading.Lock()
+_PREV_JAX_CACHE: Optional[tuple] = None
+
+
+def enabled() -> bool:
+    """The hot-path guard — one module-global boolean read, consulted
+    only on the pipeline-cache SLOW path (a fresh compile miss)."""
+    return _ENABLED
+
+
+def active() -> Optional[ProgramCache]:
+    return _ACTIVE
+
+
+def stats() -> Optional[Dict[str, Any]]:
+    """Live counters for /status and tpu_top (None while off)."""
+    pc = _ACTIVE
+    return pc.stats.to_json() if pc is not None else None
+
+
+def install(conf_: RapidsConf) -> Optional[ProgramCache]:
+    """Install the cache when the confs ask for one (aotCache.dir
+    implies aotCache.enabled, the eventLog pattern). Off — the default —
+    installs NOTHING: no directory access, no jax config change, no
+    threads. Idempotent for an identical (dir, identity) pair."""
+    want = conf_.get(AOT_CACHE_ENABLED) or conf_.get(AOT_CACHE_DIR)
+    if not want:
+        return None
+    global _ENABLED, _ACTIVE, _PREV_JAX_CACHE
+    with _INSTALL_LOCK:
+        cache = ProgramCache(conf_)
+        cur = _ACTIVE
+        if (cur is not None and cur.dir == cache.dir
+                and cur.conf_fp == cache.conf_fp
+                and cur.max_bytes == cache.max_bytes):
+            return cur  # same identity: keep the live stats
+        _register_pytree_serialization()
+        import jax
+
+        try:
+            if _PREV_JAX_CACHE is None:
+                _PREV_JAX_CACHE = (
+                    jax.config.jax_compilation_cache_dir,
+                    jax.config.jax_persistent_cache_min_entry_size_bytes,
+                    jax.config.jax_persistent_cache_min_compile_time_secs)
+            jax.config.update("jax_compilation_cache_dir",
+                              os.path.join(cache.dir, "xla"))
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+        except Exception:
+            # older jax without the persistent-cache knobs (the
+            # snapshot reads degrade too, not just the updates): export
+            # artifacts still skip the re-trace, the backend compile
+            # just isn't disk-cached
+            pass
+        _ACTIVE = cache
+        _ENABLED = True
+        return cache
+
+
+def uninstall() -> None:
+    """Detach the cache and restore the pre-install jax compilation
+    cache settings (tests pair install with this)."""
+    global _ENABLED, _ACTIVE, _PREV_JAX_CACHE
+    with _INSTALL_LOCK:
+        _ACTIVE = None
+        _ENABLED = False
+        if _PREV_JAX_CACHE is not None:
+            import jax
+
+            d, sz, secs = _PREV_JAX_CACHE
+            try:
+                jax.config.update("jax_compilation_cache_dir", d)
+                jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", sz)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", secs)
+            except Exception:
+                pass
+            _PREV_JAX_CACHE = None

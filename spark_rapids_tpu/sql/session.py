@@ -283,6 +283,14 @@ class TpuSession:
         # module-global boolean read. Never uninstalled implicitly;
         # tests pair install with faults.uninstall().
         _faults.install(self.conf)
+        # persistent AOT program cache (serve/program_cache.py): a no-op
+        # returning None with the aotCache.* confs off (the default) —
+        # no directory touched, no jax config change, the pipeline-cache
+        # fast path unchanged. Same lifecycle as the fault injector:
+        # process-global, tests pair install with uninstall().
+        from ..serve import program_cache as _progcache
+
+        _progcache.install(self.conf)
 
     def close(self) -> None:
         """Flush/close the session's event sink (atexit also covers a

@@ -259,7 +259,8 @@ def note_program_cost(site: str, digest: str, trace_ns: int,
               ("site", "digest", "backend", "trace_ms", "compile_ms")
               + COST_FIELDS}
         for k in ("op", "out_bytes", "generated_code_bytes",
-                  "peak_hbm_gbps", "peak_tflops"):
+                  "peak_hbm_gbps", "peak_tflops", "from_cache",
+                  "saved_ms"):
             if rec.get(k) is not None:
                 ev[k] = rec[k]
         _events.emit("program_cost", **ev)

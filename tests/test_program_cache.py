@@ -1,0 +1,693 @@
+"""Persistent AOT program cache (spark_rapids_tpu/serve/program_cache.py).
+
+Pins the ISSUE 15 contracts:
+  1. compile once, serve everywhere: a stored program deserializes on a
+     later (cleared-cache / second-session / second-process) run with
+     ZERO compile_miss events and row-exact results;
+  2. cache-key correctness: flipping any identity component (format
+     version, backend, device kind/count, jax version, conf
+     fingerprint) misses; same-everything hits; a key whose repr is not
+     process-stable never touches the directory;
+  3. negative paths never fail a query: truncated/corrupt entries and
+     version-mismatched headers are deleted and fall through to a plain
+     compile; a deserialized program rejecting this call's signature
+     falls back to the real build;
+  4. the ``aotcache`` fault channel (read:<site>/write:<site>) drives
+     both negative paths deterministically;
+  5. size-capped LRU eviction keeps the directory bounded;
+  6. the cost plane survives caching: warm runs re-emit the persisted
+     program_cost/hlo_summary payloads flagged from_cache (saved_ms
+     naming the avoided bill), feeding the roofline report, the
+     '== program cache ==' profiler section, and the obs twins;
+  7. zero overhead when off: conf off => no lookup, no store, no jax
+     config change, cached_pipeline's fast path untouched;
+  8. --diff: warm compile misses / a collapsed warm ratio / grown
+     compile_s_warm flag regressions in the bench cold_start lane.
+"""
+import importlib.util
+import json
+import os
+import struct
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from spark_rapids_tpu import events as EV
+from spark_rapids_tpu import faults as F
+from spark_rapids_tpu import obs
+from spark_rapids_tpu import xla_cost as XC
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.exec import base as B
+from spark_rapids_tpu.expr import aggregates as A
+from spark_rapids_tpu.expr import expressions as E
+from spark_rapids_tpu.expr.expressions import col, lit
+from spark_rapids_tpu.obs.registry import EVENT_BACKED_METRICS, METRICS, \
+    MetricsRegistry
+from spark_rapids_tpu.serve import program_cache as PC
+from spark_rapids_tpu.sql import TpuSession
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "tpu_profile", os.path.join(REPO, "tools", "tpu_profile.py"))
+tpu_profile = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(tpu_profile)
+
+
+@pytest.fixture(autouse=True)
+def clean_planes():
+    """Every test starts and ends with events/obs/faults/program-cache
+    uninstalled and the harvest hook off; uninstalling the cache also
+    restores the suite's own jax compilation-cache settings."""
+    EV.uninstall()
+    obs.uninstall()
+    F.uninstall()
+    PC.uninstall()
+    prev = XC.FORCE_HARVEST
+    XC.FORCE_HARVEST = False
+    yield
+    XC.FORCE_HARVEST = prev
+    EV.uninstall()
+    obs.uninstall()
+    F.uninstall()
+    PC.uninstall()
+
+
+def _query(sess, hi, mult):
+    """The pipeline caches are PROCESS-global: each test uses a unique
+    (hi, mult) pair, and BOTH ride in literals (literal values are part
+    of the bound-expression cache keys) so its cold run actually
+    compiles instead of inheriting another test's warm programs."""
+    df = (sess.range(0, hi)
+          .where(E.GreaterThanOrEqual(col("id"), lit(hi % 97)))
+          .select(col("id"),
+                  E.Alias(E.Multiply(col("id"), lit(mult)), "v"))
+          .agg(A.agg(A.Sum(col("v")), "s"), A.agg(A.Count(None), "c")))
+    return sorted(df.collect())
+
+
+def _conf(tmp_path, **extra):
+    return {"spark.rapids.tpu.aotCache.dir": str(tmp_path / "aot"),
+            **extra}
+
+
+def _entries(tmp_path):
+    d = str(tmp_path / "aot")
+    if not os.path.isdir(d):
+        return []
+    return sorted(f for f in os.listdir(d) if f.endswith(".aot"))
+
+
+# ---------------------------------------------------------------------------
+# 1. compile once, serve everywhere
+# ---------------------------------------------------------------------------
+def test_store_then_warm_hit_across_sessions(tmp_path):
+    s1 = TpuSession(_conf(tmp_path))
+    r1 = _query(s1, 1751, 3)
+    st = PC.stats()
+    assert st["puts"] >= 1 and st["hits"] == 0
+    assert _entries(tmp_path)
+    # a fresh process = empty in-memory pipeline caches; simulate with
+    # the sanctioned sweep, then a SECOND session over the same dir
+    B.clear_pipeline_caches()
+    m0 = B.compile_miss_count()
+    s2 = TpuSession(_conf(tmp_path))
+    r2 = _query(s2, 1751, 3)
+    st = PC.stats()
+    assert B.compile_miss_count() == m0, "warm run must not compile"
+    assert st["hits"] >= 1 and st["deserialized"] >= 1
+    assert st["saved_ms"] > 0
+    assert r1 == r2
+
+
+def test_warm_rows_match_cache_off_oracle(tmp_path):
+    s1 = TpuSession(_conf(tmp_path))
+    _query(s1, 1753, 5)
+    B.clear_pipeline_caches()
+    warm = _query(TpuSession(_conf(tmp_path)), 1753, 5)
+    assert PC.stats()["deserialized"] >= 1
+    PC.uninstall()
+    B.clear_pipeline_caches()
+    oracle = _query(TpuSession({}), 1753, 5)
+    assert warm == oracle
+
+
+@pytest.mark.slow
+def test_cross_process_second_run_compiles_nothing(tmp_path):
+    """The ROADMAP 5(a) success metric, literally: a second process over
+    a warm cache dir reports zero compile misses and serves every
+    program from_cache."""
+    script = tmp_path / "child.py"
+    script.write_text(f"""
+import sys, os, json
+sys.path.insert(0, {REPO!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from spark_rapids_tpu.sql import TpuSession
+from spark_rapids_tpu.expr import aggregates as A
+from spark_rapids_tpu.expr import expressions as E
+from spark_rapids_tpu.expr.expressions import col, lit
+from spark_rapids_tpu.exec import base as B
+from spark_rapids_tpu import xla_cost
+xla_cost.FORCE_HARVEST = True
+sess = TpuSession({{"spark.rapids.tpu.aotCache.dir": {str(tmp_path / 'aot')!r}}})
+df = (sess.range(0, 1759)
+      .where(E.GreaterThanOrEqual(col("id"), lit(7)))
+      .select(col("id"), E.Alias(E.Multiply(col("id"), lit(3)), "v"))
+      .agg(A.agg(A.Sum(col("v")), "s")))
+rows = sorted(df.collect())
+recs = xla_cost.records_since(0)
+print(json.dumps({{
+    "misses": B.compile_miss_count(),
+    "rows": rows,
+    "from_cache": sum(1 for r in recs if r.get("from_cache")),
+    "compile_s": sum((r.get("trace_ms") or 0) + (r.get("compile_ms") or 0)
+                     for r in recs) / 1e3,
+}}))
+""")
+
+    def run():
+        p = subprocess.run([sys.executable, str(script)],
+                           capture_output=True, text=True, cwd=REPO)
+        assert p.returncode == 0, p.stderr[-2000:]
+        return json.loads(p.stdout.strip().splitlines()[-1])
+
+    cold = run()
+    warm = run()
+    assert cold["misses"] > 0 and cold["from_cache"] == 0
+    assert warm["misses"] == 0, "second process must compile nothing"
+    assert warm["from_cache"] >= 1
+    assert warm["rows"] == cold["rows"]
+    assert warm["compile_s"] < cold["compile_s"]
+
+
+# ---------------------------------------------------------------------------
+# 2. cache-key correctness
+# ---------------------------------------------------------------------------
+def test_entry_name_flips_on_every_identity_component(tmp_path):
+    conf = RapidsConf(_conf(tmp_path))
+    base = PC.ProgramCache(conf)
+    key = (("project", "p1"), ("bigint", 2048), 2048)
+    name = base.entry_name("fused_chain", key)
+    assert name is not None and name.endswith(".aot")
+    # same everything -> same name (a second process recomputes it)
+    assert PC.ProgramCache(conf).entry_name("fused_chain", key) == name
+    # flip one component at a time -> different name
+    for attr, val in (("backend", "tpu"), ("device_kind", "v5e"),
+                      ("device_count", 1 + (base.device_count or 0)),
+                      ("jax_version", "99.0"),
+                      ("conf_fp", "deadbeef")):
+        other = PC.ProgramCache(conf)
+        setattr(other, attr, val)
+        assert other.entry_name("fused_chain", key) != name, attr
+    # different site / different pipeline key -> different name
+    assert base.entry_name("agg_plan", key) != name
+    assert base.entry_name("fused_chain", key + (1,)) != name
+
+
+def test_unstable_key_repr_never_touches_disk(tmp_path):
+    conf = RapidsConf(_conf(tmp_path))
+    cache = PC.ProgramCache(conf)
+    assert cache.entry_name("site", (object(),)) is None
+    PC.install(RapidsConf(_conf(tmp_path)))
+    store: dict = {}
+    fn = B.cached_pipeline(store, (object(), 1), "unit_unstable",
+                           lambda: jax.jit(lambda x: x + 1))
+    assert fn(jnp.ones((4,), jnp.int32))[0] == 2
+    assert _entries(tmp_path) == []
+
+
+def test_conf_fingerprint_ignores_observability_confs(tmp_path):
+    fp = PC.program_conf_fingerprint
+    a = RapidsConf(_conf(tmp_path))
+    b = RapidsConf(_conf(tmp_path,
+                         **{"spark.rapids.tpu.eventLog.dir": "/tmp/x",
+                            "spark.rapids.tpu.metrics.http.enabled": True}))
+    assert fp(a) == fp(b), "observability confs must not shatter the key"
+    c = RapidsConf(_conf(tmp_path,
+                         **{"spark.rapids.tpu.sql.agg.strategy": "SORT"}))
+    assert fp(a) != fp(c), "engine-shaping confs must key apart"
+
+
+def test_conf_flip_misses_same_structural_key(tmp_path):
+    s1 = TpuSession(_conf(tmp_path))
+    _query(s1, 1761, 3)
+    assert PC.stats()["puts"] >= 1
+    B.clear_pipeline_caches()
+    # join.strategy is irrelevant to this agg-only plan (identical
+    # structural pipeline keys) but explicitly set -> new fingerprint
+    s2 = TpuSession(_conf(
+        tmp_path, **{"spark.rapids.tpu.sql.join.strategy": "DIRECT"}))
+    _query(s2, 1761, 3)
+    st = PC.stats()
+    assert st["hits"] == 0 and st["misses"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# 3. negative paths
+# ---------------------------------------------------------------------------
+def _corrupt_all(tmp_path, data=b"garbage"):
+    for f in _entries(tmp_path):
+        with open(os.path.join(str(tmp_path / "aot"), f), "wb") as fh:
+            fh.write(data)
+
+
+def test_corrupt_entry_deleted_and_query_succeeds(tmp_path):
+    s1 = TpuSession(_conf(tmp_path))
+    r1 = _query(s1, 1763, 3)
+    _corrupt_all(tmp_path)
+    B.clear_pipeline_caches()
+    m0 = B.compile_miss_count()
+    r2 = _query(TpuSession(_conf(tmp_path)), 1763, 3)
+    st = PC.stats()
+    assert r2 == r1
+    assert st["corrupt"] >= 1
+    assert B.compile_miss_count() > m0, "fell through to plain compiles"
+    # poisoned entries were deleted, then re-stored by the fallback...
+    # no: the fallback path is a plain compile+store-probe MISS path
+    # only on the NEXT miss; the poisoned files themselves must be gone
+    # or replaced by fresh valid entries (re-put on this run)
+    for f in _entries(tmp_path):
+        p = os.path.join(str(tmp_path / "aot"), f)
+        assert os.path.getsize(p) > len(b"garbage")
+
+
+def test_truncated_entry_is_poisoned(tmp_path):
+    s1 = TpuSession(_conf(tmp_path))
+    _query(s1, 1767, 3)
+    _corrupt_all(tmp_path, b"\x00\x01")  # shorter than the length header
+    B.clear_pipeline_caches()
+    r = _query(TpuSession(_conf(tmp_path)), 1767, 3)
+    assert r and PC.stats()["corrupt"] >= 1
+
+
+def test_version_stamp_mismatch_invalidates(tmp_path):
+    s1 = TpuSession(_conf(tmp_path))
+    _query(s1, 1769, 3)
+    d = str(tmp_path / "aot")
+    names = _entries(tmp_path)
+    assert names
+    # rewrite each entry with a bumped format version but intact blob:
+    # the explicit header check must reject it even at the same path
+    for n in names:
+        p = os.path.join(d, n)
+        with open(p, "rb") as fh:
+            raw = fh.read()
+        (hlen,) = struct.unpack(">Q", raw[:8])
+        header = json.loads(raw[8:8 + hlen].decode())
+        header["version"] = PC.FORMAT_VERSION + 1
+        hb = json.dumps(header, separators=(",", ":"),
+                        sort_keys=True).encode()
+        with open(p, "wb") as fh:
+            fh.write(struct.pack(">Q", len(hb)) + hb + raw[8 + hlen:])
+    B.clear_pipeline_caches()
+    r = _query(TpuSession(_conf(tmp_path)), 1769, 3)
+    st = PC.stats()
+    assert r and st["corrupt"] >= len(names) and st["deserialized"] == 0
+
+
+def test_signature_drift_falls_back_to_build(tmp_path):
+    """A deserialized executable that rejects this call's arguments
+    (the key under-captured the signature) must fall back to the real
+    build and poison the entry."""
+    from jax import export as _export
+
+    PC.install(RapidsConf(_conf(tmp_path)))
+    cache = PC.active()
+    fn4 = jax.jit(lambda x: x * 2)
+    exported = _export.export(fn4)(jnp.ones((4,), jnp.float32))
+    path = os.path.join(cache.dir, "drift.aot")
+    with open(path, "wb") as fh:
+        fh.write(b"placeholder")  # only existence matters to _poison
+    probe = PC._LoadProbe(
+        cache, exported, {"cost": {}}, "unit_drift", ("k",), "d1", path,
+        lambda: jax.jit(lambda x: x * 2), 0)
+    out = probe(jnp.ones((8,), jnp.float32))  # wrong shape for the entry
+    assert out.shape == (8,) and float(out[0]) == 2.0
+    assert not os.path.exists(path), "drifted entry must be deleted"
+
+
+# ---------------------------------------------------------------------------
+# 4. fault injection (the aotcache channel)
+# ---------------------------------------------------------------------------
+def test_fault_read_channel_poisons_deterministically(tmp_path):
+    s1 = TpuSession(_conf(tmp_path))
+    r1 = _query(s1, 1771, 3)
+    n_entries = len(_entries(tmp_path))
+    assert n_entries >= 1
+    B.clear_pipeline_caches()
+    sess = TpuSession(_conf(
+        tmp_path, **{"spark.rapids.tpu.test.faults.aotcache": "read:*"}))
+    r2 = _query(sess, 1771, 3)
+    st = PC.stats()
+    assert r2 == r1, "an injected read fault must never fail a query"
+    assert st["corrupt"] >= 1 and st["deserialized"] == 0
+    assert any(ch == "aotcache" for ch, _, _ in F.active().fired())
+
+
+def test_fault_write_channel_skips_store(tmp_path):
+    sess = TpuSession(_conf(
+        tmp_path, **{"spark.rapids.tpu.test.faults.aotcache": "write:*"}))
+    r = _query(sess, 1773, 3)
+    st = PC.stats()
+    assert r, "an injected write fault must never fail a query"
+    assert st["write_errors"] >= 1 and st["puts"] == 0
+    assert _entries(tmp_path) == []
+
+
+# ---------------------------------------------------------------------------
+# 5. eviction
+# ---------------------------------------------------------------------------
+def test_lru_eviction_bounds_the_directory(tmp_path):
+    sess = TpuSession(_conf(
+        tmp_path, **{"spark.rapids.tpu.aotCache.maxBytes": 2000}))
+    _query(sess, 1777, 3)
+    st = PC.stats()
+    assert st["evictions"] >= 1
+    assert PC.active().resident_bytes() <= 2000
+
+
+def test_lru_prefers_evicting_least_recently_used(tmp_path):
+    PC.install(RapidsConf(_conf(tmp_path)))
+    cache = PC.active()
+    old = os.path.join(cache.dir, "a" * 40 + ".aot")
+    new = os.path.join(cache.dir, "b" * 40 + ".aot")
+    for p in (old, new):
+        with open(p, "wb") as fh:
+            fh.write(b"x" * 600)
+    os.utime(old, times=(1, 1))  # least recently used
+    cache.max_bytes = 1000
+    cache._evict_if_needed()
+    assert not os.path.exists(old) and os.path.exists(new)
+    assert cache.stats.evictions == 1
+
+
+# ---------------------------------------------------------------------------
+# 6. the cost plane survives caching
+# ---------------------------------------------------------------------------
+def _run_logged(tmp_path, hi, log_sub):
+    log_dir = tmp_path / log_sub
+    sess = TpuSession(_conf(
+        tmp_path, **{"spark.rapids.tpu.eventLog.dir": str(log_dir)}))
+    _query(sess, hi, 3)
+    sess.close()
+    recs = []
+    for f in os.listdir(log_dir):
+        if f.endswith(".jsonl"):
+            with open(log_dir / f) as fh:
+                recs.extend(json.loads(ln) for ln in fh if ln.strip())
+    return recs
+
+
+def test_warm_run_reemits_cost_flagged_from_cache(tmp_path):
+    cold = _run_logged(tmp_path, 1779, "log-cold")
+    cold_costs = [r for r in cold if r["event"] == "program_cost"]
+    assert cold_costs and not any(r.get("from_cache") for r in cold_costs)
+    assert any(r["event"] == "program_cache" and r["op"] == "put"
+               for r in cold)
+    B.clear_pipeline_caches()
+    warm = _run_logged(tmp_path, 1779, "log-warm")
+    assert not any(r["event"] == "compile_miss" for r in warm)
+    warm_costs = [r for r in warm if r["event"] == "program_cost"]
+    assert warm_costs and all(r.get("from_cache") for r in warm_costs)
+    for r in warm_costs:
+        assert r.get("saved_ms", 0) > 0
+        # near-zero warm bill: deserialize + cached compile, a fraction
+        # of the persisted original
+        assert (r["trace_ms"] + r["compile_ms"]) < r["saved_ms"]
+    # persisted XLA byte figures re-emitted so the roofline stays fed
+    cold_bytes = {r["digest"]: r.get("bytes_accessed")
+                  for r in cold_costs}
+    for r in warm_costs:
+        if cold_bytes.get(r["digest"]) is not None:
+            assert r.get("bytes_accessed") == cold_bytes[r["digest"]]
+    # hlo payloads ride along when the original harvest parsed one
+    if any(r["event"] == "hlo_summary" for r in cold):
+        warm_hlo = [r for r in warm if r["event"] == "hlo_summary"]
+        assert warm_hlo and all(r.get("from_cache") for r in warm_hlo)
+    # schema: every program_cache event carries its required fields
+    for r in warm + cold:
+        if r["event"] == "program_cache":
+            for field in EV.EVENT_TYPES["program_cache"]:
+                assert field in r, (field, r)
+
+
+def test_profile_section_reports_hits_and_avoided_seconds(tmp_path):
+    _run_logged(tmp_path, 1783, "log-cold")
+    B.clear_pipeline_caches()
+    warm = _run_logged(tmp_path, 1783, "log-warm")
+    report, violations = tpu_profile.build_report(warm)
+    assert violations == 0
+    assert "== program cache ==" in report
+    sec = report.split("== program cache ==")[1].split("==")[0]
+    assert "hit=" in sec and "deserialize=" in sec
+    assert "avoided" in sec
+    assert "served from the AOT cache" in report  # roofline annotation
+
+
+def test_obs_twins_count_cache_ops(tmp_path):
+    assert EVENT_BACKED_METRICS["program_cache"] == "tpu_program_cache"
+    assert "tpu_program_cache" in METRICS
+    reg = MetricsRegistry()
+    obs.install(reg)
+    sess = TpuSession(_conf(tmp_path))
+    _query(sess, 1787, 3)
+    assert reg.value("tpu_program_cache", op="put") >= 1
+    B.clear_pipeline_caches()
+    _query(TpuSession(_conf(tmp_path)), 1787, 3)
+    assert reg.value("tpu_program_cache", op="hit") >= 1
+    assert reg.value("tpu_program_cache", op="deserialize") >= 1
+    assert reg.value("tpu_program_cache_saved_seconds") > 0
+
+
+def test_status_and_top_render_cache_counters(tmp_path):
+    from spark_rapids_tpu.obs.progress import ProgressTracker
+    from spark_rapids_tpu.obs.server import build_status
+
+    sess = TpuSession(_conf(tmp_path))
+    _query(sess, 1789, 3)
+    status = build_status(MetricsRegistry(), ProgressTracker(), None)
+    assert status["program_cache"]["puts"] >= 1
+    json.dumps(status)  # must stay plain-JSON
+    _spec2 = importlib.util.spec_from_file_location(
+        "tpu_top", os.path.join(REPO, "tools", "tpu_top.py"))
+    tpu_top = importlib.util.module_from_spec(_spec2)
+    _spec2.loader.exec_module(tpu_top)
+    frame = tpu_top.render_status(status)
+    assert "AOT cache:" in frame
+
+
+# ---------------------------------------------------------------------------
+# 7. zero overhead when off
+# ---------------------------------------------------------------------------
+def test_off_no_lookup_no_store_no_config_change(monkeypatch, tmp_path):
+    def boom(*a, **k):
+        raise AssertionError("program cache consulted while off")
+
+    monkeypatch.setattr(PC.ProgramCache, "lookup", boom)
+    monkeypatch.setattr(PC.ProgramCache, "wrap_store", boom)
+    before = jax.config.jax_compilation_cache_dir
+    assert not PC.enabled()
+    sess = TpuSession({})  # cache conf off
+    assert _query(sess, 1793, 3)
+    assert jax.config.jax_compilation_cache_dir == before
+    assert not os.path.exists(str(tmp_path / "aot"))
+    assert PC.install(RapidsConf({})) is None
+
+
+def test_uninstall_restores_jax_cache_config(tmp_path):
+    before = jax.config.jax_compilation_cache_dir
+    PC.install(RapidsConf(_conf(tmp_path)))
+    assert jax.config.jax_compilation_cache_dir == os.path.join(
+        str(tmp_path / "aot"), "xla")
+    PC.uninstall()
+    assert jax.config.jax_compilation_cache_dir == before
+
+
+# ---------------------------------------------------------------------------
+# 8. the mesh tuple path + single-flight + diff gates
+# ---------------------------------------------------------------------------
+def test_tuple_path_roundtrips_aux(tmp_path):
+    PC.install(RapidsConf(_conf(tmp_path)))
+    store: dict = {}
+    key = ("unit_tuple", 4)
+
+    def build():
+        return jax.jit(lambda x: x + 1), ("layout", 4)
+
+    fn, aux = B.cached_pipeline(store, key, "unit_tuple_site", build)
+    assert aux == ("layout", 4)
+    assert float(fn(jnp.ones((4,), jnp.float32))[0]) == 2.0
+    assert _entries(tmp_path)
+    store.clear()
+    fn2, aux2 = B.cached_pipeline(
+        store, key, "unit_tuple_site",
+        lambda: (_ for _ in ()).throw(AssertionError("must not rebuild")))
+    assert aux2 == ("layout", 4)
+    assert float(fn2(jnp.ones((4,), jnp.float32))[0]) == 2.0
+    assert PC.stats()["hits"] >= 1
+
+
+def test_corrupt_aux_pickle_poisons_instead_of_raising(tmp_path):
+    """A tuple-path entry whose aux payload is corrupt must be treated
+    exactly like any other corruption: poisoned + plain compile, never
+    an exception out of lookup()."""
+    PC.install(RapidsConf(_conf(tmp_path)))
+    store: dict = {}
+    key = ("unit_badaux", 1)
+    fn, aux = B.cached_pipeline(
+        store, key, "unit_badaux_site",
+        lambda: (jax.jit(lambda x: x + 5), ("aux",)))
+    assert float(fn(jnp.ones((4,), jnp.float32))[0]) == 6.0
+    names = _entries(tmp_path)
+    assert names
+    d = str(tmp_path / "aot")
+    for n in names:
+        p = os.path.join(d, n)
+        with open(p, "rb") as fh:
+            raw = fh.read()
+        (hlen,) = struct.unpack(">Q", raw[:8])
+        header = json.loads(raw[8:8 + hlen].decode())
+        header["aux"] = "!!!not-base64-pickle!!!"
+        hb = json.dumps(header, separators=(",", ":"),
+                        sort_keys=True).encode()
+        with open(p, "wb") as fh:
+            fh.write(struct.pack(">Q", len(hb)) + hb + raw[8 + hlen:])
+    store.clear()
+    fn2, aux2 = B.cached_pipeline(
+        store, key, "unit_badaux_site",
+        lambda: (jax.jit(lambda x: x + 5), ("aux",)))
+    assert float(fn2(jnp.ones((4,), jnp.float32))[0]) == 6.0
+    assert aux2 == ("aux",)
+    assert PC.stats()["corrupt"] >= 1
+
+
+def test_unexportable_program_keeps_cost_plane(monkeypatch, tmp_path):
+    """A program jax.export rejects must fall back to a PLAIN compile
+    that still harvests its program_cost (one per miss) — losing the
+    cache must not also lose the roofline."""
+    from jax import export as jax_export
+
+    PC.install(RapidsConf(_conf(tmp_path)))
+    XC.FORCE_HARVEST = True
+
+    def boom(fn, **kw):
+        raise ValueError("synthetically unexportable")
+
+    monkeypatch.setattr(jax_export, "export", boom)
+    seq0 = XC.snapshot()
+    store: dict = {}
+    fn = B.cached_pipeline(store, ("unit_unexp", 1), "unit_unexp_site",
+                           lambda: jax.jit(lambda x: x * 3))
+    assert float(fn(jnp.ones((4,), jnp.float32))[0]) == 3.0
+    recs = XC.records_since(seq0)
+    assert any(r["site"] == "unit_unexp_site"
+               and not r.get("from_cache") for r in recs)
+    assert "unit_unexp_site" in PC.active()._unexportable
+    assert _entries(tmp_path) == []
+    # later misses at the marked site skip the export attempt entirely
+    fn2 = B.cached_pipeline(store, ("unit_unexp", 2), "unit_unexp_site",
+                            lambda: jax.jit(lambda x: x * 4))
+    assert float(fn2(jnp.ones((4,), jnp.float32))[0]) == 4.0
+
+
+@pytest.mark.slow
+@pytest.mark.cpu_only
+def test_mesh_shard_map_program_roundtrips(tmp_path):
+    """The mesh ``_cached_program`` tuple path participates for real: a
+    shard_map SPMD aggregate stores (aux layouts pickled into the
+    header), deserializes on a cleared-cache rerun with zero compile
+    misses, and stays row-exact. Sharded arguments carry the device
+    context jax.export needs."""
+    from spark_rapids_tpu import types as T
+
+    conf = _conf(tmp_path, **{
+        "spark.rapids.tpu.shuffle.mode": "ici",
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1})
+    schema = T.StructType([T.StructField("k", T.INT),
+                           T.StructField("v", T.LONG)])
+    data = {"k": [i % 9 for i in range(700)],
+            "v": [i * 5 - 701 for i in range(700)]}
+
+    def run():
+        s = TpuSession(conf)
+        df = s.create_dataframe(data, schema, num_partitions=4)
+        return sorted(df.group_by("k")
+                      .agg(A.agg(A.Sum(col("v")), "sv"),
+                           A.agg(A.Count(None), "n")).collect()), s
+
+    r1, s1 = run()
+    assert "Mesh" in s1.last_executed_plan.tree_string()
+    assert PC.stats()["puts"] >= 1, "mesh program must store"
+    B.clear_pipeline_caches()
+    m0 = B.compile_miss_count()
+    r2, _ = run()
+    assert B.compile_miss_count() == m0
+    assert PC.stats()["deserialized"] >= 1
+    assert r1 == r2
+
+
+def test_store_single_flight_lockfile(tmp_path):
+    PC.install(RapidsConf(_conf(tmp_path)))
+    cache = PC.active()
+    path = os.path.join(cache.dir, "c" * 40 + ".aot")
+    header = cache.header_identity("unit_sf")
+    header["blob_len"] = 3
+    # fresh lock held by "another process": the store is skipped
+    with open(path + ".lock", "w"):
+        pass
+    cache.store("unit_sf", "d1", path, dict(header), b"abc")
+    assert not os.path.exists(path)
+    # stale lock (a crashed writer): reclaimed, store proceeds
+    os.utime(path + ".lock", times=(1, 1))
+    cache.store("unit_sf", "d1", path, dict(header), b"abc")
+    assert os.path.exists(path)
+    assert not os.path.exists(path + ".lock")
+
+
+def _cold_row(**over):
+    row = {"compile_s_cold": 4.0, "compile_s_warm": 0.3,
+           "warm_ratio": 0.075, "compile_miss_cold": 3,
+           "compile_miss_warm": 0, "from_cache_warm": 3}
+    row.update(over)
+    return row
+
+
+def test_diff_gates_cold_start_lane():
+    old = {"cold_start": {"agg": _cold_row()}}
+    # clean new run: no regressions
+    _, n = tpu_profile.diff_bench(
+        old, {"cold_start": {"agg": _cold_row()}}, 0.25)
+    assert n == 0
+    # warm compile misses = the cache stopped hitting
+    _, n = tpu_profile.diff_bench(
+        old, {"cold_start": {"agg": _cold_row(compile_miss_warm=2)}}, 0.25)
+    assert n >= 1
+    # collapsed warm ratio
+    _, n = tpu_profile.diff_bench(
+        old, {"cold_start": {"agg": _cold_row(
+            compile_s_warm=3.6, warm_ratio=0.9)}}, 0.25)
+    assert n >= 1
+    # grown warm compile seconds vs the old round
+    _, n = tpu_profile.diff_bench(
+        old, {"cold_start": {"agg": _cold_row(
+            compile_s_warm=1.2, warm_ratio=0.3)}}, 0.25)
+    assert n >= 1
+    # a steady residual miss (timing-dependent keys, e.g. the parquet
+    # packed upload) is NOT a regression: same count as the old round
+    _, n = tpu_profile.diff_bench(
+        {"cold_start": {"pq": _cold_row(compile_miss_warm=1)}},
+        {"cold_start": {"pq": _cold_row(compile_miss_warm=1)}}, 0.25)
+    assert n == 0
+    # no baseline: misses flag only when the cache served NOTHING
+    _, n = tpu_profile.diff_bench(
+        {}, {"cold_start": {"agg": _cold_row(
+            compile_miss_warm=1, from_cache_warm=2)}}, 0.25)
+    assert n == 0
+    _, n = tpu_profile.diff_bench(
+        {}, {"cold_start": {"agg": _cold_row(
+            compile_miss_warm=3, from_cache_warm=0,
+            compile_s_warm=3.9, warm_ratio=0.975)}}, 0.25)
+    assert n >= 1

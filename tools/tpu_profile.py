@@ -139,7 +139,8 @@ def _is_bench_json(path: str) -> bool:
     try:
         with open(path) as f:
             head = f.read(1 << 20)
-        return "per_shape" in head and path.endswith(".json")
+        return (("per_shape" in head or "cold_start" in head)
+                and path.endswith(".json"))
     except OSError:
         return False
 
@@ -397,6 +398,16 @@ def roofline_section(events: List[dict], queries: List[dict],
                  f"TFLOP/s (backend {backend or '?'}; override with "
                  "spark.rapids.tpu.roofline.peakHbmGBps/.peakTflops or "
                  "--peak-hbm-gbps/--peak-tflops)")
+    cached_n = sum(1 for r in costs if r.get("from_cache"))
+    if cached_n:
+        # AOT program cache (serve/program_cache.py): these programs'
+        # bytes/flops are the ORIGINAL harvest re-emitted on a
+        # deserialize hit; their compile_ms is this process's near-zero
+        # warm cost, so per-site compile seconds read honestly
+        lines.append(f"  {cached_n}/{len(costs)} program(s) served "
+                     "from the AOT cache (bytes/flops persisted at "
+                     "original compile; compile ms = warm deserialize "
+                     "cost)")
     # which sites claim each op: ops claimed by >1 site get ONE combined
     # achieved line (the op's lane is one denominator, not one per site)
     op_claims: Dict[str, set] = {}
@@ -807,6 +818,48 @@ def build_report(events: List[dict], top_n: int = 10,
     for op, (n, b) in sorted(sc.items()):
         lines.append(f"  {op}: {n} ({_mb(b)})")
 
+    # persistent AOT program cache (serve/program_cache.py): lifecycle
+    # counts per op, warm compile cost actually paid, and the
+    # compile-seconds-avoided estimate from the persisted cost payloads
+    # riding the from_cache program_cost events. A warm serving process
+    # should read hits ~= deserializes, zero compile misses above, and
+    # avoided >> paid.
+    pc_ops: Dict[str, List[float]] = defaultdict(lambda: [0, 0.0])
+    for r in events:
+        if r.get("event") == "program_cache":
+            t = pc_ops[r["op"]]
+            t[0] += 1
+            t[1] += r.get("bytes") or 0
+    warm_paid_ms = 0.0
+    saved_ms = 0.0
+    from_cache_n = 0
+    for r in events:
+        if r.get("event") == "program_cost" and r.get("from_cache"):
+            from_cache_n += 1
+            warm_paid_ms += ((r.get("trace_ms") or 0)
+                             + (r.get("compile_ms") or 0))
+            saved_ms += r.get("saved_ms") or 0
+    lines.append("== program cache ==")
+    if not pc_ops:
+        lines.append("  no activity (spark.rapids.tpu.aotCache off)")
+    else:
+        lines.append("  " + ", ".join(
+            f"{op}={int(n)}" for op, (n, _) in sorted(pc_ops.items())))
+        for op in ("hit", "put"):
+            if op in pc_ops and pc_ops[op][1]:
+                lines.append(f"  {op} bytes: {_mb(pc_ops[op][1])}")
+        if from_cache_n:
+            lines.append(
+                f"  {from_cache_n} program(s) served from cache: paid "
+                f"{warm_paid_ms / 1e3:.2f}s (deserialize + cached "
+                f"compile), avoided ~{saved_ms / 1e3:.2f}s of original "
+                "trace+compile (persisted payload estimate)")
+        corrupt = int(pc_ops.get("corrupt", [0, 0])[0])
+        if corrupt:
+            lines.append(f"  NOTE: {corrupt} poisoned entr"
+                         f"{'y' if corrupt == 1 else 'ies'} deleted "
+                         "(fell through to plain compiles)")
+
     # aggregation strategy choices (one 'agg_strategy' event per exec per
     # capacity): the chooser on the record — compare against the top-ops
     # table above to see whether the pick was right
@@ -1097,6 +1150,65 @@ def diff_bench(old: dict, new: dict, threshold: float
             elif ka or kb:
                 lines.append(f"  {shape}.hlo_scatter_count: ok {ka} -> "
                              f"{kb}")
+    # cold-start lane (bench.py --cold-start): the warm-cache compile
+    # seconds are the serving-restart bill, and they must stay ~zero.
+    # Structural gates on the new run alone (meaningful across
+    # environments): a warm run that counted compile misses means the
+    # AOT cache stopped hitting, and a warm/cold ratio above 0.5 means
+    # deserialize+cached-compile stopped being cheap. Relative gate vs
+    # the old round: compile_s_warm growth beyond the threshold.
+    ca, cb = old.get("cold_start"), new.get("cold_start")
+    if cb:
+        for shape, row in sorted(cb.items()):
+            if not isinstance(row, dict):
+                continue
+            misses = row.get("compile_miss_warm") or 0
+            old_row = (ca or {}).get(shape)
+            old_row = old_row if isinstance(old_row, dict) else None
+            # a site with timing-dependent keys (the parquet packed
+            # upload) legitimately carries a residual warm miss every
+            # round — gate on GROWTH vs the old round, or (with no
+            # baseline) on the cache having served nothing at all
+            if old_row is not None:
+                miss_bad = misses > (old_row.get("compile_miss_warm")
+                                     or 0)
+            else:
+                miss_bad = misses and not row.get("from_cache_warm")
+            if miss_bad:
+                regressions += 1
+                lines.append(
+                    f"  cold_start.{shape}: REGRESSION {misses} warm "
+                    "compile miss(es) — the AOT cache stopped hitting")
+            ratio = row.get("warm_ratio")
+            if ratio is not None and ratio > 0.5:
+                regressions += 1
+                lines.append(
+                    f"  cold_start.{shape}: REGRESSION warm/cold "
+                    f"compile ratio {ratio:.2f} > 0.5 (deserialize no "
+                    "longer avoids the compile bill)")
+            wa = ((ca or {}).get(shape) or {}).get("compile_s_warm") \
+                if isinstance((ca or {}).get(shape), dict) else None
+            wb = row.get("compile_s_warm")
+            if wa and wb is not None:
+                if wb > wa * (1.0 + threshold) \
+                        and (wb - wa) * 1e3 > DIFF_MIN_MS:
+                    regressions += 1
+                    lines.append(
+                        f"  cold_start.{shape}.compile_s_warm: "
+                        f"REGRESSION {wa:.2f}s -> {wb:.2f}s")
+                else:
+                    lines.append(
+                        f"  cold_start.{shape}.compile_s_warm: ok "
+                        f"{wa:.2f}s -> {wb:.2f}s")
+            elif wb is not None and not misses and (
+                    ratio is None or ratio <= 0.5):
+                lines.append(
+                    f"  cold_start.{shape}: ok warm {wb:.2f}s"
+                    + (f" ({ratio:.2f}x of cold)"
+                       if ratio is not None else ""))
+    elif ca:
+        lines.append("  cold_start: lane missing from new run (run "
+                     "bench.py --cold-start to compare)")
     # serving lane (bench.py --serve): structural gates always — the new
     # run must be internally clean (ok flag: no errors/rejects/bypass,
     # summed forecasts within budget) and must still beat serialized

@@ -112,6 +112,19 @@ def render_status(status: dict, clock: str = "") -> str:
                 f"waited {q.get('waited_ms', 0):.0f}ms — "
                 f"{q.get('reason')}")
 
+    pc = status.get("program_cache")
+    if pc:
+        lines.append(
+            f"AOT cache: {pc.get('hits', 0)} hit / "
+            f"{pc.get('misses', 0)} miss / {pc.get('puts', 0)} put"
+            + (f" / {pc.get('evictions', 0)} evict"
+               if pc.get("evictions") else "")
+            + (f" / {pc.get('corrupt', 0)} corrupt"
+               if pc.get("corrupt") else "")
+            + (f"  saved ~{pc.get('saved_ms', 0) / 1e3:.1f}s compile "
+               f"(paid {pc.get('warm_ms', 0) / 1e3:.2f}s warm)"
+               if pc.get("hits") else ""))
+
     lines.append("")
     queries = status.get("queries") or []
     if not queries:
