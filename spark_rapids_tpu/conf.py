@@ -483,6 +483,27 @@ LINT_ALLOWLIST_PATH = conf(
     "tools/tpu_lint.py accepts (one 'path::qualname::RULE  # why' per "
     "line). Read by the lint TOOL at startup (override per run with "
     "--allowlist=); not a per-session runtime setting.")
+RACECHECK_ALLOWLIST_PATH = conf(
+    "spark.rapids.tpu.tools.racecheck.allowlistPath",
+    "tools/tpu_racecheck_allow.txt",
+    "Path (relative to the repo root) of the concurrency race analyzer's "
+    "allowlist file — the documented deliberate exceptions "
+    "tools/tpu_racecheck.py accepts (one 'path::qualname::RULE  # why' "
+    "per line). Read by the racecheck TOOL at startup (override per run "
+    "with --allowlist=); not a per-session runtime setting.")
+RACECHECK_WITNESS_ENABLED = conf(
+    "spark.rapids.tpu.tools.racecheck.witness.enabled", False,
+    "Install the runtime lock-order witness: every ordered_lock acquire "
+    "is validated against the declared LOCK_ORDER hierarchy "
+    "(spark_rapids_tpu/utils/locks.py) and observed (outer, inner) "
+    "acquisition pairs are recorded for the chaos suite's cross-check "
+    "against tools/tpu_racecheck.py's static acquire graph. An "
+    "out-of-order acquire raises LockOrderInversion naming the "
+    "colliding pair BEFORE blocking, so a would-be deadlock is a typed "
+    "error instead of a hang. Off by default — an acquire then costs "
+    "one module-global read (the event-log zero-overhead contract). "
+    "The SRTPU_RACECHECK_WITNESS=1 environment variable turns it on at "
+    "import for subprocess/CI runs.")
 
 # ---------------------------------------------------------------------------
 # Live observability plane (obs/): metrics registry, /metrics + /status

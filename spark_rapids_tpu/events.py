@@ -30,6 +30,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .conf import RapidsConf, conf
+from .utils.locks import ordered_lock
 
 EVENT_LOG_ENABLED = conf(
     "spark.rapids.tpu.eventLog.enabled", False,
@@ -234,7 +235,7 @@ class EventLogger:
         conf_ = conf_ or RapidsConf({})
         log_dir = conf_.get(EVENT_LOG_DIR)
         self.enabled = bool(conf_.get(EVENT_LOG_ENABLED) or log_dir or path)
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("events.logger")
         size = ring_size or conf_.get(EVENT_LOG_RING_SIZE)
         self._ring: collections.deque = collections.deque(maxlen=size)
         self.path: Optional[str] = None

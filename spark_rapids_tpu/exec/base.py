@@ -24,6 +24,7 @@ from ..columnar import ColumnarBatch, DeviceColumn
 from ..conf import RapidsConf
 from ..expr.eval import ColV, DictV, StrV, Val
 from ..types import StructType
+from ..utils.locks import ordered_lock
 
 # Standard metric names (reference: GpuMetricNames in GpuExec.scala:27-60)
 NUM_OUTPUT_ROWS = "numOutputRows"
@@ -54,7 +55,7 @@ class CompileCounter:
         self.by_site: Dict[str, int] = {}
         # concurrent sessions compile concurrently: unguarded += would
         # lose counts and break the recompile-guard tests' exact deltas
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("exec.compile_counter")
 
     def note(self, site: str) -> None:
         with self._lock:
@@ -82,7 +83,7 @@ COMPILE_COUNTER = CompileCounter()
 # first call, which jax serializes internally), so holding the lock
 # across build() is cheap.
 # ---------------------------------------------------------------------------
-_PIPELINE_CACHE_LOCK = threading.RLock()
+_PIPELINE_CACHE_LOCK = ordered_lock("exec.pipeline_cache", reentrant=True)
 
 #: cache dicts that have passed through cached_pipeline (dedup by
 #: identity, O(1) via the id set) — the clear_pipeline_caches() sweep

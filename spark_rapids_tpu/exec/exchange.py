@@ -37,6 +37,7 @@ from ..shuffle.transport import (
     new_shuffle_id,
 )
 from ..types import StructType
+from ..utils.locks import ordered_lock
 from ..columnar.column import choose_capacity
 from .base import (
     TOTAL_TIME,
@@ -200,7 +201,7 @@ class TpuShuffleExchangeExec(TpuExec):
         self.shuffle_id = new_shuffle_id()
         self._map_done = False
         self._consumed: set = set()
-        self._map_lock = threading.Lock()
+        self._map_lock = ordered_lock("exec.exchange_map", reentrant=True)
         self._jits: Dict[tuple, object] = {}
         self.metrics[PARTITION_SIZE] = self.metric(PARTITION_SIZE)
         self.metrics[DATA_SIZE] = self.metric(DATA_SIZE)
@@ -420,14 +421,21 @@ class TpuShuffleExchangeExec(TpuExec):
         self._run_map_side()
         pieces = self.transport.fetch(self.shuffle_id, index)
         self._note_transport_stats()
-        self._consumed.add(index)
-        if len(self._consumed) >= self.num_partitions:
-            # every reduce partition fetched once: drop the cached pieces
-            # (the reference ties shuffle buffer lifetime to the stage) and
-            # reset the map latch so a re-execution rebuilds them
-            self.transport.release(self.shuffle_id)
-            self._consumed.clear()
-            self._map_done = False
+        # the consumed-set transition runs under the map latch: parallel
+        # reduce partitions otherwise race the len() check-then-act —
+        # two threads can both see the set full and double-release the
+        # transport, or a late add lands after clear() and wedges the
+        # NEXT execution's release forever
+        with self._map_lock:
+            self._consumed.add(index)
+            if len(self._consumed) >= self.num_partitions:
+                # every reduce partition fetched once: drop the cached
+                # pieces (the reference ties shuffle buffer lifetime to
+                # the stage) and reset the map latch so a re-execution
+                # rebuilds them
+                self.transport.release(self.shuffle_id)
+                self._consumed.clear()
+                self._map_done = False
         if not pieces:
             return
         from ..memory.retry import named_oom

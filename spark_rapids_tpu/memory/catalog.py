@@ -31,6 +31,7 @@ from ..conf import (
     SPILL_ENABLED,
     conf,
 )
+from ..utils.locks import ordered_lock
 
 log = logging.getLogger("spark_rapids_tpu.memory")
 
@@ -100,7 +101,7 @@ class BufferCatalog:
 
     def __init__(self, conf_: Optional[RapidsConf] = None):
         self.conf = conf_ or RapidsConf({})
-        self._lock = threading.RLock()
+        self._lock = ordered_lock("memory.catalog", reentrant=True)
         self._buffers: Dict[int, "SpillableHandle"] = {}
         self._next_id = 0
         self._device_bytes = 0
@@ -334,9 +335,13 @@ class BufferCatalog:
                  if h.tier == TIER_DEVICE and not h.pinned), default=0)
 
     def _disk_dir(self) -> str:
-        if self._spill_dir is None:
-            self._spill_dir = tempfile.mkdtemp(prefix="srtpu_spill_")
-        return self._spill_dir
+        # under the catalog lock: concurrent host-overage drains
+        # otherwise both see None and mkdtemp twice, scattering spill
+        # files across two directories (one leaked on cleanup)
+        with self._lock:
+            if self._spill_dir is None:
+                self._spill_dir = tempfile.mkdtemp(prefix="srtpu_spill_")
+            return self._spill_dir
 
     @property
     def device_bytes(self) -> int:
@@ -403,7 +408,9 @@ class SpillableHandle:
         self.pinned = False
         self.size = sum(a.size * a.dtype.itemsize for a in arrays.values())
         self._closed = False
-        self._tlock = threading.RLock()  # guards tier transitions
+        # guards tier transitions; "memory.spillable" ranks just above
+        # the catalog — close() unregisters while holding it
+        self._tlock = ordered_lock("memory.spillable", reentrant=True)
         self._id = self._catalog.register(self)
 
     # -- tier transitions (each holds the handle lock; the catalog never

@@ -17,6 +17,7 @@ from ..conf import (
     SEMAPHORE_ACQUIRE_TIMEOUT_MS,
     RapidsConf,
 )
+from ..utils.locks import ordered_lock
 
 
 class TpuSemaphoreTimeout(RuntimeError):
@@ -39,7 +40,7 @@ class TpuSemaphore:
         # read (under the holders lock) to name the culprits when an
         # acquire times out
         self._holders: Dict[int, str] = {}
-        self._holders_lock = threading.Lock()
+        self._holders_lock = ordered_lock("memory.semaphore_holders")
 
     @classmethod
     def initialize(cls, conf: Optional[RapidsConf] = None) -> "TpuSemaphore":

@@ -36,6 +36,7 @@ from .registry import (
     uninstall,
 )
 from .watchdog import Alert, Watchdog, WatchdogRules, replay_alerts
+from ..utils.locks import ordered_lock as _ordered_lock
 
 __all__ = [
     "Alert", "EVENT_BACKED_METRICS", "METRICS", "MetricsRegistry",
@@ -176,7 +177,7 @@ class ObsPlane:
 
 
 _PLANE: Optional[ObsPlane] = None
-_PLANE_LOCK = threading.Lock()
+_PLANE_LOCK = _ordered_lock("obs.plane")
 
 
 def plane() -> Optional[ObsPlane]:

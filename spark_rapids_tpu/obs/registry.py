@@ -37,6 +37,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from ..utils.locks import ordered_lock
+
 COUNTER = "counter"
 GAUGE = "gauge"
 HISTOGRAM = "histogram"
@@ -243,7 +245,7 @@ class MetricsRegistry:
     out of this module (leaf-lock discipline — see module docstring)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("obs.registry")
         # name -> label-values tuple -> float
         self._vals: Dict[str, Dict[tuple, float]] = {
             name: {} for name in METRICS

@@ -242,10 +242,6 @@ class Watchdog:
 
     # -- background thread -------------------------------------------------
     def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._stop.clear()
-
         def run():
             while not self._stop.wait(self.interval_s):
                 try:
@@ -253,16 +249,27 @@ class Watchdog:
                 except Exception:  # pragma: no cover - never kill the host
                     log.exception("watchdog tick failed")
 
-        self._thread = threading.Thread(
-            target=run, name="srtpu-watchdog", daemon=True)
-        self._thread.start()
+        # the thread-slot transition runs under the lock: two unserialized
+        # start() calls otherwise both see None and spawn two tick threads
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=run, name="srtpu-watchdog", daemon=True)
+            self._thread.start()
 
     def stop(self) -> None:
-        if self._thread is None:
+        # claim the thread under the lock, join OUTSIDE it: the tick
+        # thread takes the same lock in check_now, so joining while
+        # holding it would stall stop() behind an in-flight tick
+        with self._lock:
+            t = self._thread
+            self._thread = None
+        if t is None:
             return
         self._stop.set()
-        self._thread.join(timeout=5)
-        self._thread = None
+        t.join(timeout=5)
 
 
 # ---------------------------------------------------------------------------

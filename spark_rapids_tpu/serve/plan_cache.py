@@ -23,6 +23,7 @@ import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from .. import obs as _obs
+from ..utils.locks import ordered_lock
 
 #: one analysis per distinct (digest, conf fingerprint) is plenty; the
 #: cap only bounds a pathological digest churn (ragged ad-hoc plans)
@@ -36,7 +37,7 @@ class SharedPlanCache:
     _instance_lock = threading.Lock()
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("serve.plan_cache")
         self._entries: Dict[tuple, Any] = {}
         self._inflight: Dict[tuple, threading.Event] = {}
         self._warm: Dict[tuple, bool] = {}

@@ -66,6 +66,7 @@ from .. import events as _events
 from .. import faults as _faults
 from .. import obs as _obs
 from ..conf import RapidsConf, conf
+from ..utils.locks import ordered_lock
 
 AOT_CACHE_ENABLED = conf(
     "spark.rapids.tpu.aotCache.enabled", False,
@@ -183,7 +184,7 @@ class ProgramCacheStats:
     """Thread-safe counters for one installed cache."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("aot.stats")
         self.hits = 0
         self.misses = 0
         self.puts = 0
@@ -542,7 +543,7 @@ class _StoreProbe:
         self._aux_b64 = aux_b64
         self._compiled = None
         self._done = False
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("aot.store_probe")
 
     def __call__(self, *args, **kwargs):
         if not self._done:
@@ -645,7 +646,7 @@ class _LoadProbe:
         self._compiled = None
         self._fallback: Optional[Callable] = None
         self._done = False
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("aot.load_probe")
 
     def __call__(self, *args, **kwargs):
         if not self._done:

@@ -45,6 +45,7 @@ from typing import Deque, Dict, List, Optional
 from .. import events as _events
 from .. import obs as _obs
 from ..conf import RapidsConf, conf
+from ..utils.locks import ordered_lock
 
 SERVE_ENABLED = conf(
     "spark.rapids.tpu.serve.enabled", False,
@@ -141,7 +142,7 @@ class QueryScheduler:
 
     def __init__(self, conf_: Optional[RapidsConf] = None):
         self.conf = conf_ or RapidsConf({})
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("serve.scheduler")
         self._queues: Dict[str, Deque[Ticket]] = {}
         self._rr_order: List[str] = []  # round-robin rotation of sessions
         self._active: Dict[int, Ticket] = {}  # seq -> admitted ticket

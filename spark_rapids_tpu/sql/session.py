@@ -15,7 +15,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 from .. import events as _events  # registers the eventLog.* conf entries
 from .. import faults as _faults  # registers the test.faults.* entries
 from .. import obs as _obs
-from ..conf import RapidsConf
+from ..conf import RACECHECK_WITNESS_ENABLED, RapidsConf
 from ..cpu import plan as C
 from ..memory import catalog as _catalog  # noqa: F401 — registers the
 # memory.* conf entries (hbm.budgetBytes) BEFORE RapidsConf validates a
@@ -27,6 +27,7 @@ from ..expr import aggregates as A
 from ..expr import expressions as E
 from ..plugin.overrides import TpuOverrides
 from ..types import StructType
+from ..utils import locks as _locks
 
 
 @dataclasses.dataclass(frozen=True)
@@ -262,7 +263,7 @@ class TpuSession:
         # obs slot): the serving path lets N threads share one session,
         # so plan+claim runs under this lock (the drain itself is
         # arbitrated by the scheduler + semaphore, not this lock)
-        self._plan_lock = threading.RLock()
+        self._plan_lock = _locks.ordered_lock("sql.plan", reentrant=True)
         self._serve_analysis = None
         self._serve_plan_key = None
         self._last_digest: Optional[str] = None
@@ -291,6 +292,13 @@ class TpuSession:
         from ..serve import program_cache as _progcache
 
         _progcache.install(self.conf)
+        # runtime lock-order witness (utils/locks.py): validates every
+        # ordered_lock acquire against the declared LOCK_ORDER and
+        # records observed acquisition pairs. Off (the default) keeps an
+        # acquire at one module-global read; process-global once on,
+        # tests pair install_witness with uninstall_witness().
+        if self.conf.get(RACECHECK_WITNESS_ENABLED):
+            _locks.install_witness()
 
     def close(self) -> None:
         """Flush/close the session's event sink (atexit also covers a

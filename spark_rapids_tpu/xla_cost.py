@@ -132,11 +132,17 @@ def harvesting() -> bool:
         return True
     if _events.enabled():
         return True
-    if _OBS_MOD is None:
-        from . import obs
+    mod = _OBS_MOD
+    if mod is None:
+        # double-checked under the record lock: the bind is idempotent
+        # but the sanctioned shape costs nothing off the first call
+        with _LOCK:
+            if _OBS_MOD is None:
+                from . import obs
 
-        _OBS_MOD = obs
-    return _OBS_MOD.enabled()
+                _OBS_MOD = obs
+            mod = _OBS_MOD
+    return mod.enabled()
 
 
 def snapshot() -> int:

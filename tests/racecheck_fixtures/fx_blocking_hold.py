@@ -1,0 +1,34 @@
+"""Must-catch fixture: manifest lock held across a blocking boundary
+(TPU104) — the teardown/mid-scrape stall shape.
+
+Waiting on a future (or a host sync) while holding a hierarchy lock
+stalls every other acquirer behind the wait. tpu_racecheck must flag
+``wait_under_lock`` (direct ``.result()``) and ``sync_under_lock``
+(host_pull reached through a call edge) with TPU104, and must NOT flag
+``wait_outside_lock``.
+"""
+from spark_rapids_tpu.utils.locks import ordered_lock
+
+_CACHE_LOCK = ordered_lock("serve.plan_cache")
+
+
+def wait_under_lock(fut):
+    with _CACHE_LOCK:
+        return fut.result()          # every other acquirer stalls here
+
+
+def _drain(dev):
+    from spark_rapids_tpu.runtime import host_pull
+
+    return host_pull(dev)
+
+
+def sync_under_lock(dev):
+    with _CACHE_LOCK:
+        return _drain(dev)           # blocking through the call edge
+
+
+def wait_outside_lock(fut):
+    out = fut.result()
+    with _CACHE_LOCK:
+        return out

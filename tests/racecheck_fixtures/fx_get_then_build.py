@@ -1,0 +1,25 @@
+"""Must-catch fixture: the PR 9 get-then-build pipeline-cache race.
+
+Every process-global pipeline cache in the audit had this exact shape:
+check the dict, miss, build, insert — with no lock, so two threads both
+miss and both compile. tpu_racecheck must flag ``pipeline_for`` with
+TPU102 and must NOT flag ``pipeline_for_fixed`` (double-checked under
+the module lock — the cached_pipeline shape).
+"""
+import threading
+
+_PIPELINES: dict = {}
+_LOCK = threading.Lock()
+
+
+def pipeline_for(key, build):
+    if key not in _PIPELINES:        # check: no lock held
+        _PIPELINES[key] = build()    # act: a second thread raced us here
+    return _PIPELINES[key]
+
+
+def pipeline_for_fixed(key, build):
+    with _LOCK:
+        if key not in _PIPELINES:
+            _PIPELINES[key] = build()
+        return _PIPELINES[key]

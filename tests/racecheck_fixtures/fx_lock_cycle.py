@@ -1,0 +1,23 @@
+"""Must-catch fixture: raw-lock cycle (TPU101).
+
+Two undeclared ``threading`` locks acquired in opposite orders by two
+functions — the classic AB/BA deadlock. Neither lock is in the
+manifest, so rank checks can't see it; the cycle detector on the full
+static acquire graph must.
+"""
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+
+
+def ab():
+    with _A:
+        with _B:
+            pass
+
+
+def ba():
+    with _B:
+        with _A:
+            pass

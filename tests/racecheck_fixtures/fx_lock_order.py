@@ -1,0 +1,26 @@
+"""Must-catch fixture: manifest lock-order inversion (TPU101).
+
+LOCK_ORDER only permits acquiring DOWNWARD (outermost rank 0 first).
+``inverted`` takes the scheduler lock while already holding the
+lower-ranked plan-cache lock — an upward acquisition that deadlocks
+against any downward path. tpu_racecheck must flag ``inverted`` with
+TPU101 and must NOT flag ``forward`` (a distinct, downward pair, so no
+cycle forms between the two functions either).
+"""
+from spark_rapids_tpu.utils.locks import ordered_lock
+
+_PLAN = ordered_lock("sql.plan")
+_SCHED = ordered_lock("serve.scheduler")
+_CACHE = ordered_lock("serve.plan_cache")
+
+
+def forward():
+    with _PLAN:
+        with _CACHE:     # downward: rank(sql.plan) < rank(serve.plan_cache)
+            pass
+
+
+def inverted():
+    with _CACHE:
+        with _SCHED:     # upward: scheduler outranks the plan cache
+            pass

@@ -1,0 +1,21 @@
+"""Must-catch fixture: the PR 15 mesh-aux unpickle outside the
+corruption guard.
+
+The AOT store's mesh-aux sidecar was probed with ``.get`` and, on miss,
+deserialized and inserted into the shared table outside the guard that
+serializes corruption recovery — two loaders could interleave and one
+would publish a half-validated aux. tpu_racecheck must flag
+``aux_for`` with TPU102.
+"""
+import pickle
+from concurrent.futures import ThreadPoolExecutor  # noqa: F401 — pool users
+
+_MESH_AUX: dict = {}
+
+
+def aux_for(key, blob):
+    entry = _MESH_AUX.get(key)       # check: no guard held
+    if entry is None:
+        entry = pickle.loads(blob)
+        _MESH_AUX[key] = entry       # act: publishes unvalidated aux
+    return entry

@@ -1,0 +1,28 @@
+"""Must-catch fixture: the PR 10 probe-lock fallback transition race.
+
+The AOT-cache load probe flipped ``self._fallback`` after observing it
+clear WITHOUT holding the probe lock, so a concurrent prober could
+re-enter the transition and double-drain the in-flight table.
+tpu_racecheck must flag ``note_corruption`` with TPU102 (the class owns
+a lock, so unlocked attr check-then-act is in scope) and must NOT flag
+``note_corruption_fixed``.
+"""
+import threading
+
+
+class LoadProbe:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fallback = False
+        self._inflight: dict = {}
+
+    def note_corruption(self, key):
+        if not self._fallback:        # check: probe lock not held
+            self._fallback = True     # act: racing transition
+            self._inflight.clear()
+
+    def note_corruption_fixed(self, key):
+        with self._lock:
+            if not self._fallback:
+                self._fallback = True
+                self._inflight.clear()

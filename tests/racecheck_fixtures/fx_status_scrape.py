@@ -1,0 +1,26 @@
+"""Must-catch fixture: the /status mid-scrape mutation.
+
+The status endpoint's refresher thread rewrote the shared snapshot dict
+in place while the HTTP handler iterated it — a RuntimeError (dict
+changed size during iteration) under load. tpu_racecheck must flag
+``_refresh`` with TPU103 (module-global mutation from a thread-run
+function with no lock held).
+"""
+import threading
+
+_SNAPSHOT: dict = {}
+
+
+def _refresh():
+    _SNAPSHOT["queued"] = 0          # unlocked write from the thread
+    _SNAPSHOT.update(scrape())
+
+
+def scrape():
+    return {"running": 1}
+
+
+def start_refresher():
+    t = threading.Thread(target=_refresh, daemon=True)
+    t.start()
+    return t

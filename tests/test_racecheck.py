@@ -1,0 +1,532 @@
+"""Concurrency race analyzer (tools/tpu_racecheck.py), the declared lock
+hierarchy (spark_rapids_tpu/utils/locks.py), and its runtime witness.
+
+Four layers, mirroring the ISSUE 18 acceptance criteria:
+
+  1. analyzer contract — the must-catch fixture corpus (each historical
+     race shape in tests/racecheck_fixtures/ is flagged by its matching
+     rule, the fixed variants are not), the repo itself is clean under
+     --strict-allowlist, stale allowlist entries fail strict mode;
+  2. witness semantics — edges recorded, inversions raised AND tallied,
+     reentrancy, zero-overhead when off;
+  3. regressions for the real races the analyzer surfaced on today's
+     tree (watchdog start/stop churn, exchange consumed-set transition,
+     catalog spill-dir creation, xla_cost lazy obs bind);
+  4. the witness-on serve stress: zero inversions, and every observed
+     acquisition pair is downward in LOCK_ORDER — the same partial
+     order TPU101 enforces statically (the static graph from
+     --dump-graph under-approximates dynamic dispatch, so the
+     cross-check is order-consistency plus hot-edge overlap, not
+     set equality).
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import Future
+
+import pytest
+
+from spark_rapids_tpu.utils import locks
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "tpu_racecheck.py")
+FIXTURES = os.path.join(REPO, "tests", "racecheck_fixtures")
+
+
+def _run_tool(*args):
+    return subprocess.run([sys.executable, TOOL, *args],
+                          capture_output=True, text=True, cwd=REPO)
+
+
+def _findings(out: str):
+    """(basename, rule, qualname) triples from analyzer stdout."""
+    got = set()
+    for line in out.splitlines():
+        if ": TPU1" not in line:
+            continue
+        loc, rest = line.split(": TPU", 1)
+        rule = "TPU" + rest.split(" ", 1)[0]
+        qual = rest.split("[", 1)[1].split("]", 1)[0]
+        got.add((os.path.basename(loc.rsplit(":", 1)[0]), rule, qual))
+    return got
+
+
+# ---------------------------------------------------------------------------
+# 1. analyzer contract
+# ---------------------------------------------------------------------------
+def test_fixture_corpus_must_catch():
+    """Every historical race shape is flagged by its matching rule."""
+    r = _run_tool(FIXTURES, "--allowlist=/dev/null")
+    assert r.returncode == 1, r.stdout + r.stderr
+    got = _findings(r.stdout)
+    must_catch = {
+        # PR 9: get-then-build in a process-global pipeline cache
+        ("fx_get_then_build.py", "TPU102", "pipeline_for"),
+        # PR 10: probe-lock fallback transition
+        ("fx_probe_transition.py", "TPU102", "LoadProbe.note_corruption"),
+        # PR 15: mesh-aux unpickle outside the corruption guard
+        ("fx_mesh_aux_unpickle.py", "TPU102", "aux_for"),
+        # /status mid-scrape mutation from the refresher thread
+        ("fx_status_scrape.py", "TPU103", "_refresh"),
+        # declared-order inversion and raw AB/BA cycle
+        ("fx_lock_order.py", "TPU101", "inverted"),
+        ("fx_lock_cycle.py", "TPU101", "ab"),
+        # manifest lock across a blocking boundary, direct + via call edge
+        ("fx_blocking_hold.py", "TPU104", "wait_under_lock"),
+        ("fx_blocking_hold.py", "TPU104", "sync_under_lock"),
+    }
+    missing = must_catch - got
+    assert not missing, f"rules failed to catch: {missing}\n{r.stdout}"
+
+
+def test_fixture_corpus_fixed_variants_not_flagged():
+    """The corrected shapes sitting next to each race stay quiet."""
+    r = _run_tool(FIXTURES, "--allowlist=/dev/null")
+    quals = {q for (_, _, q) in _findings(r.stdout)}
+    for clean in ("pipeline_for_fixed", "LoadProbe.note_corruption_fixed",
+                  "forward", "wait_outside_lock"):
+        assert clean not in quals, f"false positive on {clean}:\n{r.stdout}"
+
+
+def test_repo_clean_under_strict_allowlist():
+    """The acceptance gate: exit 0 on the engine tree, no stale entries."""
+    r = _run_tool("--strict-allowlist")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_stale_allowlist_entry_fails_strict(tmp_path):
+    r = _run_tool(FIXTURES, "--allowlist=/dev/null")
+    keys = [f"tests/racecheck_fixtures/{b}::{q}::{rule}"
+            for (b, rule, q) in _findings(r.stdout)]
+    allow = tmp_path / "allow.txt"
+    allow.write_text("\n".join(keys) + "\nbogus.py::gone::TPU101  # stale\n")
+    # non-strict: everything real is allowlisted, the stale line is ignored
+    ok = _run_tool(FIXTURES, f"--allowlist={allow}")
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    # strict: the stale entry is itself a failure
+    strict = _run_tool(FIXTURES, f"--allowlist={allow}",
+                       "--strict-allowlist")
+    assert strict.returncode == 1
+    assert "stale allowlist entry" in strict.stderr
+
+
+def test_dump_graph_prints_declared_downward_edges():
+    r = _run_tool("--dump-graph")
+    assert r.returncode == 0, r.stderr
+    edges = set()
+    for line in r.stdout.splitlines():
+        head = line.split("#", 1)[0].strip()
+        if " -> " in head:
+            a, b = head.split(" -> ")
+            edges.add((a.strip(), b.strip()))
+    assert edges, "static manifest graph is empty"
+    for a, b in edges:
+        assert locks.rank_of(a) < locks.rank_of(b), (
+            f"static edge {a} -> {b} is not downward — TPU101 should "
+            "have failed the repo-clean gate")
+
+
+# ---------------------------------------------------------------------------
+# 2. witness semantics
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def witness():
+    locks.uninstall_witness()
+    w = locks.install_witness()
+    yield w
+    locks.uninstall_witness()
+
+
+def test_witness_records_downward_edges(witness):
+    outer = locks.ordered_lock("serve.scheduler")
+    inner = locks.ordered_lock("memory.catalog", reentrant=True)
+    with outer:
+        with inner:
+            pass
+    assert locks.observed_edges() == {
+        ("serve.scheduler", "memory.catalog"): 1}
+    assert locks.observed_inversions() == []
+    rep = locks.witness_report()
+    assert rep["active"] and rep["inversions"] == []
+    assert rep["edges"] == ["serve.scheduler -> memory.catalog"]
+
+
+def test_witness_raises_named_inversion_and_tallies(witness):
+    sched = locks.ordered_lock("serve.scheduler")
+    plan = locks.ordered_lock("sql.plan")
+    with sched:
+        with pytest.raises(locks.LockOrderInversion) as ei:
+            with plan:
+                pass  # pragma: no cover - the acquire raises
+    assert ei.value.held == "serve.scheduler"
+    assert ei.value.acquiring == "sql.plan"
+    assert "LOCK_ORDER" in str(ei.value)
+    # the tally survives even when a stress harness swallows the raise
+    assert ("serve.scheduler", "sql.plan",
+            threading.current_thread().name) in locks.observed_inversions()
+    # the colliding acquire never happened: sql.plan is free afterwards
+    assert plan.acquire(blocking=False)
+    plan.release()
+
+
+def test_witness_reentrant_same_name_allowed(witness):
+    lk = locks.ordered_lock("memory.spillable", reentrant=True)
+    with lk:
+        with lk:  # same-thread re-acquisition of the SAME name
+            pass
+    assert locks.observed_inversions() == []
+    # a NON-reentrant same-name re-acquire is the self-deadlock shape
+    a = locks.ordered_lock("obs.plane")
+    b = locks.ordered_lock("obs.plane")
+    with a:
+        with pytest.raises(locks.LockOrderInversion):
+            b.acquire()
+
+
+def test_witness_zero_overhead_when_off():
+    locks.uninstall_witness()
+    assert not locks.witness_active()
+    with locks.ordered_lock("sql.plan"):
+        pass
+    assert locks.observed_edges() == {}
+    assert locks.observed_inversions() == []
+    assert locks.witness_report() == {
+        "active": False, "edges": [], "inversions": []}
+
+
+def test_ordered_lock_rejects_undeclared_names():
+    with pytest.raises(ValueError, match="LOCK_ORDER"):
+        locks.ordered_lock("not.in.the.manifest")
+
+
+# ---------------------------------------------------------------------------
+# 3. regressions for the races the analyzer surfaced on today's tree
+# ---------------------------------------------------------------------------
+def _watchdog_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "srtpu-watchdog" and t.is_alive()]
+
+
+def test_watchdog_start_stop_churn_leaves_one_thread_at_most():
+    """Pre-fix, unserialized start()/stop() could spawn two tick threads
+    (both saw _thread None) or leak one past stop()."""
+    from spark_rapids_tpu.obs.registry import MetricsRegistry
+    from spark_rapids_tpu.obs.watchdog import Watchdog, WatchdogRules
+
+    wd = Watchdog(MetricsRegistry(), WatchdogRules(), interval_s=0.01)
+    base = len(_watchdog_threads())
+
+    def churn():
+        for _ in range(25):
+            wd.start()
+            wd.stop()
+
+    ths = [threading.Thread(target=churn) for _ in range(6)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(30)
+    assert wd._thread is None
+    # double-start is idempotent: exactly one tick thread, stop reaps it
+    wd.start()
+    wd.start()
+    assert len(_watchdog_threads()) == base + 1
+    wd.stop()
+    deadline = time.time() + 5
+    while _watchdog_threads() and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(_watchdog_threads()) == base
+    assert wd._thread is None
+
+
+def test_catalog_disk_dir_single_under_concurrency():
+    """Pre-fix, concurrent host-overage drains could both see
+    _spill_dir None and mkdtemp twice, scattering spill files."""
+    import shutil
+
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.memory.catalog import BufferCatalog
+
+    BufferCatalog.reset(RapidsConf({}))
+    cat = BufferCatalog.get()
+    dirs, barrier = [], threading.Barrier(8)
+    lock = threading.Lock()
+
+    def probe():
+        barrier.wait()
+        d = cat._disk_dir()
+        with lock:
+            dirs.append(d)
+
+    ths = [threading.Thread(target=probe) for _ in range(8)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(10)
+    try:
+        assert len(set(dirs)) == 1 and os.path.isdir(dirs[0])
+    finally:
+        shutil.rmtree(dirs[0], ignore_errors=True)
+        BufferCatalog.reset(RapidsConf({}))
+
+
+def test_xla_cost_obs_bind_thread_safe():
+    """Pre-fix, the lazy _OBS_MOD bind was an unlocked check-then-act;
+    now it double-checks under _LOCK and stays consistent under a
+    thundering herd."""
+    import spark_rapids_tpu.xla_cost as xc
+
+    old = xc._OBS_MOD
+    xc._OBS_MOD = None
+    try:
+        results, barrier = [], threading.Barrier(8)
+        lock = threading.Lock()
+
+        def probe():
+            barrier.wait()
+            v = xc.harvesting()
+            with lock:
+                results.append(v)
+
+        ths = [threading.Thread(target=probe) for _ in range(8)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(10)
+        assert len(results) == 8 and len(set(results)) == 1
+        assert xc._OBS_MOD is not None
+    finally:
+        xc._OBS_MOD = old
+
+
+def test_exchange_parallel_reduce_releases_transport_once():
+    """Pre-fix, the consumed-set check-then-act let two reduce threads
+    double-release the transport, or wedge the NEXT execution's release.
+    Two back-to-back all-parallel executions must release exactly once
+    each (the latch resets cleanly)."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar import ColumnarBatch, schema_of
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.exec import InMemoryScanExec
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.shuffle.partition import HashPartitioning
+
+    conf = RapidsConf({"spark.rapids.tpu.shuffle.mode": "host"})
+    schema = schema_of(k=T.INT, v=T.LONG)
+    batch = ColumnarBatch.from_pydict(
+        {"k": [i % 13 for i in range(512)],
+         "v": list(range(512))}, schema)
+    scan = InMemoryScanExec(conf, [[batch]], schema)
+    ex = TpuShuffleExchangeExec(conf, scan, HashPartitioning([0], 8))
+
+    releases = []
+    real_release = ex.transport.release
+
+    def counting_release(shuffle_id):
+        releases.append(shuffle_id)
+        return real_release(shuffle_id)
+
+    ex.transport.release = counting_release
+
+    for round_no in (1, 2):
+        rows, errors = [], []
+        lock = threading.Lock()
+
+        def reduce_one(p):
+            try:
+                got = [r for b in ex.execute_partition(p)
+                       for r in b.to_rows()]
+                with lock:
+                    rows.extend(got)
+            except Exception as e:  # pragma: no cover - the failure mode
+                with lock:
+                    errors.append((p, repr(e)))
+
+        ths = [threading.Thread(target=reduce_one, args=(p,))
+               for p in range(ex.num_partitions)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(60)
+        assert not errors, errors
+        assert sorted(r[1] for r in rows) == list(range(512))
+        assert len(releases) == round_no, (
+            f"transport released {len(releases)}x after {round_no} full "
+            "consumption round(s) — double-release or wedged latch")
+
+
+# ---------------------------------------------------------------------------
+# 3b. pq_decode packed-upload key determinism (the cold-start warm miss)
+# ---------------------------------------------------------------------------
+class _OrderedPool:
+    """A decode pool that completes tasks one at a time in submission
+    order or in REVERSE — the adversarial completion order that used to
+    leak into the packed-upload layout key."""
+
+    def __init__(self, reverse: bool):
+        self.reverse = reverse
+        self._q = []
+        self._lock = threading.Lock()
+        self._stop = False
+        self._t = threading.Thread(target=self._drain, daemon=True)
+        self._t.start()
+
+    def submit(self, fn, *args, **kw):
+        fut = Future()
+        with self._lock:
+            self._q.append((fut, fn, args, kw))
+        return fut
+
+    def _drain(self):
+        while not self._stop:
+            time.sleep(0.02)  # let a row group's whole batch accumulate
+            with self._lock:
+                batch, self._q = self._q, []
+            if self.reverse:
+                batch.reverse()
+            for fut, fn, args, kw in batch:
+                try:
+                    fut.set_result(fn(*args, **kw))
+                except BaseException as e:  # pragma: no cover
+                    fut.set_exception(e)
+
+    def stop(self):
+        self._stop = True
+        self._t.join(5)
+
+
+def test_packed_upload_layout_is_completion_order_invariant(
+        tmp_path, monkeypatch):
+    """The staged-flush split must partition columns by DECLARED order,
+    not decode completion order: forward and reverse completion must
+    produce the identical packed layouts (= identical upload_unpack
+    pipeline keys, = zero warm compile misses on the cold-start lane)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.exec.scan import TpuFileSourceScanExec
+    from spark_rapids_tpu.io import arrow_convert, parquet_device
+    from spark_rapids_tpu.io.parquet import ParquetScanner
+    from spark_rapids_tpu.io.scan_cache import DeviceScanCache
+
+    rng = np.random.default_rng(7)
+    n = 8192
+    t = pa.table({f"c{i}": pa.array(
+        rng.integers(0, 50, n).astype(np.int32)) for i in range(5)})
+    path = os.path.join(str(tmp_path), "d.parquet")
+    pq.write_table(t, path, row_group_size=4096)
+
+    real_upload = arrow_convert.packed_upload
+
+    def scan_layouts(reverse: bool):
+        DeviceScanCache.reset()
+        layouts = []
+
+        def spy(host_arrays):
+            layouts.append(tuple(
+                (a.shape, a.dtype.str) for a in host_arrays))
+            return real_upload(host_arrays)
+
+        pool = _OrderedPool(reverse)
+        monkeypatch.setattr(arrow_convert, "packed_upload", spy)
+        monkeypatch.setattr(parquet_device, "_decode_pool", lambda: pool)
+        try:
+            conf = RapidsConf(
+                {"spark.rapids.tpu.scan.deviceCache.enabled": False})
+            ex = TpuFileSourceScanExec(
+                conf, ParquetScanner(path, conf), "parquet")
+            rows = [r for p in range(ex.num_partitions)
+                    for b in ex.execute_partition(p) for r in b.to_rows()]
+        finally:
+            pool.stop()
+            monkeypatch.undo()
+        return layouts, rows
+
+    fwd_layouts, fwd_rows = scan_layouts(reverse=False)
+    rev_layouts, rev_rows = scan_layouts(reverse=True)
+    assert fwd_layouts, "device decode path did not stage any upload"
+    assert sorted(rev_rows) == sorted(fwd_rows)
+    assert sorted(fwd_layouts) == sorted(rev_layouts), (
+        "packed-upload layout depends on decode completion order — the "
+        "upload_unpack pipeline key is unstable across runs")
+
+
+# ---------------------------------------------------------------------------
+# 4. witness-on serve stress: the chaos cross-check
+# ---------------------------------------------------------------------------
+def test_witness_serve_stress_zero_inversions(tmp_path):
+    """4 sessions x 4 queries with the witness armed via the conf entry:
+    zero inversions, and every OBSERVED acquisition pair is downward in
+    LOCK_ORDER — the runtime half of the TPU101 contract. The hot
+    statically-predicted session edge must also actually be observed."""
+    from spark_rapids_tpu import events as EV
+    from spark_rapids_tpu import obs
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.expr import aggregates as A
+    from spark_rapids_tpu.expr import expressions as E
+    from spark_rapids_tpu.expr.expressions import col, lit
+    from spark_rapids_tpu.memory.catalog import BufferCatalog
+    from spark_rapids_tpu.serve import QueryScheduler, SharedPlanCache
+    from spark_rapids_tpu.sql import TpuSession
+
+    settings = {
+        "spark.rapids.tpu.serve.enabled": True,
+        "spark.rapids.tpu.tools.racecheck.witness.enabled": True,
+    }
+    locks.uninstall_witness()
+    QueryScheduler.reset(RapidsConf(settings))
+    SharedPlanCache.reset()
+    BufferCatalog.reset(RapidsConf(settings))
+
+    def q(sess, mult, n=2048):
+        return (sess.range(0, n)
+                .where(E.GreaterThanOrEqual(col("id"), lit(100)))
+                .select(col("id"),
+                        E.Alias(E.Multiply(col("id"), lit(mult)), "v"))
+                .agg(A.agg(A.Sum(col("v")), "s"),
+                     A.agg(A.Count(None), "c")))
+
+    errors, lock = [], threading.Lock()
+
+    def worker(ti):
+        try:
+            sess = TpuSession(settings)
+            for qi in range(4):
+                q(sess, 2 + (ti * 4 + qi) % 5).collect()
+        except Exception as e:  # pragma: no cover - the failure mode
+            with lock:
+                errors.append((ti, repr(e)))
+
+    try:
+        ths = [threading.Thread(target=worker, args=(ti,),
+                                name=f"witness-stress-{ti}")
+               for ti in range(4)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(120)
+        assert not errors, errors
+        assert locks.witness_active(), (
+            "the conf entry did not arm the witness")
+        rep = locks.witness_report()
+        assert rep["inversions"] == [], rep
+        observed = locks.observed_edges()
+        assert observed, "stress recorded no acquisition pairs"
+        for a, b in observed:
+            assert locks.rank_of(a) < locks.rank_of(b), (
+                f"observed edge {a} -> {b} acquires upward — the static "
+                "analyzer and the witness disagree")
+        # cross-check against the static graph's hot session edge
+        assert ("sql.plan", "serve.plan_cache") in observed
+    finally:
+        locks.uninstall_witness()
+        QueryScheduler.reset()
+        SharedPlanCache.reset()
+        BufferCatalog.reset()
+        EV.uninstall()
+        obs.shutdown()

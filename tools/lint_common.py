@@ -1,0 +1,174 @@
+"""Shared AST-walk + allowlist machinery for the repo-directed analysis
+tools (tools/tpu_lint.py — tracing hazards TPU001–004 — and
+tools/tpu_racecheck.py — concurrency hazards TPU101–104).
+
+Both tools have the same skeleton: walk a target tree of .py files,
+parse each with ``ast`` (no imports, so they run without jax), produce
+``Finding``s keyed ``relpath::qualname::RULE``, filter them through a
+conf-named allowlist file, and exit 0 clean / 1 findings / 2 usage
+error — with ``--strict-allowlist`` turning stale allowlist entries
+into failures. This module is that skeleton; the rule logic stays in
+the tools.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Callable, Dict, List, Optional, Set
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class Finding:
+    __slots__ = ("path", "line", "rule", "qualname", "message")
+
+    def __init__(self, path, line, rule, qualname, message):
+        self.path = path
+        self.line = line
+        self.rule = rule
+        self.qualname = qualname
+        self.message = message
+
+    def key(self) -> str:
+        return f"{self.path}::{self.qualname}::{self.rule}"
+
+    def __str__(self):
+        return (f"{self.path}:{self.line}: {self.rule} [{self.qualname}] "
+                f"{self.message}")
+
+
+def load_allowlist(path: str) -> Set[str]:
+    allowed: Set[str] = set()
+    if not os.path.exists(path):
+        return allowed
+    with open(path) as f:
+        for raw in f:
+            line = raw.split("#", 1)[0].strip()
+            if line:
+                allowed.add(line)
+    return allowed
+
+
+def default_allowlist_path(conf_attr: str, fallback: str) -> str:
+    """Resolve the tool's allowlist path from its conf entry (so the
+    location is documented in docs/configs.md), falling back to the
+    literal when the engine can't import (the tools must run bare)."""
+    try:
+        sys.path.insert(0, REPO_ROOT)
+        import spark_rapids_tpu.conf as _conf
+
+        entry = getattr(_conf, conf_attr)
+        return os.path.join(REPO_ROOT, entry.default)
+    except Exception:  # noqa: BLE001 — tools must run without deps
+        return os.path.join(REPO_ROOT, fallback)
+
+
+def attr_chain(node: ast.AST) -> Optional[str]:
+    """'jax.device_get' for Attribute(Name('jax'), 'device_get'), else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def function_defs(tree: ast.AST) -> Dict[ast.AST, str]:
+    """Every function/lambda node -> qualname."""
+    out: Dict[ast.AST, str] = {}
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[child] = ".".join(stack + [child.name])
+                walk(child, stack + [child.name])
+            elif isinstance(child, ast.Lambda):
+                out[child] = ".".join(stack + ["<lambda>"])
+                walk(child, stack)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, stack + [child.name])
+            else:
+                walk(child, stack)
+
+    walk(tree, [])
+    return out
+
+
+def parents_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    par: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            par[child] = node
+    return par
+
+
+def enclosing_function(node, parents):
+    cur = parents.get(node)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        cur = parents.get(cur)
+    return cur
+
+
+def qualname_resolver(tree: ast.AST, parents) -> Callable[[ast.AST], str]:
+    """node -> qualname of its nearest enclosing function (or <module>)."""
+    qualnames = function_defs(tree)
+
+    def qual_of(node) -> str:
+        fn = node if node in qualnames else enclosing_function(node, parents)
+        while fn is not None and fn not in qualnames:
+            fn = enclosing_function(fn, parents)
+        return qualnames.get(fn, "<module>")
+
+    return qual_of
+
+
+def iter_py_files(target: str):
+    for dirpath, dirnames, filenames in os.walk(target):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def run_tool(tool: str, argv: List[str], default_target: str,
+             default_allow_path: str,
+             check_file: Callable[[str, str], List[Finding]]) -> int:
+    """The shared CLI driver: positional target dir, --allowlist=PATH,
+    --strict-allowlist. Exit 0 clean, 1 findings/stale, 2 usage error.
+    ``check_file(abspath, relpath)`` supplies the tool's rules."""
+    args = [a for a in argv if not a.startswith("--")]
+    target = os.path.abspath(args[0]) if args else default_target
+    allow_path = default_allow_path
+    for a in argv:
+        if a.startswith("--allowlist="):
+            allow_path = a.split("=", 1)[1]
+    if not os.path.exists(target):
+        print(f"{tool}: no such target {target}", file=sys.stderr)
+        return 2
+    allowed = load_allowlist(allow_path)
+    findings: List[Finding] = []
+    used: Set[str] = set()
+    for path in iter_py_files(target):
+        rel = os.path.relpath(path, REPO_ROOT)
+        for f in check_file(path, rel):
+            if f.key() in allowed:
+                used.add(f.key())
+                continue
+            findings.append(f)
+    for f in findings:
+        print(str(f))
+    stale = allowed - used
+    if stale and "--strict-allowlist" in argv:
+        for s in sorted(stale):
+            print(f"{tool}: stale allowlist entry: {s}", file=sys.stderr)
+        return 1
+    if findings:
+        print(f"{tool}: {len(findings)} finding(s) "
+              f"({len(used)} allowlisted)", file=sys.stderr)
+        return 1
+    print(f"{tool}: clean ({len(used)} allowlisted site(s))")
+    return 0

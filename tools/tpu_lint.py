@@ -55,7 +55,20 @@ import os
 import sys
 from typing import Dict, List, Optional, Set, Tuple
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from lint_common import (  # noqa: E402 — path bootstrap above
+    Finding,
+    REPO_ROOT,
+    attr_chain as _attr_chain,
+    default_allowlist_path,
+    enclosing_function as _enclosing_function,
+    function_defs as _function_defs,
+    iter_py_files,
+    load_allowlist,
+    parents_map as _parents,
+    run_tool,
+)
+
 DEFAULT_TARGET = os.path.join(REPO_ROOT, "spark_rapids_tpu")
 #: dirs where ANY raw host-sync primitive is a finding (TPU001); the rest
 #: of the package is host-boundary code where pulls are the point
@@ -77,109 +90,8 @@ CAPACITY_SANCTIONED = (
 
 
 def _default_allowlist_path() -> str:
-    try:
-        sys.path.insert(0, REPO_ROOT)
-        from spark_rapids_tpu.conf import LINT_ALLOWLIST_PATH
-
-        return os.path.join(REPO_ROOT, LINT_ALLOWLIST_PATH.default)
-    except Exception:  # noqa: BLE001 — lint must run without deps
-        return os.path.join(REPO_ROOT, "tools", "tpu_lint_allow.txt")
-
-
-class Finding:
-    __slots__ = ("path", "line", "rule", "qualname", "message")
-
-    def __init__(self, path, line, rule, qualname, message):
-        self.path = path
-        self.line = line
-        self.rule = rule
-        self.qualname = qualname
-        self.message = message
-
-    def key(self) -> str:
-        return f"{self.path}::{self.qualname}::{self.rule}"
-
-    def __str__(self):
-        return (f"{self.path}:{self.line}: {self.rule} [{self.qualname}] "
-                f"{self.message}")
-
-
-def load_allowlist(path: str) -> Set[str]:
-    allowed: Set[str] = set()
-    if not os.path.exists(path):
-        return allowed
-    with open(path) as f:
-        for raw in f:
-            line = raw.split("#", 1)[0].strip()
-            if line:
-                allowed.add(line)
-    return allowed
-
-
-def _attr_chain(node: ast.AST) -> Optional[str]:
-    """'jax.device_get' for Attribute(Name('jax'), 'device_get'), else None."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-class _Scope:
-    """Qualname + traced-parameter bookkeeping while walking."""
-
-    def __init__(self):
-        self.stack: List[str] = []
-
-    def push(self, name: str):
-        self.stack.append(name)
-
-    def pop(self):
-        self.stack.pop()
-
-    @property
-    def qualname(self) -> str:
-        return ".".join(self.stack) if self.stack else "<module>"
-
-
-def _function_defs(tree: ast.AST) -> Dict[ast.AST, str]:
-    """Every function/lambda node -> qualname."""
-    out: Dict[ast.AST, str] = {}
-
-    def walk(node, stack):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                out[child] = ".".join(stack + [child.name])
-                walk(child, stack + [child.name])
-            elif isinstance(child, ast.Lambda):
-                out[child] = ".".join(stack + ["<lambda>"])
-                walk(child, stack)
-            elif isinstance(child, ast.ClassDef):
-                walk(child, stack + [child.name])
-            else:
-                walk(child, stack)
-
-    walk(tree, [])
-    return out
-
-
-def _parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
-    par: Dict[ast.AST, ast.AST] = {}
-    for node in ast.walk(tree):
-        for child in ast.iter_child_nodes(node):
-            par[child] = node
-    return par
-
-
-def _enclosing_function(node, parents):
-    cur = parents.get(node)
-    while cur is not None and not isinstance(
-            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-        cur = parents.get(cur)
-    return cur
+    return default_allowlist_path(
+        "LINT_ALLOWLIST_PATH", os.path.join("tools", "tpu_lint_allow.txt"))
 
 
 def _is_jit_call(call: ast.Call) -> bool:
@@ -458,47 +370,9 @@ def lint_file(path: str, relpath: str) -> List[Finding]:
     return findings
 
 
-def iter_py_files(target: str):
-    for dirpath, dirnames, filenames in os.walk(target):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if fn.endswith(".py"):
-                yield os.path.join(dirpath, fn)
-
-
 def main(argv: List[str]) -> int:
-    args = [a for a in argv if not a.startswith("--")]
-    target = os.path.abspath(args[0]) if args else DEFAULT_TARGET
-    allow_path = _default_allowlist_path()
-    for a in argv:
-        if a.startswith("--allowlist="):
-            allow_path = a.split("=", 1)[1]
-    if not os.path.exists(target):
-        print(f"tpu_lint: no such target {target}", file=sys.stderr)
-        return 2
-    allowed = load_allowlist(allow_path)
-    findings: List[Finding] = []
-    used: Set[str] = set()
-    for path in iter_py_files(target):
-        rel = os.path.relpath(path, REPO_ROOT)
-        for f in lint_file(path, rel):
-            if f.key() in allowed:
-                used.add(f.key())
-                continue
-            findings.append(f)
-    for f in findings:
-        print(str(f))
-    stale = allowed - used
-    if stale and "--strict-allowlist" in argv:
-        for s in sorted(stale):
-            print(f"tpu_lint: stale allowlist entry: {s}", file=sys.stderr)
-        return 1
-    if findings:
-        print(f"tpu_lint: {len(findings)} finding(s) "
-              f"({len(used)} allowlisted)", file=sys.stderr)
-        return 1
-    print(f"tpu_lint: clean ({len(used)} allowlisted site(s))")
-    return 0
+    return run_tool("tpu_lint", argv, DEFAULT_TARGET,
+                    _default_allowlist_path(), lint_file)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,872 @@
+#!/usr/bin/env python3
+"""tpu_racecheck — repo-directed AST analysis for concurrency hazards.
+
+The engine is deeply concurrent (serve scheduler, obs registry +
+watchdog threads, prefetch/decode pools, cross-process AOT cache) and
+its dominant residual bug class is lock misuse: PR 9's thread-safety
+audit found get-then-build races in every process-global pipeline
+cache, and the PR 10/15 post-review passes each hand-caught more
+(probe-lock transitions, mid-scrape dict mutation, plane-lock teardown
+races). This tool turns that review lore into CI failures, checked
+against the DECLARED lock hierarchy in
+``spark_rapids_tpu/utils/locks.py`` (``LOCK_ORDER`` + ``LEAF_SINKS``).
+
+Rules
+-----
+TPU101  lock-order inversion: the static acquire graph (``with`` sites
+        across call edges, transitively) contains an edge that violates
+        the declared partial order — a manifest lock acquired while
+        holding an equal-or-lower-ranked manifest lock, ANY acquisition
+        while holding a leaf sink, an undeclared (raw ``threading``)
+        lock held across a structural manifest-lock acquisition, or a
+        cycle anywhere in the full graph (declared or not).
+TPU102  check-then-act on shared mutable state: a module-global dict/
+        list/set (or a lock-owning class's attribute) conditionally
+        read and later written in the same function with NEITHER access
+        under a lock — the get-then-build shape. The sanctioned helper
+        ``exec/base.cached_pipeline`` (which double-checks under the
+        pipeline lock) is the fix; double-checked sites (write under a
+        lock) are not flagged. Only modules that import ``threading`` /
+        ``concurrent.futures`` are in scope.
+TPU103  unlocked mutation from a thread: a function reachable from a
+        ``threading.Thread(target=...)`` / ``Timer`` / pool
+        ``.submit(...)`` entry writes module-global mutable state with
+        no lock held — the /status mid-scrape-mutation shape.
+TPU104  manifest lock held across a blocking boundary: a ``with`` body
+        on a declared lock reaches ``host_pull``/``host_fence``/
+        ``device_get``/``block_until_ready``/``.item()``, a future
+        ``.result()``, an event/queue wait, a no-arg ``.join()``,
+        ``time.sleep``, or ``subprocess.*`` — directly or through
+        resolvable calls. Holding a hierarchy lock through a host sync
+        or a thread join is how the teardown/scrape stalls happened.
+
+The static graph is cross-checked at runtime: the conf-gated witness
+(``spark.rapids.tpu.tools.racecheck.witness.enabled``) records actual
+acquisition pairs through ``ordered_lock`` and the chaos suite asserts
+every observed pair acquires DOWNWARD in LOCK_ORDER — the same partial
+order TPU101 enforces statically (``--dump-graph`` prints the static
+manifest edges; the static set under-approximates dynamic dispatch, so
+it is compared for consistency, not equality).
+
+Allowlist: ``tools/tpu_racecheck_allow.txt`` (conf entry
+``spark.rapids.tpu.tools.racecheck.allowlistPath``), one
+``relpath::qualname::RULE  # why`` per line; ``--strict-allowlist``
+fails on stale entries. Exit 0 clean, 1 findings, 2 usage error.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from lint_common import (  # noqa: E402 — path bootstrap above
+    Finding,
+    REPO_ROOT,
+    attr_chain,
+    default_allowlist_path,
+    enclosing_function,
+    function_defs,
+    iter_py_files,
+    parents_map,
+    run_tool,
+)
+
+DEFAULT_TARGET = os.path.join(REPO_ROOT, "spark_rapids_tpu")
+MANIFEST_PATH = os.path.join(
+    REPO_ROOT, "spark_rapids_tpu", "utils", "locks.py")
+
+#: attribute mutators that count as a WRITE to the object they're
+#: called on (dict/list/set/deque surface the engine actually uses)
+MUTATING_METHODS = frozenset({
+    "append", "add", "update", "setdefault", "pop", "popleft", "remove",
+    "discard", "clear", "insert", "extend", "appendleft", "__setitem__",
+})
+
+#: call names that block the calling thread (TPU104 boundaries)
+BLOCKING_CALL_NAMES = frozenset({
+    "host_pull", "host_fence", "device_get", "block_until_ready",
+})
+
+
+def _default_allowlist_path() -> str:
+    return default_allowlist_path(
+        "RACECHECK_ALLOWLIST_PATH",
+        os.path.join("tools", "tpu_racecheck_allow.txt"))
+
+
+# ---------------------------------------------------------------------------
+# The declared hierarchy, read straight from the manifest module's AST
+# (no engine import — the tool must run without jax installed).
+# ---------------------------------------------------------------------------
+def load_manifest(path: str = MANIFEST_PATH) -> Tuple[Dict[str, int],
+                                                      Set[str]]:
+    """(name -> rank, leaf sink names) from LOCK_ORDER / LEAF_SINKS."""
+    with open(path, "rb") as f:
+        tree = ast.parse(f.read(), filename=path)
+    order: List[str] = []
+    sinks: Set[str] = set()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "LOCK_ORDER" in names and isinstance(node.value, ast.Tuple):
+            order = [e.value for e in node.value.elts
+                     if isinstance(e, ast.Constant)]
+        if "LEAF_SINKS" in names:
+            sinks = {n.value for n in ast.walk(node.value)
+                     if isinstance(n, ast.Constant)
+                     and isinstance(n.value, str)}
+    return {n: i for i, n in enumerate(order)}, sinks
+
+
+# ---------------------------------------------------------------------------
+# Per-module scan: lock definitions, function bodies (acquire sites with
+# the held-lock stack, calls, blocking boundaries, global/attr accesses)
+# ---------------------------------------------------------------------------
+class LockDef:
+    __slots__ = ("lid", "manifest_name", "reentrant", "relpath", "line")
+
+    def __init__(self, lid, manifest_name, reentrant, relpath, line):
+        self.lid = lid                    # graph node id
+        self.manifest_name = manifest_name  # None for undeclared locks
+        self.reentrant = reentrant
+        self.relpath = relpath
+        self.line = line
+
+    @property
+    def label(self) -> str:
+        return self.manifest_name or f"<undeclared {self.lid}>"
+
+
+class FuncScan:
+    __slots__ = ("qualname", "module", "node",
+                 "acquire_events", "call_events", "blocking_events",
+                 "global_checks", "global_writes",
+                 "attr_checks", "attr_writes", "class_qual")
+
+    def __init__(self, qualname, module, node, class_qual):
+        self.qualname = qualname
+        self.module = module
+        self.node = node
+        self.class_qual = class_qual
+        self.acquire_events: List[tuple] = []  # (lid, line, held[lid])
+        self.call_events: List[tuple] = []     # (desc, line, held[lid])
+        self.blocking_events: List[tuple] = []  # (line, label, held[lid])
+        self.global_checks: Dict[str, List[tuple]] = {}  # g -> (ln, locked)
+        self.global_writes: Dict[str, List[tuple]] = {}
+        self.attr_checks: Dict[str, List[tuple]] = {}    # attr -> (ln, lk)
+        self.attr_writes: Dict[str, List[tuple]] = {}
+
+
+class ModuleScan:
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        # "spark_rapids_tpu/serve/scheduler.py" -> dotted module name
+        self.dotted = relpath[:-3].replace(os.sep, ".")
+        self.import_aliases: Dict[str, str] = {}   # alias -> dotted target
+        self.module_locks: Dict[str, LockDef] = {}  # module-level var
+        self.class_locks: Dict[Tuple[str, str], LockDef] = {}
+        self.lock_classes: Set[str] = set()  # class quals owning a lock
+        self.funcs: Dict[str, FuncScan] = {}
+        self.top_funcs: Dict[str, str] = {}  # bare name -> qualname
+        self.methods: Dict[Tuple[str, str], str] = {}  # (cls, m) -> qual
+        self.mutable_globals: Set[str] = set()
+        self.uses_threading = False
+        self.thread_entry_descs: List[tuple] = []
+
+
+def _is_threading_lock_ctor(call: ast.Call, mod: ModuleScan) -> Optional[bool]:
+    """None if not a raw lock ctor, else reentrant flag."""
+    chain = attr_chain(call.func)
+    if not chain:
+        return None
+    parts = chain.split(".")
+    if parts[-1] not in ("Lock", "RLock"):
+        return None
+    root = parts[0]
+    if len(parts) == 1:  # bare Lock() via from-import
+        tgt = mod.import_aliases.get(root, "")
+        if not tgt.startswith("threading"):
+            return None
+    elif mod.import_aliases.get(root, root) not in (
+            "threading", "_threading"):
+        return None
+    return parts[-1] == "RLock"
+
+
+def _is_ordered_lock_ctor(call: ast.Call) -> bool:
+    chain = attr_chain(call.func)
+    return bool(chain) and chain.split(".")[-1] in (
+        "ordered_lock", "_ordered_lock")
+
+
+def _mutable_literal(value: ast.AST) -> bool:
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Constant) and value.value is None:
+        return True
+    if isinstance(value, ast.Call):
+        chain = attr_chain(value.func) or ""
+        return chain.split(".")[-1] in (
+            "dict", "list", "set", "deque", "defaultdict", "OrderedDict")
+    return False
+
+
+def _call_desc(call: ast.Call, mod: ModuleScan,
+               class_qual: Optional[str]) -> Optional[tuple]:
+    """A resolvable-call descriptor, or None."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return ("local", f.id)
+    if isinstance(f, ast.Attribute):
+        if isinstance(f.value, ast.Name) and f.value.id in ("self", "cls") \
+                and class_qual is not None:
+            return ("self", class_qual, f.attr)
+        if isinstance(f.value, ast.Name):
+            tgt = mod.import_aliases.get(f.value.id)
+            if tgt is not None:
+                return ("module", tgt, f.attr)
+        return ("attr", f.attr)
+    return None
+
+
+def _blocking_label(call: ast.Call, mod: ModuleScan) -> Optional[str]:
+    """Label if this call blocks the calling thread, else None."""
+    chain = attr_chain(call.func) or ""
+    parts = chain.split(".")
+    last = parts[-1] if parts else ""
+    if last in BLOCKING_CALL_NAMES:
+        return f"{last}() host sync"
+    if isinstance(call.func, ast.Attribute):
+        if last == "item" and not call.args:
+            return ".item() host sync"
+        if last == "result":
+            return ".result() future wait"
+        if last == "wait":
+            return ".wait() event/condition wait"
+        if last == "join" and not call.args:
+            # thread/queue join; str.join/os.path.join take a positional
+            return ".join() thread/queue wait"
+        if last == "get" and any(kw.arg in ("block", "timeout")
+                                 for kw in call.keywords):
+            return ".get(block/timeout) queue wait"
+    root = mod.import_aliases.get(parts[0], parts[0]) if parts else ""
+    if root == "time" and last == "sleep":
+        return "time.sleep()"
+    if root == "subprocess":
+        return f"subprocess.{last}()"
+    return None
+
+
+def scan_module(path: str, relpath: str) -> Optional[ModuleScan]:
+    with open(path, "rb") as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError:
+            return None
+    mod = ModuleScan(relpath)
+    parents = parents_map(tree)
+    qualnames = function_defs(tree)
+
+    # imports ---------------------------------------------------------------
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mod.import_aliases[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            for a in node.names:
+                tgt = f"{base}.{a.name}" if base else a.name
+                mod.import_aliases[a.asname or a.name] = tgt
+    mod.uses_threading = any(
+        v.startswith(("threading", "concurrent.futures"))
+        for v in mod.import_aliases.values())
+
+    def enclosing_class(node) -> Optional[str]:
+        cur, names = parents.get(node), []
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                names.append(cur.name)
+            cur = parents.get(cur)
+        return ".".join(reversed(names)) if names else None
+
+    # lock + mutable-global + function indexes ------------------------------
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cq = enclosing_class(node)
+            qn = qualnames[node]
+            if cq is None and enclosing_function(node, parents) is None:
+                mod.top_funcs[node.name] = qn
+            if cq is not None and qn == f"{cq}.{node.name}":
+                mod.methods[(cq, node.name)] = qn
+        # normalize plain and annotated assignments (`_C = {}` and
+        # `_C: dict = {}` declare the same mutable global)
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if not isinstance(value, ast.Call):
+            if enclosing_function(node, parents) is None \
+                    and enclosing_class(node) is None:
+                for t in targets:
+                    if isinstance(t, ast.Name) \
+                            and _mutable_literal(value):
+                        mod.mutable_globals.add(t.id)
+            continue
+        call = value
+        is_ordered = _is_ordered_lock_ctor(call)
+        raw_reentrant = _is_threading_lock_ctor(call, mod)
+        if not is_ordered and raw_reentrant is None:
+            continue
+        if is_ordered:
+            name = (call.args[0].value
+                    if call.args and isinstance(call.args[0], ast.Constant)
+                    else None)
+            reentrant = any(
+                kw.arg == "reentrant" and isinstance(kw.value, ast.Constant)
+                and bool(kw.value.value) for kw in call.keywords)
+        else:
+            name, reentrant = None, raw_reentrant
+        for t in targets:
+            cq = enclosing_class(node)
+            if isinstance(t, ast.Name) and cq is None \
+                    and enclosing_function(node, parents) is None:
+                lid = name or f"~{relpath}::{t.id}"
+                mod.module_locks[t.id] = LockDef(
+                    lid, name, reentrant, relpath, node.lineno)
+            elif isinstance(t, ast.Name) and cq is not None:
+                # class-level attr (e.g. _instance_lock)
+                lid = name or f"~{relpath}::{cq}.{t.id}"
+                mod.class_locks[(cq, t.id)] = LockDef(
+                    lid, name, reentrant, relpath, node.lineno)
+                mod.lock_classes.add(cq)
+            elif isinstance(t, ast.Attribute) and isinstance(
+                    t.value, ast.Name) and t.value.id in ("self", "cls") \
+                    and cq is not None:
+                lid = name or f"~{relpath}::{cq}.{t.attr}"
+                mod.class_locks[(cq, t.attr)] = LockDef(
+                    lid, name, reentrant, relpath, node.lineno)
+                mod.lock_classes.add(cq)
+
+    lock_attr_names = {a for (_, a) in mod.class_locks}
+
+    def resolve_lock(expr, class_qual) -> Optional[LockDef]:
+        if isinstance(expr, ast.Name):
+            return mod.module_locks.get(expr.id)
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name):
+            if expr.value.id in ("self", "cls") and class_qual:
+                return mod.class_locks.get((class_qual, expr.attr))
+            return mod.class_locks.get((expr.value.id, expr.attr))
+        return None
+
+    # per-function body walk ------------------------------------------------
+    def scan_function(fn_node, qn, class_qual) -> FuncScan:
+        fs = FuncScan(qn, mod, fn_node, class_qual)
+        held: List[LockDef] = []
+
+        def note_check(g_or_attr, store, line):
+            store.setdefault(g_or_attr, []).append((line, bool(held)))
+
+        def global_name_refs(expr) -> Set[str]:
+            return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)
+                    and n.id in mod.mutable_globals}
+
+        def self_attr_refs(expr) -> Set[str]:
+            out = set()
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Attribute) and isinstance(
+                        n.value, ast.Name) and n.value.id == "self" \
+                        and n.attr not in lock_attr_names:
+                    out.add(n.attr)
+            return out
+
+        def visit(node):
+            if node is not fn_node and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+                return  # nested defs are scanned as their own functions
+            if isinstance(node, ast.With):
+                pushed = []
+                for item in node.items:
+                    ld = resolve_lock(item.context_expr, class_qual)
+                    if ld is not None:
+                        fs.acquire_events.append(
+                            (ld, node.lineno, [h.lid for h in held]))
+                        held.append(ld)
+                        pushed.append(ld)
+                for item in node.items:
+                    visit(item.context_expr)
+                for child in node.body:
+                    visit(child)
+                for _ in pushed:
+                    held.pop()
+                return
+            if isinstance(node, (ast.If, ast.While)):
+                for g in global_name_refs(node.test):
+                    note_check(g, fs.global_checks, node.lineno)
+                for a in self_attr_refs(node.test):
+                    note_check(a, fs.attr_checks, node.lineno)
+            if isinstance(node, ast.Compare):
+                for g in global_name_refs(node):
+                    note_check(g, fs.global_checks, node.lineno)
+                for a in self_attr_refs(node):
+                    note_check(a, fs.attr_checks, node.lineno)
+            if isinstance(node, ast.Call):
+                desc = _call_desc(node, mod, class_qual)
+                if desc is not None:
+                    fs.call_events.append(
+                        (desc, node.lineno, [h.lid for h in held]))
+                label = _blocking_label(node, mod)
+                if label is not None:
+                    fs.blocking_events.append(
+                        (node.lineno, label, [h.lid for h in held]))
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    if f.attr == "get" and isinstance(f.value, ast.Name) \
+                            and f.value.id in mod.mutable_globals:
+                        note_check(f.value.id, fs.global_checks, node.lineno)
+                    if f.attr in MUTATING_METHODS:
+                        if isinstance(f.value, ast.Name) \
+                                and f.value.id in mod.mutable_globals:
+                            note_check(f.value.id, fs.global_writes,
+                                       node.lineno)
+                        if isinstance(f.value, ast.Attribute) and isinstance(
+                                f.value.value, ast.Name) \
+                                and f.value.value.id == "self" \
+                                and f.value.attr not in lock_attr_names:
+                            note_check(f.value.attr, fs.attr_writes,
+                                       node.lineno)
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        if isinstance(t.value, ast.Name) \
+                                and t.value.id in mod.mutable_globals:
+                            note_check(t.value.id, fs.global_writes,
+                                       node.lineno)
+                        if isinstance(t.value, ast.Attribute) \
+                                and isinstance(t.value.value, ast.Name) \
+                                and t.value.value.id == "self" \
+                                and t.value.attr not in lock_attr_names:
+                            note_check(t.value.attr, fs.attr_writes,
+                                       node.lineno)
+                    elif isinstance(t, ast.Name) \
+                            and t.id in declared_globals:
+                        note_check(t.id, fs.global_writes, node.lineno)
+                    elif isinstance(t, ast.Attribute) and isinstance(
+                            t.value, ast.Name) and t.value.id == "self" \
+                            and t.attr not in lock_attr_names:
+                        note_check(t.attr, fs.attr_writes, node.lineno)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        declared_globals: Set[str] = set()
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.Global):
+                declared_globals.update(
+                    g for g in n.names if g in mod.mutable_globals
+                    or g in mod.module_locks)
+                mod.mutable_globals.update(
+                    g for g in n.names if g not in mod.module_locks)
+        visit(fn_node)
+        return fs
+
+    for node, qn in qualnames.items():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod.funcs[qn] = scan_function(node, qn, enclosing_class(node))
+
+    # thread entry points ---------------------------------------------------
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func) or ""
+        last = chain.split(".")[-1]
+        target_expr = None
+        if last in ("Thread", "Timer"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target_expr = kw.value
+            if last == "Timer" and len(node.args) >= 2:
+                target_expr = node.args[1]
+        elif last == "submit" and isinstance(node.func, ast.Attribute) \
+                and node.args:
+            target_expr = node.args[0]
+        if target_expr is None:
+            continue
+        cq = enclosing_class(node)
+        desc = _call_desc(ast.Call(func=target_expr, args=[], keywords=[]),
+                          mod, cq) if isinstance(
+            target_expr, (ast.Name, ast.Attribute)) else None
+        if desc is not None:
+            mod.thread_entry_descs.append(desc)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Whole-program resolution: call graph, transitive acquires, may-block
+# ---------------------------------------------------------------------------
+class Program:
+    def __init__(self, modules: List[ModuleScan],
+                 ranks: Dict[str, int], sinks: Set[str]):
+        self.modules = modules
+        self.ranks = ranks
+        self.sinks = sinks
+        self.funcs: Dict[str, FuncScan] = {}
+        self.methods_by_name: Dict[str, List[FuncScan]] = {}
+        self.lock_defs: Dict[str, LockDef] = {}
+        for m in modules:
+            for qn, fs in m.funcs.items():
+                self.funcs[f"{m.dotted}:{qn}"] = fs
+            for (cq, meth), qn in m.methods.items():
+                self.methods_by_name.setdefault(meth, []).append(
+                    m.funcs[qn])
+            for ld in list(m.module_locks.values()) \
+                    + list(m.class_locks.values()):
+                self.lock_defs.setdefault(ld.lid, ld)
+        self._acq: Dict[int, Set[str]] = {}
+        self._blk: Dict[int, Optional[str]] = {}
+
+    def _module_by_suffix(self, dotted: str) -> Optional[ModuleScan]:
+        for m in self.modules:
+            if m.dotted == dotted or m.dotted.endswith("." + dotted) \
+                    or m.dotted.split(".")[-1] == dotted.split(".")[-1]:
+                return m
+        return None
+
+    def resolve(self, desc: tuple, mod: ModuleScan) -> Optional[FuncScan]:
+        kind = desc[0]
+        if kind == "local":
+            qn = mod.top_funcs.get(desc[1])
+            if qn is not None:
+                return mod.funcs[qn]
+            tgt = mod.import_aliases.get(desc[1])
+            if tgt and "." in tgt:
+                owner, fname = tgt.rsplit(".", 1)
+                m2 = self._module_by_suffix(owner)
+                if m2 is not None and fname in m2.top_funcs:
+                    return m2.funcs[m2.top_funcs[fname]]
+            return None
+        if kind == "self":
+            qn = mod.methods.get((desc[1], desc[2]))
+            return mod.funcs[qn] if qn is not None else None
+        if kind == "module":
+            m2 = self._module_by_suffix(desc[1])
+            if m2 is not None and desc[2] in m2.top_funcs:
+                return m2.funcs[m2.top_funcs[desc[2]]]
+            return None
+        if kind == "attr":
+            cands = self.methods_by_name.get(desc[1], [])
+            return cands[0] if len(cands) == 1 else None
+        return None
+
+    # transitive locks a call of fs may acquire ----------------------------
+    def acquired(self, fs: FuncScan, _seen=None) -> Set[str]:
+        key = id(fs)
+        if key in self._acq:
+            return self._acq[key]
+        _seen = _seen or set()
+        if key in _seen:
+            return set()
+        _seen.add(key)
+        out = {ld.lid for ld, _, _ in fs.acquire_events}
+        for desc, _, _ in fs.call_events:
+            g = self.resolve(desc, fs.module)
+            if g is not None:
+                out |= self.acquired(g, _seen)
+        self._acq[key] = out
+        return out
+
+    # may a call of fs block? (label of the first boundary, or None) -------
+    def may_block(self, fs: FuncScan, _seen=None) -> Optional[str]:
+        key = id(fs)
+        if key in self._blk:
+            return self._blk[key]
+        _seen = _seen or set()
+        if key in _seen:
+            return None
+        _seen.add(key)
+        out: Optional[str] = None
+        if fs.blocking_events:
+            out = fs.blocking_events[0][1]
+        else:
+            for desc, _, _ in fs.call_events:
+                if desc[0] == "attr":
+                    # all same-name candidates must block (conservative
+                    # fallback where unique resolution fails)
+                    cands = self.methods_by_name.get(desc[1], [])
+                    if cands and len(cands) > 1 and all(
+                            self.may_block(c, _seen) for c in cands):
+                        out = (f"call to .{desc[1]}() "
+                               f"(every known implementation blocks)")
+                        break
+                g = self.resolve(desc, fs.module)
+                if g is not None:
+                    lbl = self.may_block(g, _seen)
+                    if lbl is not None:
+                        out = f"call into {g.qualname} -> {lbl}"
+                        break
+        self._blk[key] = out
+        return out
+
+
+def build_edges(prog: Program):
+    """(outer lid, inner lid) -> (relpath, line, qualname, why)."""
+    edges: Dict[Tuple[str, str], tuple] = {}
+
+    def add(outer, inner, fs, line, why):
+        k = (outer, inner)
+        if k not in edges:
+            edges[k] = (fs.module.relpath, line, fs.qualname, why)
+
+    for fs in prog.funcs.values():
+        for ld, line, held in fs.acquire_events:
+            for h in held:
+                add(h, ld.lid, fs, line, f"acquires {ld.label!r} directly")
+        for desc, line, held in fs.call_events:
+            if not held:
+                continue
+            g = prog.resolve(desc, fs.module)
+            if g is None:
+                continue
+            for inner in prog.acquired(g):
+                for h in held:
+                    add(h, inner, fs, line,
+                        f"call into {g.qualname} acquires "
+                        f"{prog.lock_defs[inner].label!r}")
+    return edges
+
+
+def find_cycles(edges) -> List[List[str]]:
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, []).append(b)
+    seen_cycles: Set[frozenset] = set()
+    cycles: List[List[str]] = []
+    color: Dict[str, int] = {}
+    stack: List[str] = []
+
+    def dfs(v):
+        color[v] = 1
+        stack.append(v)
+        for w in adj.get(v, ()):
+            if color.get(w, 0) == 0:
+                dfs(w)
+            elif color.get(w) == 1:
+                cyc = stack[stack.index(w):] + [w]
+                key = frozenset(cyc)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(cyc)
+        stack.pop()
+        color[v] = 2
+
+    for v in list(adj):
+        if color.get(v, 0) == 0:
+            dfs(v)
+    return cycles
+
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+def analyze(target: str) -> Dict[str, List[Finding]]:
+    ranks, sinks = load_manifest()
+    modules = []
+    for path in iter_py_files(target):
+        rel = os.path.relpath(path, REPO_ROOT)
+        m = scan_module(path, rel)
+        if m is not None:
+            modules.append(m)
+    prog = Program(modules, ranks, sinks)
+    edges = build_edges(prog)
+    by_path: Dict[str, List[Finding]] = {}
+
+    def emit(path, line, rule, qual, msg):
+        by_path.setdefault(path, []).append(
+            Finding(path, line, rule, qual, msg))
+
+    # --- TPU101: order violations on the static acquire graph -------------
+    for (outer, inner), (path, line, qual, why) in sorted(edges.items()):
+        od = prog.lock_defs.get(outer)
+        idf = prog.lock_defs.get(inner)
+        o_name = od.manifest_name if od else None
+        i_name = idf.manifest_name if idf else None
+        if o_name is not None and o_name in sinks:
+            emit(path, line, "TPU101", qual,
+                 f"leaf-sink lock {o_name!r} held while {why} — leaf "
+                 "sinks must never call out (locks.py LEAF_SINKS)")
+            continue
+        if o_name is not None and i_name is not None:
+            if o_name == i_name:
+                if od is not None and not od.reentrant:
+                    emit(path, line, "TPU101", qual,
+                         f"non-reentrant lock {o_name!r} re-acquired "
+                         f"while already held ({why}) — self-deadlock")
+                continue
+            if ranks.get(o_name, -1) >= ranks.get(i_name, 10 ** 9):
+                emit(path, line, "TPU101", qual,
+                     f"lock-order inversion: {why} while holding "
+                     f"{o_name!r} (rank {ranks[o_name]} >= rank "
+                     f"{ranks[i_name]}) — LOCK_ORDER only permits "
+                     "acquiring downward")
+            continue
+        if o_name is None and i_name is not None and i_name not in sinks:
+            emit(path, line, "TPU101", qual,
+                 f"undeclared lock {outer!r} held while {why} — raw "
+                 "threading locks must not sit above the declared "
+                 "hierarchy; migrate it onto ordered_lock() or "
+                 "restructure")
+    for cyc in find_cycles(edges):
+        first = edges[(cyc[0], cyc[1])]
+        labels = [prog.lock_defs[lid].label if lid in prog.lock_defs
+                  else lid for lid in cyc]
+        emit(first[0], first[1], "TPU101", first[2],
+             "cycle in the static acquire graph: "
+             + " -> ".join(labels) + " — deadlock possible")
+
+    # --- TPU102: check-then-act on shared mutable state --------------------
+    for m in modules:
+        if not m.uses_threading:
+            continue
+        for fs in m.funcs.values():
+            fname = fs.qualname.rsplit(".", 1)[-1]
+            for g, checks in fs.global_checks.items():
+                writes = fs.global_writes.get(g, [])
+                bad_c = [ln for ln, lk in checks if not lk]
+                bad_w = [ln for ln, lk in writes if not lk]
+                if bad_c and bad_w and min(bad_c) <= max(bad_w):
+                    emit(m.relpath, min(bad_c), "TPU102", fs.qualname,
+                         f"check-then-act on module global {g!r}: read at "
+                         f"line {min(bad_c)} and write at line "
+                         f"{max(bad_w)} with no lock held — two threads "
+                         "can interleave; double-check under a lock "
+                         "(exec/base.cached_pipeline is the sanctioned "
+                         "helper for caches)")
+            if fs.class_qual is None or fname == "__init__" \
+                    or fs.class_qual not in m.lock_classes:
+                continue
+            for a, checks in fs.attr_checks.items():
+                writes = fs.attr_writes.get(a, [])
+                bad_c = [ln for ln, lk in checks if not lk]
+                bad_w = [ln for ln, lk in writes if not lk]
+                if bad_c and bad_w and min(bad_c) <= max(bad_w):
+                    emit(m.relpath, min(bad_c), "TPU102", fs.qualname,
+                         f"check-then-act on self.{a} in lock-owning "
+                         f"class {fs.class_qual}: read at line "
+                         f"{min(bad_c)} and write at line {max(bad_w)} "
+                         "with the class's lock not held — hold the lock "
+                         "for the transition or double-check under it")
+
+    # --- TPU103: unlocked global mutation from a thread --------------------
+    prog_funcs = list(prog.funcs.values())
+    thread_run: Set[int] = set()
+    work: List[FuncScan] = []
+    for m in modules:
+        for desc in m.thread_entry_descs:
+            g = prog.resolve(desc, m)
+            if g is not None and id(g) not in thread_run:
+                thread_run.add(id(g))
+                work.append(g)
+    while work:
+        fs = work.pop()
+        for desc, _, _ in fs.call_events:
+            g = prog.resolve(desc, fs.module)
+            if g is not None and id(g) not in thread_run:
+                thread_run.add(id(g))
+                work.append(g)
+    for fs in prog_funcs:
+        if id(fs) not in thread_run:
+            continue
+        for g, writes in fs.global_writes.items():
+            bad = [ln for ln, lk in writes if not lk]
+            if bad:
+                emit(fs.module.relpath, min(bad), "TPU103", fs.qualname,
+                     f"module global {g!r} mutated from a thread-run "
+                     "function with no lock held — racing the main "
+                     "thread's readers; guard it or hand the data over "
+                     "via a queue/immutable snapshot")
+
+    # --- TPU104: manifest lock held across a blocking boundary -------------
+    seen104: Set[tuple] = set()
+    for fs in prog.funcs.values():
+        for line, label, held in fs.blocking_events:
+            for h in held:
+                hd = prog.lock_defs.get(h)
+                if hd is None or hd.manifest_name is None:
+                    continue
+                k = (fs.module.relpath, fs.qualname, h)
+                if k not in seen104:
+                    seen104.add(k)
+                    emit(fs.module.relpath, line, "TPU104", fs.qualname,
+                         f"manifest lock {hd.manifest_name!r} held "
+                         f"across a blocking boundary: {label} — every "
+                         "other acquirer stalls behind the block")
+        for desc, line, held in fs.call_events:
+            man = [h for h in held
+                   if prog.lock_defs.get(h) is not None
+                   and prog.lock_defs[h].manifest_name is not None]
+            if not man:
+                continue
+            g = prog.resolve(desc, fs.module)
+            lbl = prog.may_block(g) if g is not None else None
+            if lbl is None:
+                continue
+            for h in man:
+                k = (fs.module.relpath, fs.qualname, h)
+                if k not in seen104:
+                    seen104.add(k)
+                    emit(fs.module.relpath, line, "TPU104", fs.qualname,
+                         f"manifest lock "
+                         f"{prog.lock_defs[h].manifest_name!r} held "
+                         f"across a blocking boundary: {lbl}")
+    return by_path
+
+
+def dump_graph(target: str) -> int:
+    """Print the static manifest-edge set. The chaos suite cross-checks
+    the witness's observed edges against these: both must be downward in
+    LOCK_ORDER, and the hot statically-predicted edges must actually be
+    observed (the static set under-approximates dynamic dispatch, so
+    observed ⊆ static does not hold exactly)."""
+    ranks, sinks = load_manifest()
+    modules = [m for m in (
+        scan_module(p, os.path.relpath(p, REPO_ROOT))
+        for p in iter_py_files(target)) if m is not None]
+    prog = Program(modules, ranks, sinks)
+    for (outer, inner), (path, line, qual, _why) in sorted(
+            build_edges(prog).items()):
+        od, idf = prog.lock_defs.get(outer), prog.lock_defs.get(inner)
+        if od is None or idf is None:
+            continue
+        if od.manifest_name and idf.manifest_name:
+            print(f"{od.manifest_name} -> {idf.manifest_name}"
+                  f"  # {path}:{line} {qual}")
+    return 0
+
+
+def main(argv: List[str]) -> int:
+    args = [a for a in argv if not a.startswith("--")]
+    target = os.path.abspath(args[0]) if args else DEFAULT_TARGET
+    if not os.path.exists(target):
+        print(f"tpu_racecheck: no such target {target}", file=sys.stderr)
+        return 2
+    if "--dump-graph" in argv:
+        return dump_graph(target)
+    by_path = analyze(target)
+
+    def check_file(path: str, relpath: str) -> List[Finding]:
+        return by_path.get(relpath, [])
+
+    return run_tool("tpu_racecheck", argv, target,
+                    _default_allowlist_path(), check_file)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
