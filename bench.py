@@ -101,12 +101,19 @@ def _mem_snapshot():
     rebases the BufferCatalog's peak watermark to the CURRENT level so
     the value read after the shape is THIS shape's peak, not a hungrier
     earlier shape's (the watermark is a monotonic process-wide max;
-    bench owns the process, so resetting it between shapes is safe)."""
+    bench owns the process, so resetting it between shapes is safe).
+    The obs tpu_program_temp_bytes high-water gauge gets the same
+    per-shape rebase — a scrape during shape N must report shape N's
+    compile peaks, not the run's."""
+    from spark_rapids_tpu import obs as _obs
     from spark_rapids_tpu.io.scan_cache import DeviceScanCache
     from spark_rapids_tpu.memory.catalog import BufferCatalog
 
     cat = BufferCatalog.get()
     cat.metrics.peak_device_bytes = cat.device_bytes
+    reg = _obs.active()
+    if reg is not None:
+        reg.rebase_gauge("tpu_program_temp_bytes")
     inst = DeviceScanCache._instance
     return (inst.hits, inst.misses) if inst is not None else (0, 0)
 
@@ -1332,6 +1339,14 @@ def main() -> None:
         "--cold-start-child", type=str, default="",
         help=argparse.SUPPRESS)  # internal: one fresh-process shape run
     ap.add_argument(
+        "--donation", type=str, default="on", choices=("on", "off"),
+        help="buffer donation at the analyzer-certified compile sites "
+             "(plugin/donation.py). 'on' (default) also keeps the "
+             "InMemoryScan host-resident so fresh per-execute uploads "
+             "are exclusive and donatable; 'off' disables donation "
+             "engine-wide — diff the two runs' donated_bytes / "
+             "xla_peak_temp_bytes per shape to price the feature")
+    ap.add_argument(
         "--event-log", type=str, default="",
         help="directory for a structured JSONL event log of the bench run "
              "(spark.rapids.tpu.eventLog.dir); inspect it offline with "
@@ -1379,11 +1394,19 @@ def main() -> None:
     # order-insensitive float aggregation, as the reference's own benchmark
     # runs enable (spark.rapids.sql.variableFloatAgg.enabled)
     conf_dict = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": True}
+    if args.donation == "on":
+        # hostResident makes every scan execute upload FRESH planes the
+        # scan marks exclusive — without it the shapes' device-resident
+        # scan batches are shared across iters and never donate
+        conf_dict["spark.rapids.tpu.sql.inMemoryScan.hostResident"] = True
+    else:
+        conf_dict["spark.rapids.tpu.sql.donation.enabled"] = False
     # compiled-program cost plane: harvest XLA's own bytes/flops at every
     # compile miss (warm-up only — the timed iterations compile nothing)
     # so each shape reports hbm_frac_xla, the compiler-reported twin of
     # the layout-derived hbm_frac_device; the two bound the truth
     from spark_rapids_tpu import envinfo, hlo, xla_cost
+    from spark_rapids_tpu.plugin import donation as _donation
 
     xla_cost.FORCE_HARVEST = True
     # environment provenance: stamped into the BENCH json top level (and
@@ -1417,7 +1440,12 @@ def main() -> None:
         mem_before = _mem_snapshot()
         cost_before = xla_cost.snapshot()
         hlo_before = hlo.snapshot()
+        don_before = _donation.snapshot_counters()
         cpu_t, tpu_t, extra = fn(args.scale, args.iters, carg, T, E, A, X)
+        don_delta = _donation.counters_since(don_before)
+        extra["donated_bytes"] = sum(don_delta.values())
+        if don_delta:
+            extra["donated_bytes_by_site"] = don_delta
         extra.update(_mem_stats(mem_before))
         extra.update(_xla_stats(cost_before, extra.get("device_ms"),
                                 peak_gbps))
@@ -1465,6 +1493,7 @@ def main() -> None:
         "unit": f"x (pipeline wallclock; scale={args.scale})",
         "vs_baseline": round(geomean / 4.0, 3),
         "geomean_all_shapes": round(geomean, 3),
+        "donation": args.donation,
         "env": env,
         "per_shape": details,
         **extras,

@@ -19,12 +19,19 @@ class ColumnarBatch:
     caches; ``num_rows_lazy`` never syncs.
     """
 
-    __slots__ = ("columns", "schema", "_num_rows", "_capacity")
+    __slots__ = ("columns", "schema", "_num_rows", "_capacity",
+                 "exclusive")
 
     def __init__(self, columns: Sequence[DeviceColumn], schema: StructType,
                  num_rows=None, capacity: Optional[int] = None):
         self.columns: List[DeviceColumn] = list(columns)
         self.schema = schema
+        # exclusivity mark (plugin/donation.py): True only when the
+        # producer guarantees no other reference to these planes exists,
+        # so a certified downstream dispatch may donate them to XLA.
+        # select() deliberately builds non-exclusive batches — it SHARES
+        # columns with this one.
+        self.exclusive = False
         if num_rows is None:
             num_rows = int(columns[0].length) if columns else 0
         self._num_rows = num_rows

@@ -504,6 +504,56 @@ RACECHECK_WITNESS_ENABLED = conf(
     "one module-global read (the event-log zero-overhead contract). "
     "The SRTPU_RACECHECK_WITNESS=1 environment variable turns it on at "
     "import for subprocess/CI runs.")
+DONATION_ENABLED = conf(
+    "spark.rapids.tpu.sql.donation.enabled", True,
+    "Donate dead-after-dispatch input planes to XLA (donate_argnums) at "
+    "the compile sites the donation-safety analyzer certifies "
+    "(tools/tpu_donate.py; plugin/donation.py holds the per-site "
+    "certification table). A donated plane's HBM is reused for the "
+    "program's outputs/temps, cutting peak temp bytes; soundness comes "
+    "from the batch-exclusivity protocol — only batches explicitly "
+    "marked exclusive by their producer ever donate, so scan-cache / "
+    "catalog / spill-held planes are never aliased away. Disable to "
+    "force copy-semantics dispatch everywhere (the donation "
+    "differential tests diff the two bit-for-bit).")
+DONATION_RETRY_SNAPSHOT = conf(
+    "spark.rapids.tpu.sql.donation.retrySnapshot.enabled", True,
+    "At donating sites under with_oom_retry, snapshot donated planes to "
+    "host before dispatch and restore them on failure, so split-and-"
+    "retry can re-read the input batch it re-dispatches (memory/"
+    "retry.py's contract). Disabling switches those sites to exclusion "
+    "mode — retry-covered args are simply not donated — trading the "
+    "snapshot's host round-trip for the lost donation win.")
+DONATION_WITNESS_ENABLED = conf(
+    "spark.rapids.tpu.tools.donation.witness.enabled", False,
+    "Install the runtime donation witness: after every donating "
+    "dispatch, assert at least one donated buffer was actually deleted "
+    "by JAX (the backend may decline INDIVIDUAL aliases — a validity "
+    "plane matching no output — but a mask with NO effect means the "
+    "certification named an argnum the program never aliased) and "
+    "convert any "
+    "use-after-donation 'Array has been deleted' error into a typed, "
+    "op-attributed TpuDonationViolation naming the site and plane. Off "
+    "by default — a dispatch then costs one module-global read (the "
+    "event-log zero-overhead contract). The SRTPU_DONATION_WITNESS=1 "
+    "environment variable turns it on at import for subprocess/CI runs.")
+DONATE_ALLOWLIST_PATH = conf(
+    "spark.rapids.tpu.tools.donate.allowlistPath",
+    "tools/tpu_donate_allow.txt",
+    "Path (relative to the repo root) of the donation-safety analyzer's "
+    "allowlist file — the documented deliberate exceptions "
+    "tools/tpu_donate.py accepts (one 'path::qualname::RULE  # why' per "
+    "line). Read by the donation TOOL at startup (override per run with "
+    "--allowlist=); not a per-session runtime setting.")
+SCAN_HOST_RESIDENT = conf(
+    "spark.rapids.tpu.sql.inMemoryScan.hostResident", False,
+    "Keep InMemoryScanExec partitions host-resident and upload fresh "
+    "device planes on every execute (the faithful Spark .cache() "
+    "semantics: the cached representation survives the query). Fresh "
+    "uploads are exclusive to the executing query, so downstream "
+    "certified sites can donate them; the default device-resident mode "
+    "retains device batches across executes (zero re-upload cost) and "
+    "therefore never donates scan planes.")
 
 # ---------------------------------------------------------------------------
 # Live observability plane (obs/): metrics registry, /metrics + /status

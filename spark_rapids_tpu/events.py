@@ -98,9 +98,13 @@ EVENT_TYPES: Dict[str, tuple] = {
     # Renders as a duration span on the Perfetto "compile" track plus a
     # cumulative compile-seconds counter; tools/tpu_profile.py joins it
     # against the op_span device lane in '== roofline =='.
+    # ``alias_bytes``: input bytes XLA aliased to outputs under buffer
+    # donation (plugin/donation.py); temp_bytes arrives alias-CORRECTED
+    # (raw temp minus alias — see xla_cost.harvest_compiled), so a
+    # donating program's temp genuinely reflects scratch HBM
     "program_cost": ("site", "digest", "backend", "trace_ms",
                      "compile_ms", "flops", "bytes_accessed", "temp_bytes",
-                     "argument_bytes", "output_bytes"),
+                     "argument_bytes", "output_bytes", "alias_bytes"),
     # per-fusion HLO attribution of one harvested program (hlo.py):
     # emitted right after its program_cost twin (same site+digest), it
     # names WHICH instructions own the bytes — top-K fusions by
@@ -129,6 +133,10 @@ EVENT_TYPES: Dict[str, tuple] = {
     # one split-and-retry halving: the input rows and both pieces'
     # (first piece takes the extra row on odd counts)
     "batch_split": ("op", "depth", "rows", "rows_left", "rows_right"),
+    # one donating dispatch (plugin/donation.py): ``bytes`` of input
+    # planes handed to XLA for reuse, ``planes`` how many arrays, at
+    # which certified compile site, attributed to the dispatching op
+    "donation": ("site", "op", "bytes", "planes"),
     # shuffle pieces through the transport SPI (shuffle/transport.py)
     "shuffle_write": ("shuffle_id", "map_id", "reduce_id", "rows", "bytes",
                       "codec"),

@@ -207,6 +207,7 @@ def _agg_pipeline(
     str_val_max_lens: Tuple[int, ...] = (),
     nonnull: Tuple[bool, ...] = (),
     strategy: Optional[str] = None,
+    donate: Tuple[int, ...] = (),
 ):
     """ONE fused program: child chain (filter/project/join probe...),
     key+input projection, groupby reduce — a whole query stage per
@@ -248,11 +249,12 @@ def _agg_pipeline(
                 vals, list(ops), live, str_val_max_lens=str_val_max_lens)
             return [], outs, jnp.int32(1)
 
-        return jax.jit(run)
+        return jax.jit(run, donate_argnums=donate)
 
     from .base import cached_pipeline
 
-    return cached_pipeline(_AGG_CACHE, key, "agg_update", build)
+    return cached_pipeline(_AGG_CACHE, key, "agg_update", build,
+                           donate=donate)
 
 
 def _fused_agg_trace(key_exprs, key_dts, value_exprs, update_ops, merge_ops,
@@ -523,12 +525,17 @@ class TpuHashAggregateExec(TpuExec):
 
     def _run_batch(self, batch: ColumnarBatch, ops: Sequence[str],
                    value_exprs: Sequence[Optional[E.Expression]],
-                   chain=(), live=None, nonnull=None) -> ColumnarBatch:
+                   chain=(), live=None, nonnull=None,
+                   donate_input: bool = False) -> ColumnarBatch:
         """Aggregate one (source) batch into a [keys..., buffers...] batch,
         fusing any fusable child execs into the same XLA program. The group
         count stays a device scalar — no sync. ``live``: optional (cap,)
         bool mask overriding the batch's prefix row count (used by the
-        sync-free merge, where live rows are NOT a prefix)."""
+        sync-free merge, where live rows are NOT a prefix).
+        ``donate_input``: only the streaming per-batch UPDATE path sets
+        it — merge callers re-dispatch the same partials under
+        with_oom_retry_nosplit, so their inputs are never dead (the
+        agg_merge verdict in plugin/donation.py)."""
         cap = batch.capacity  # batches carry their bucket even zero-column
         sml = self._str_max_lens(batch, direct=not chain)
         # string-typed min/max inputs need a static byte bound for the
@@ -547,17 +554,29 @@ class TpuHashAggregateExec(TpuExec):
 
             nonnull = entry_nonnull_flags(batch.schema, self.conf)
         sides = [e.side_vals() for e in chain]
+        from .base import _donation
+
+        don = _donation()
+        mask = (don.dispatch_mask("agg_update", batch, self.conf)
+                if donate_input else ())
         fn = _agg_pipeline(
             chain, tuple(self._bound_keys), self._key_dtypes(),
             tuple(value_exprs), tuple(ops), batch_signature(batch), cap, sml,
             approx_float_sum=self.conf.get(IMPROVED_FLOAT_OPS),
             sides=sides, str_val_max_lens=svml, nonnull=nonnull,
-            strategy=self.resolved_strategy(cap),
+            strategy=self.resolved_strategy(cap), donate=mask,
         )
-        keys, aggs, nseg = fn(
-            vals_of_batch(batch),
-            live if live is not None else count_scalar(batch.num_rows_lazy),
-            sides)
+        nr = (live if live is not None
+              else count_scalar(batch.num_rows_lazy))
+        if mask:
+            # split-and-retry re-dispatches this batch on OOM, so the
+            # guard snapshots its planes and restores them on failure
+            with don.guard("agg_update", batch, op=self.node_name,
+                           conf=self.conf,
+                           metric=self.metric("donatedBytes")):
+                keys, aggs, nseg = fn(vals_of_batch(batch), nr, sides)
+        else:
+            keys, aggs, nseg = fn(vals_of_batch(batch), nr, sides)
         vals = list(keys) + list(aggs)
         return batch_from_vals(vals, self._buffer_schema, nseg)
 
@@ -882,17 +901,32 @@ class TpuHashAggregateExec(TpuExec):
                 ]
                 return finish(partial_sets)
 
-            return jax.jit(run)
+            return jax.jit(run, donate_argnums=mask)
 
-        from .base import cached_pipeline
+        from .base import _donation, cached_pipeline
 
-        fn = cached_pipeline(_AGG_CACHE, key, "agg_plan", build)
-        vals, nseg = fn(
-            [vals_of_batch(b) for b in batches],
-            [count_scalar(b.num_rows_lazy) for b in batches], sides)
+        don = _donation()
+        # argnum 0 is EVERY buffered batch's plane pytree: the mask is
+        # non-empty only when all of them are donatable, because one
+        # shared batch in the list poisons the whole dispatch
+        mask = don.dispatch_mask("agg_plan", batches, self.conf)
+        fn = cached_pipeline(_AGG_CACHE, key, "agg_plan", build,
+                             donate=mask)
+        all_nr = [count_scalar(b.num_rows_lazy) for b in batches]
+        if mask:
+            # the device-OOM fallback (flush_buffered) re-reads the
+            # buffered batches, so the guard snapshots/restores them
+            with don.guard("agg_plan", batches, op=self.node_name,
+                           conf=self.conf,
+                           metric=self.metric("donatedBytes")):
+                vals, nseg = fn(
+                    [vals_of_batch(b) for b in batches], all_nr, sides)
+        else:
+            vals, nseg = fn(
+                [vals_of_batch(b) for b in batches], all_nr, sides)
         schema = (self._buffer_schema if self.mode == A.PARTIAL
                   else self._schema)
-        return batch_from_vals(vals, schema, nseg)
+        return don.mark_exclusive(batch_from_vals(vals, schema, nseg))
 
     #: fused-plan guard: above this many stacked capacity rows the
     #: in-trace padded merge's dead-row blowup outweighs the saved
@@ -975,7 +1009,8 @@ class TpuHashAggregateExec(TpuExec):
             partials.extend(with_oom_retry(
                 self.node_name,
                 lambda piece: self._run_batch(
-                    piece, ops, exprs, tuple(chain), nonnull=src_nonnull),
+                    piece, ops, exprs, tuple(chain), nonnull=src_nonnull,
+                    donate_input=True),
                 b, self.conf, combine="list",
                 on_pressure=getattr(source, "invalidate_prefetch", None)))
 
@@ -1059,4 +1094,9 @@ class TpuHashAggregateExec(TpuExec):
             # typed TpuRetryOOM verdict
             out = with_oom_retry_nosplit(
                 self.node_name + ".merge", merge_and_eval, self.conf)
-        yield self.record_batch(out)
+        # the merged/evaluated output leaves this generator as its only
+        # live reference (the partials list is never read again after
+        # the yield), so downstream certified sites may donate it
+        from .base import _donation
+
+        yield self.record_batch(_donation().mark_exclusive(out))

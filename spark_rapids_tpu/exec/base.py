@@ -11,7 +11,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from typing import Callable, Dict, Iterator, List, Optional, Sequence
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 
@@ -115,7 +115,18 @@ def clear_pipeline_caches() -> int:
 
 def cached_pipeline(cache: dict, key, site: Optional[str],
                     build: Callable[[], Callable],
-                    max_entries: int = 512) -> Callable:
+                    max_entries: int = 512,
+                    donate: Tuple[int, ...] = ()) -> Callable:
+    if donate:
+        # the donation mask is part of the program's identity: a
+        # donating and a non-donating dispatch of the same logical
+        # pipeline are DIFFERENT executables (input/output aliasing
+        # differs), and the fold below also reaches the AOT
+        # program-cache entry name (entry_name hashes the key repr) so
+        # a warm process can never load a non-donating export into a
+        # donating call site. tools/tpu_donate.py TPU203 flags any
+        # donate_argnums declared outside this chokepoint.
+        key = (key, ("donate", tuple(donate)))
     fn = cache.get(key)
     if fn is not None:
         return fn
@@ -138,7 +149,7 @@ def cached_pipeline(cache: dict, key, site: Optional[str],
                 # persisted cost payload flagged from_cache at first
                 # call. Anything else (entry absent, corrupt, identity
                 # mismatch) returns None and the plain path below runs.
-                fn = pc.lookup(site, key, build)
+                fn = pc.lookup(site, key, build, donate=donate)
             if fn is None:
                 if _faults.enabled():
                     # injected compile failure (chaos testing): raised
@@ -153,7 +164,7 @@ def cached_pipeline(cache: dict, key, site: Optional[str],
                     # persists at first call AND subsumes the cost-plane
                     # harvest (it falls back to xla_cost.wrap itself for
                     # programs that cannot participate)
-                    fn = pc.wrap_store(build(), site, key)
+                    fn = pc.wrap_store(build(), site, key, donate=donate)
                 else:
                     # compiled-program cost plane (xla_cost.py): while a
                     # cost consumer is active (events / obs / the
@@ -785,8 +796,18 @@ def side_signature(sides: Sequence[tuple]) -> tuple:
     )
 
 
+def _donation():
+    """Lazy handle on plugin/donation.py — plugin/__init__ imports the
+    overrides layer which imports this module, so a module-level import
+    here would cycle; by first dispatch everything is in sys.modules."""
+    from ..plugin import donation
+
+    return donation
+
+
 def fused_pipeline(chain: Sequence[TpuExec], sig: tuple, cap: int,
-                   sides: Sequence[tuple] = (), nonnull: tuple = ()):
+                   sides: Sequence[tuple] = (), nonnull: tuple = (),
+                   donate: Tuple[int, ...] = ()):
     """One jitted program applying every exec in ``chain`` bottom-up.
 
     The chain threads a liveness MASK between stages; if any stage
@@ -818,10 +839,10 @@ def fused_pipeline(chain: Sequence[TpuExec], sig: tuple, cap: int,
                 return cols, count
             return cols, num_rows
 
-        return jax.jit(run)
+        return jax.jit(run, donate_argnums=donate)
 
     return cached_pipeline(_FUSED_CACHE, key, "fused_chain", build,
-                           max_entries=1024)
+                           max_entries=1024, donate=donate)
 
 
 def run_fused_chain(exec_self: TpuExec, index: int) -> Iterator[ColumnarBatch]:
@@ -846,12 +867,28 @@ def run_fused_chain(exec_self: TpuExec, index: int) -> Iterator[ColumnarBatch]:
     on_pressure = getattr(source, "invalidate_prefetch", None)
 
     def attempt(b: ColumnarBatch) -> ColumnarBatch:
+        don = _donation()
         cap = b.capacity
+        mask = don.dispatch_mask("fused_chain", b, exec_self.conf)
         fn = fused_pipeline(chain, batch_signature(b), cap, sides,
-                            nonnull)
-        vals, nr = fn(
-            vals_of_batch(b), count_scalar(b.num_rows_lazy), sides)
-        return batch_from_vals(vals, out_schema, nr, capacity=cap)
+                            nonnull, donate=mask)
+        if mask:
+            # donating dispatch: the guard snapshots b's planes so
+            # split-and-retry can re-read them on failure, accounts
+            # donated_bytes, and (under the witness) asserts the
+            # donated buffers really died
+            with don.guard("fused_chain", b, op=exec_self.node_name,
+                           conf=exec_self.conf,
+                           metric=exec_self.metric("donatedBytes")):
+                vals, nr = fn(vals_of_batch(b),
+                              count_scalar(b.num_rows_lazy), sides)
+        else:
+            vals, nr = fn(
+                vals_of_batch(b), count_scalar(b.num_rows_lazy), sides)
+        # the output planes come straight out of the program — no other
+        # reference exists, so the next certified site may donate them
+        return don.mark_exclusive(
+            batch_from_vals(vals, out_schema, nr, capacity=cap))
 
     for batch in source.execute_partition(index):
         with exec_self.op_timed():

@@ -50,13 +50,27 @@ def _output_schema_for(exprs: Sequence[E.Expression], child: StructType) -> Stru
 
 
 class InMemoryScanExec(TpuExec):
-    """Leaf over already-device-resident batches (test/data source seam)."""
+    """Leaf over already-device-resident batches (test/data source seam).
+
+    Under ``spark.rapids.tpu.sql.inMemoryScan.hostResident`` the cached
+    representation lives on the HOST (the faithful Spark ``.cache()``
+    semantics — the cache survives the query) and every execute uploads
+    fresh device planes. Fresh uploads have exactly one reference — the
+    executing query — so they are marked exclusive and every certified
+    downstream site may donate them (plugin/donation.py). The default
+    device-resident mode retains device batches across executes and
+    therefore never marks them: donating a retained plane would delete
+    the cache out from under the next query."""
 
     def __init__(self, conf: RapidsConf, partitions: Sequence[Sequence[ColumnarBatch]],
                  schema: StructType):
         super().__init__(conf)
         self._partitions = [list(p) for p in partitions]
         self._schema = schema
+        from ..conf import SCAN_HOST_RESIDENT
+
+        self._host_resident = bool(conf.get(SCAN_HOST_RESIDENT))
+        self._host_planes: Optional[List[List[Optional[tuple]]]] = None
 
     @property
     def output_schema(self):
@@ -66,7 +80,63 @@ class InMemoryScanExec(TpuExec):
     def num_partitions(self):
         return len(self._partitions)
 
+    def _snapshot_to_host(self) -> List[List[Optional[tuple]]]:
+        """One-time demotion of the cached batches to host numpy planes
+        (one batched pull per batch through the sanctioned sync point).
+        Dict-encoded batches stay device-resident — their dictionary
+        pools are shared, so they could never donate anyway. Built into
+        a local and assigned whole by the caller: concurrent partition
+        executors may both compute it (idempotent — source batches are
+        immutable), but neither ever observes a partial list."""
+        from .base import host_pull
+
+        out: List[List[Optional[tuple]]] = []
+        for part in self._partitions:
+            rows: List[Optional[tuple]] = []
+            for b in part:
+                if any(c.is_dict for c in b.columns):
+                    rows.append(None)
+                    continue
+                planes = []
+                for c in b.columns:
+                    planes.append(tuple(
+                        getattr(c, s, None)
+                        for s in ("data", "validity", "offsets", "chars")))
+                pulled = host_pull(
+                    [a for ps in planes for a in ps if a is not None])
+                it = iter(pulled)
+                rows.append((b.num_rows, b.capacity, tuple(
+                    tuple(next(it) if a is not None else None for a in ps)
+                    for ps in planes)))
+            out.append(rows)
+        return out
+
+    def _upload(self, b: ColumnarBatch, snap: tuple) -> ColumnarBatch:
+        import jax.numpy as jnp
+
+        from ..plugin import donation as _donation
+
+        num_rows, _cap, planes = snap
+        cols = []
+        for c, (data, validity, offsets, chars) in zip(b.columns, planes):
+            cols.append(DeviceColumn(
+                c.dtype, num_rows,
+                None if data is None else jnp.asarray(data),
+                jnp.asarray(validity),
+                None if offsets is None else jnp.asarray(offsets),
+                None if chars is None else jnp.asarray(chars)))
+        return _donation.mark_exclusive(
+            ColumnarBatch(cols, self._schema, num_rows))
+
     def execute_partition(self, index: int) -> Iterator[ColumnarBatch]:
+        if self._host_resident:
+            if self._host_planes is None:
+                self._host_planes = self._snapshot_to_host()
+            for b, snap in zip(self._partitions[index],
+                               self._host_planes[index]):
+                yield self.record_batch(
+                    b if snap is None else self._upload(b, snap))
+            return
         for b in self._partitions[index]:
             yield self.record_batch(b)
 
@@ -99,7 +169,8 @@ _PROJECT_CACHE: dict = {}
 
 
 def _project_pipeline(exprs: Tuple[E.Expression, ...], sig: tuple, cap: int,
-                      nonnull: Tuple[bool, ...] = ()):
+                      nonnull: Tuple[bool, ...] = (),
+                      donate: Tuple[int, ...] = ()):
     """Standalone projection program. ``nonnull``: the plan analyzer's
     validity-elision flags for the input columns — flagged columns swap
     their stored validity plane for the iota-derived liveness mask
@@ -114,11 +185,12 @@ def _project_pipeline(exprs: Tuple[E.Expression, ...], sig: tuple, cap: int,
                 cols = filter_gather.elide_validity(cols, live, nonnull)
             return [lower(e, cols, cap) for e in exprs]
 
-        return jax.jit(run)
+        return jax.jit(run, donate_argnums=donate)
 
     from .base import cached_pipeline
 
-    return cached_pipeline(_PROJECT_CACHE, key, "project", build)
+    return cached_pipeline(_PROJECT_CACHE, key, "project", build,
+                           donate=donate)
 
 
 class TpuProjectExec(TpuExec):
@@ -278,12 +350,30 @@ class TpuProjectExec(TpuExec):
                     list(batch.columns) + extra_cols,
                     StructType(tuple(child_schema.fields) + tuple(extra_fields)),
                     batch.num_rows_lazy)
-                fn = _project_pipeline(
-                    rewritten, batch_signature(ext), cap)
+                from .base import _donation
                 from .base import count_scalar as _cs
 
-                vals = fn(vals_of_batch(ext), _cs(batch.num_rows_lazy))
-                out = batch_from_vals(vals, self._schema, batch.num_rows_lazy)
+                don = _donation()
+                # ext shares the child batch's planes; the appended ctx
+                # columns are fresh by construction, so the dispatch may
+                # donate exactly when the CHILD batch is donatable (the
+                # loop reads only its scalar row count afterwards)
+                nr_lazy = batch.num_rows_lazy
+                mask = don.dispatch_mask("project", batch, self.conf)
+                fn = _project_pipeline(
+                    rewritten, batch_signature(ext), cap, donate=mask)
+                if mask:
+                    # no retry harness wraps this dispatch, so the
+                    # snapshot leg of the guard is skipped: nothing
+                    # re-reads the planes on failure
+                    with don.guard("project", ext, op=self.node_name,
+                                   snapshot=False,
+                                   metric=self.metric("donatedBytes")):
+                        vals = fn(vals_of_batch(ext), _cs(nr_lazy))
+                else:
+                    vals = fn(vals_of_batch(ext), _cs(nr_lazy))
+                out = don.mark_exclusive(
+                    batch_from_vals(vals, self._schema, nr_lazy))
             yield self.record_batch(out)
             nr = batch.num_rows_lazy
             row_base = (row_base + nr if isinstance(nr, int)

@@ -834,9 +834,14 @@ class TpuShuffledHashJoinExec(TpuExec):
             matched = join_ops.matched_build_mask(lo, lo + counts, live, build_cap)
 
         if jt in ("semi", "anti"):
+            from .base import _donation as _don_semi
+
             vals, count = filter_gather.filter_cols(
                 vals_of_batch(pbatch), aux, pbatch.num_rows_lazy)
-            return batch_from_vals(vals, self._schema, count), matched
+            # the compacted output's planes are freshly gathered — no
+            # other reference exists, so downstream sites may donate
+            return _don_semi().mark_exclusive(
+                batch_from_vals(vals, self._schema, count)), matched
 
         total = int(jnp.sum(aux))
         if total == 0:
@@ -891,9 +896,28 @@ class TpuShuffledHashJoinExec(TpuExec):
                     len(build_cols),
                     tuple(int(c.data.shape[0]) for c in build_cols),
                     strategy)
-            fne = self._jit_cache_get(ekey, expand_phase)
-            probe_side, build_side = fne(
-                vals_of_batch(pbatch), list(build_cols), lo, counts, aux)
+            from .base import _donation
+
+            don = _donation()
+            # the expand dispatch is the LAST read of the probe planes
+            # (count_phase above already ran) — the one join program
+            # certified to donate; build_cols (argnum 1) serve every
+            # probe batch and never donate
+            mask = don.dispatch_mask("join", pbatch, self.conf)
+            fne = self._jit_cache_get(ekey, expand_phase, donate=mask)
+            if mask:
+                # with_oom_retry re-dispatches this probe batch on OOM,
+                # so the guard snapshots/restores its planes
+                with don.guard("join", pbatch, op=self.node_name,
+                               conf=self.conf,
+                               metric=self.metric("donatedBytes")):
+                    probe_side, build_side = fne(
+                        vals_of_batch(pbatch), list(build_cols), lo,
+                        counts, aux)
+            else:
+                probe_side, build_side = fne(
+                    vals_of_batch(pbatch), list(build_cols), lo, counts,
+                    aux)
         left_side, right_side = (
             (build_side, probe_side) if self._swap else (probe_side, build_side)
         )
@@ -913,9 +937,14 @@ class TpuShuffledHashJoinExec(TpuExec):
             vals2, cnt = fnc(
                 vals_of_batch(out), count_scalar(out.num_rows_lazy))
             out = batch_from_vals(vals2, self._schema, cnt)
-        return out, matched
+        from .base import _donation as _don_out
 
-    def _jit_cache_get(self, key, fn):
+        # join outputs are freshly gathered planes with exactly one
+        # reference (this yield path) — certified downstream sites
+        # (agg over a join, a second join's probe) may donate them
+        return _don_out().mark_exclusive(out), matched
+
+    def _jit_cache_get(self, key, fn, donate=()):
         cache = getattr(self, "_jits", None)
         if cache is None:
             cache = self._jits = {}
@@ -924,7 +953,8 @@ class TpuShuffledHashJoinExec(TpuExec):
         from .base import cached_pipeline
 
         return cached_pipeline(cache, key, "join",
-                               lambda: jax.jit(fn))
+                               lambda: jax.jit(fn, donate_argnums=donate),
+                               donate=donate)
 
     def _unmatched_build(self, build_cols, build_live_all, matched_any):
         """full outer: emit build rows no probe row matched (including live
@@ -1027,12 +1057,28 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             if cache is None:
                 cache = self._jits = {}
             key = (batch_signature(pbatch), out_cap, np_, nb)
-            from .base import cached_pipeline
+            from .base import _donation, cached_pipeline
 
+            don = _donation()
+            # probe planes (argnum 0) are dead after the expansion —
+            # the build side (argnum 1) is retained for every probe
+            # batch and never donates (the "join" certification)
+            mask = don.dispatch_mask("join", pbatch, self.conf)
             fn = cached_pipeline(cache, key, "join",
-                                 lambda: jax.jit(expand))
+                                 lambda: jax.jit(expand,
+                                                 donate_argnums=mask),
+                                 donate=mask)
             with self.op_timed():
-                vals, count = fn(vals_of_batch(pbatch), build_vals)
+                if mask:
+                    # no retry harness wraps this dispatch: skip the
+                    # guard's host snapshot leg
+                    with don.guard("join", pbatch, op=self.node_name,
+                                   snapshot=False,
+                                   metric=self.metric("donatedBytes")):
+                        vals, count = fn(vals_of_batch(pbatch),
+                                         build_vals)
+                else:
+                    vals, count = fn(vals_of_batch(pbatch), build_vals)
                 n = int(count)
             if n:
                 yield self.record_batch(batch_from_vals(vals, self._schema, n))

@@ -238,6 +238,21 @@ def concat_batches(conf_: RapidsConf, batches: Sequence) -> "object":
     return batch_from_vals(cols, schema, n)
 
 
+def _raise_if_donation_uaf(e: BaseException, op: str) -> None:
+    """A deleted-array error surfacing inside the retry harness means a
+    donated plane leaked into a re-attempt — the donation guard's
+    snapshot/restore contract was violated upstream. Re-type it with
+    the operator attribution so the failure reads as the soundness bug
+    it is, not a mystery backend error."""
+    from ..plugin import donation as _donation
+
+    if (not isinstance(e, _donation.TpuDonationViolation)
+            and _donation._use_after_donation(e)):
+        raise _donation.TpuDonationViolation(
+            "retry", op,
+            f"donated plane re-read by a retry attempt: {e}") from e
+
+
 def with_oom_retry(op: str, attempt_fn: Callable, batch,
                    conf_: RapidsConf,
                    combine: Union[str, Callable, None] = "concat",
@@ -275,6 +290,7 @@ def with_oom_retry(op: str, attempt_fn: Callable, batch,
                 return [attempt_fn(b)]
             except Exception as e:  # noqa: BLE001 - filtered below
                 if not is_device_oom(e):
+                    _raise_if_donation_uaf(e, op)
                     raise
                 last = e
                 _emit_retry(op, "retry", attempt, depth)
@@ -298,8 +314,15 @@ def with_oom_retry(op: str, attempt_fn: Callable, batch,
                 budget=budget, attempts=total_attempts[0],
                 split_depth=depth) from last
         from ..columnar import split_batch
+        from ..plugin import donation as _donation
 
         lo, hi = split_batch(b)
+        # the halves are fresh dynamic-slice outputs private to this
+        # retry recursion — no cache/exchange/spill ever holds them —
+        # so the smaller re-dispatches may donate their planes even
+        # when the parent batch was shared (plugin/donation.py)
+        _donation.mark_exclusive(lo)
+        _donation.mark_exclusive(hi)
         _emit_retry(op, "split", total_attempts[0], depth + 1)
         if _events.enabled():
             _events.emit("batch_split", op=op, depth=depth + 1, rows=n,
@@ -346,6 +369,7 @@ def with_oom_retry_nosplit(op: str, fn: Callable, conf_: RapidsConf):
             return fn()
         except Exception as e:  # noqa: BLE001 - filtered below
             if not is_device_oom(e):
+                _raise_if_donation_uaf(e, op)
                 raise
             last = e
             _emit_retry(op, "retry", attempt, 0)

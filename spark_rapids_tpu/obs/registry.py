@@ -194,6 +194,13 @@ METRICS: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
         "(retry = backed off and re-fetched, failure = retries "
         "exhausted, FetchFailedError raised — shuffle/network.py)",
         ("outcome",)),
+    "tpu_donated_bytes": (
+        COUNTER, "Input-plane bytes donated to XLA per certified "
+        "compile site (plugin/donation.py; the donation event's live "
+        "twin). Donated planes' HBM is reused for program outputs/"
+        "temps — zero here with donation enabled means no dispatch "
+        "qualified (batches not exclusive, dict columns, or the site "
+        "is uncertified).", ("site",)),
 }
 
 #: event type -> the live metric family that carries the same signal, so
@@ -224,6 +231,7 @@ EVENT_BACKED_METRICS: Dict[str, str] = {
     "queue": "tpu_serve_queue",
     "oom_retry": "tpu_oom_retries",
     "batch_split": "tpu_batch_splits",
+    "donation": "tpu_donated_bytes",
 }
 
 
@@ -287,6 +295,17 @@ class MetricsRegistry:
             cur = d.get(key)
             if cur is None or value > cur:
                 d[key] = float(value)
+
+    def rebase_gauge(self, name: str) -> None:
+        """Drop every labeled row of a high-water gauge so the next
+        ``set_gauge_max`` writes record a fresh window's peak — the
+        bench's per-shape rebase (the BufferCatalog peak-watermark
+        pattern: the gauge is a monotonic process-wide max, and a
+        window owner resetting it between windows is the only way a
+        later window's reading is its OWN peak, not an earlier,
+        hungrier one's)."""
+        with self._lock:
+            self._vals[name].clear()
 
     def observe(self, name: str, value: float, **labels: str) -> None:
         key = _label_values(name, labels)

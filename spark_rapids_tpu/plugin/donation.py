@@ -1,0 +1,438 @@
+"""Buffer-donation safety: the declared certification table, the
+batch-exclusivity protocol, and the runtime witness.
+
+Donation (``jax.jit(..., donate_argnums=...)``) lets XLA reuse an input
+plane's HBM for the program's outputs and temps — the single biggest
+peak-temp lever the engine has — but it is UNSOUND unless the caller
+provably drops every reference to the donated plane after dispatch. The
+reference plugin inherits that proof from RMM's ownership discipline
+(cuDF buffers are moved, not aliased); this engine builds it in three
+layers:
+
+1. **The certification table** (``DONATION_SPECS``, below): for every
+   compile site the engine owns, either the argnums proven dead after
+   dispatch plus how the site squares with split-and-retry, or the
+   reason donation is forbidden. ``tools/tpu_donate.py`` cross-checks
+   this table against the AST of the builders and their call sites
+   (TPU201: a certified argnum the caller later reads; TPU202: a
+   certified site not donating; TPU203: donation invisible to
+   ``cached_pipeline``'s key), the same declared-manifest pattern as
+   ``tools/tpu_racecheck.py`` over ``utils/locks.LOCK_ORDER``.
+
+2. **The exclusivity protocol** (``mark_exclusive`` / ``claim``): the
+   static pass proves the *site* safe; whether a particular batch's
+   planes are unshared is a runtime fact. Only batches explicitly
+   marked exclusive by their producer (fresh host→device uploads,
+   fused-chain outputs, join outputs) ever donate, and any consumer
+   that RETAINS a batch beyond its own dispatch (scan cache, exchange
+   buffering, concat) must ``claim()`` it first, clearing the mark.
+   Dictionary-encoded columns never donate — their dictionary pools
+   are shared across every batch of the column.
+
+3. **The retry contract** (``guard``): ``memory/retry.py``'s
+   split-and-retry re-dispatches the *input* batch, so a donating
+   dispatch under ``with_oom_retry`` must snapshot donated planes to
+   host first and restore them on failure
+   (``donation.retrySnapshot.enabled``), or simply not donate retried
+   args when snapshots are disabled. The conf-gated witness
+   (``tools.donation.witness.enabled``) asserts post-dispatch that
+   donated buffers really were deleted and converts any
+   use-after-donation error into a typed, op-attributed
+   ``TpuDonationViolation``.
+
+This module is importable without jax (the tool layer runs on bare
+CPython); jax is imported lazily inside the functions that dispatch.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import conf as _conf
+from .. import events as _events
+from .. import obs as _obs
+
+# XLA legitimately declines individual aliases (a bool validity plane
+# rarely matches any output buffer); the guard accounts the decline
+# truthfully in the donated-bytes counters, so the per-compile warning
+# is noise the engine already measures
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+__all__ = [
+    "DonationSpec", "DONATION_SPECS", "certified_sites",
+    "TpuDonationViolation", "mark_exclusive", "is_exclusive", "claim",
+    "batch_donatable", "dispatch_mask", "guard", "snapshot_counters",
+    "counters_since", "witness_enabled", "enabled",
+]
+
+
+class DonationSpec:
+    """One compile site's donation certification (or refusal).
+
+    ``argnums`` — jitted-function argument indices proven dead after
+    dispatch (empty tuple: site not certified). ``retry`` — how the
+    site reconciles donation with split-and-retry: ``"snapshot"``
+    (planes snapshotted to host before dispatch, restored on failure)
+    or ``None`` for uncertified sites. ``reason`` — the safety
+    argument, quoted verbatim by the tool's ``--explain`` output."""
+
+    __slots__ = ("site", "argnums", "retry", "reason")
+
+    def __init__(self, site: str, argnums: Tuple[int, ...],
+                 retry: Optional[str], reason: str):
+        self.site = site
+        self.argnums = argnums
+        self.retry = retry
+        self.reason = reason
+
+    @property
+    def certified(self) -> bool:
+        return bool(self.argnums)
+
+
+# The engine-wide lifetime analysis, one verdict per compile site. The
+# argnum refers to the jitted builder's parameter position (argnum 0 is
+# the per-batch column-plane pytree at every certified site). Sites
+# listed with argnums=() are PROVEN UNSAFE (or not worth it) for the
+# stated reason; tools/tpu_donate.py TPU202 only fires on certified
+# sites, and TPU201 validates the certified ones against the callers.
+DONATION_SPECS: Dict[str, DonationSpec] = {s.site: s for s in [
+    DonationSpec(
+        "fused_chain", (0,), "snapshot",
+        "run_fused_chain's attempt reads vals_of_batch(b) exactly once "
+        "(the dispatch); the output batch is rebuilt from the program's "
+        "return via batch_from_vals, and the input batch object is "
+        "dropped when the retry scope exits. Split-and-retry re-reads "
+        "the input planes, hence snapshot mode."),
+    DonationSpec(
+        "project", (0,), "snapshot",
+        "Same per-batch shape as fused_chain: the standalone projection "
+        "pipeline reads the input planes once at dispatch and rebuilds "
+        "the output batch from the return value."),
+    DonationSpec(
+        "agg_update", (0,), "snapshot",
+        "The streaming per-batch partial-aggregate update reads the "
+        "probe batch's planes once; partial state lives in the "
+        "program's RETURN, never in the input planes. Dispatched under "
+        "with_oom_retry, hence snapshot mode."),
+    DonationSpec(
+        "agg_plan", (0,), "snapshot",
+        "The fused whole-partition plan takes every buffered batch's "
+        "planes as argnum 0 and reduces them to partials in one "
+        "program; the device-OOM fallback (flush_buffered) re-reads "
+        "the buffered batches, hence snapshot mode."),
+    DonationSpec(
+        "agg_stage", (), None,
+        "Stage programs run inside the fused-plan fallback ladder and "
+        "their inputs are the retained `batches` buffer the ladder may "
+        "re-read at ANY later rung — no single dispatch is the last "
+        "use, so no argnum is provably dead."),
+    DonationSpec(
+        "agg_merge", (), None,
+        "with_oom_retry_nosplit re-dispatches the SAME partials list on "
+        "retry, and merge partials feed multiple merge rounds — the "
+        "caller provably retains every input."),
+    DonationSpec(
+        "join", (0,), "snapshot",
+        "Only the probe-side expand program donates: expand_phase's "
+        "argnum 0 (the probe plane pytree) is the LAST read of the "
+        "probe batch — count_phase reads the same planes FIRST, so the "
+        "count dispatch must not donate, and build-side planes (argnum "
+        "1) are retained across every probe batch and must never "
+        "donate. Probe dispatch runs under with_oom_retry, hence "
+        "snapshot mode. String/dict probes use eager gathers and do "
+        "not qualify."),
+    DonationSpec(
+        "sort", (), None,
+        "Sort buffers every input batch until partition end and the "
+        "gather program reads the buffered planes after the key "
+        "program already read them — multi-dispatch liveness, no dead "
+        "argnum."),
+    DonationSpec(
+        "window", (), None,
+        "Window frames re-read the partition's planes once per "
+        "function; the partition buffer outlives each dispatch."),
+    DonationSpec(
+        "exchange", (), None,
+        "Exchange retains batches in partition buffers across the "
+        "shuffle boundary (and may serve them to a remote reader "
+        "twice under retry) — retention is the operator's purpose."),
+    DonationSpec(
+        "pq_unpack", (), None,
+        "The streamed parquet unpack dispatches over mmap-backed scan "
+        "planes owned by the scan cache; residency is the point of "
+        "the cache, so the caller never drops its reference."),
+]}
+
+
+def certified_sites() -> Tuple[str, ...]:
+    return tuple(s.site for s in DONATION_SPECS.values() if s.certified)
+
+
+class TpuDonationViolation(RuntimeError):
+    """A donated buffer was observed live after dispatch, or a deleted
+    (donated) buffer was used afterwards — the static certification and
+    runtime reality disagree. Carries the site/op attribution the
+    offline log needs; raised only under the donation witness."""
+
+    def __init__(self, site: str, op: Optional[str], detail: str):
+        self.site = site
+        self.op = op
+        super().__init__(
+            f"donation violation at site={site!r}"
+            + (f" op={op!r}" if op else "") + f": {detail}")
+
+
+# ---------------------------------------------------------------------------
+# Exclusivity protocol
+# ---------------------------------------------------------------------------
+def mark_exclusive(batch):
+    """Producer-side: declare this batch's planes referenced by nobody
+    but the consumer it is being yielded to. Only four producers
+    qualify (fresh host→device scan uploads, fused-chain outputs, join
+    outputs, split-and-retry halves); marking anything else is a
+    soundness bug the witness will catch. Returns the batch for
+    chaining."""
+    try:
+        batch.exclusive = True
+    except AttributeError:
+        pass  # host-side / foreign batch types don't carry the flag
+    return batch
+
+
+def is_exclusive(batch) -> bool:
+    return bool(getattr(batch, "exclusive", False))
+
+
+def claim(batch):
+    """Consumer-side: take shared ownership of a batch this operator
+    RETAINS beyond its own dispatch (scan-cache insert, exchange
+    buffering, concat inputs, spill). Clears the exclusivity mark so
+    no later dispatch donates planes this retainer still holds.
+    Returns the batch for chaining."""
+    if getattr(batch, "exclusive", False):
+        batch.exclusive = False
+    return batch
+
+
+def _has_dict_columns(batch) -> bool:
+    for c in getattr(batch, "columns", ()):
+        if getattr(c, "is_dict", False):
+            return True
+    return False
+
+
+def batch_donatable(batch) -> bool:
+    """A batch's planes may donate iff its producer marked it exclusive
+    and no column is dictionary-encoded (dictionary pools are shared
+    across every batch of the column — never donatable)."""
+    return is_exclusive(batch) and not _has_dict_columns(batch)
+
+
+def _get(conf, entry):
+    """Session-scoped conf read with a no-session fallback to the
+    entry's default (the engine's standard RapidsConf.get pattern —
+    every exec call site passes its own conf handle)."""
+    return entry.default if conf is None else conf.get(entry)
+
+
+def enabled(conf=None) -> bool:
+    return bool(_get(conf, _conf.DONATION_ENABLED))
+
+
+def snapshot_mode(conf=None) -> bool:
+    return bool(_get(conf, _conf.DONATION_RETRY_SNAPSHOT))
+
+
+_WITNESS_ENV = os.environ.get("SRTPU_DONATION_WITNESS", "") == "1"
+_WITNESS_SESSION = False
+
+
+def install_witness() -> None:
+    """Turn the runtime donation witness on (process-global, idempotent;
+    wired from TpuSession under tools.donation.witness.enabled and the
+    SRTPU_DONATION_WITNESS=1 environment hook, the locks.py pattern)."""
+    global _WITNESS_SESSION
+    _WITNESS_SESSION = True
+
+
+def uninstall_witness() -> None:
+    global _WITNESS_SESSION
+    _WITNESS_SESSION = False
+
+
+def witness_enabled() -> bool:
+    return _WITNESS_ENV or _WITNESS_SESSION
+
+
+def dispatch_mask(site: str, batches, conf=None) -> Tuple[int, ...]:
+    """The donate_argnums for ONE dispatch at ``site`` over ``batches``
+    (a batch or a sequence of batches bound to the certified argnum).
+    Empty tuple unless donation is on, the site is certified, and
+    EVERY batch bound to the donated argnum is provably unshared
+    (exclusive, dict-free). Deterministic given batch provenance, so
+    masks never fork the compile cache between identical runs."""
+    if not enabled(conf):
+        return ()
+    spec = DONATION_SPECS.get(site)
+    if spec is None or not spec.certified:
+        return ()
+    if spec.retry == "snapshot" and not snapshot_mode(conf):
+        # exclusion mode: the site dispatches under split-and-retry and
+        # snapshots are off, so retried args must not donate
+        return ()
+    if not isinstance(batches, (list, tuple)):
+        batches = (batches,)
+    if not batches:
+        return ()
+    for b in batches:
+        if not batch_donatable(b):
+            return ()
+    return spec.argnums
+
+
+# ---------------------------------------------------------------------------
+# Donated-bytes accounting (events / obs / bench counters)
+# ---------------------------------------------------------------------------
+_COUNTER_LOCK = threading.Lock()
+_DONATED_BYTES: Dict[str, int] = {}
+_DONATED_PLANES: Dict[str, int] = {}
+
+
+def _note_donation(site: str, op: Optional[str], nbytes: int,
+                   planes: int) -> None:
+    with _COUNTER_LOCK:
+        _DONATED_BYTES[site] = _DONATED_BYTES.get(site, 0) + nbytes
+        _DONATED_PLANES[site] = _DONATED_PLANES.get(site, 0) + planes
+    if _events.enabled():
+        _events.emit("donation", site=site, op=op or "", bytes=nbytes,
+                     planes=planes)
+    if _obs.enabled():
+        _obs.inc("tpu_donated_bytes", nbytes, site=site)
+
+
+def snapshot_counters() -> Dict[str, int]:
+    """Cumulative donated bytes per site (bench snapshots/diffs this
+    around each shape, the xla_cost.snapshot()/records_since pattern)."""
+    with _COUNTER_LOCK:
+        return dict(_DONATED_BYTES)
+
+
+def counters_since(snap: Dict[str, int]) -> Dict[str, int]:
+    with _COUNTER_LOCK:
+        return {k: v - snap.get(k, 0)
+                for k, v in _DONATED_BYTES.items() if v - snap.get(k, 0)}
+
+
+def reset_counters() -> None:
+    with _COUNTER_LOCK:
+        _DONATED_BYTES.clear()
+        _DONATED_PLANES.clear()
+
+
+# ---------------------------------------------------------------------------
+# The dispatch guard
+# ---------------------------------------------------------------------------
+def _plane_arrays(batch) -> List[Tuple[Any, str, Any]]:
+    """(column, slot, array) for every donatable device plane of a
+    batch — the restore handle set. String offsets/chars planes are
+    included (a donating program's argnum-0 pytree donates EVERY leaf);
+    dict planes never appear (dict batches are not donatable)."""
+    out = []
+    for c in getattr(batch, "columns", ()):
+        for slot in ("data", "validity", "offsets", "chars"):
+            a = getattr(c, slot, None)
+            if a is not None and hasattr(a, "nbytes"):
+                out.append((c, slot, a))
+    return out
+
+
+def _use_after_donation(exc: BaseException) -> bool:
+    return "deleted" in str(exc).lower() and "rray" in str(exc)
+
+
+def _snapshot_planes(arrays) -> List[Any]:
+    """True host COPIES of device planes for the guard's restore leg.
+
+    This deliberately does NOT route through the sanctioned
+    ``host_pull`` (``jax.device_get``): on the CPU backend device_get
+    returns a zero-copy VIEW of the device buffer and pins it with an
+    external reference, after which XLA silently refuses to delete the
+    donated buffer — the snapshot leg would defeat the exact donation
+    it exists to protect. ``np.array(a, copy=True)`` reads the same
+    bytes without retaining a view, so the buffer stays deletable. The
+    d2h still lands in the transfer accounting like any host_pull."""
+    import numpy as np
+    out = [np.array(a, copy=True) for a in arrays]
+    if _events.enabled() or _obs.enabled():
+        nb = sum(int(a.nbytes) for a in out)
+        _events.emit("transfer", direction="d2h", bytes=nb,
+                     site="donation_snapshot")
+        if _obs.enabled():
+            _obs.inc("tpu_transfers", 1, direction="d2h")
+            _obs.inc("tpu_transfer_bytes", nb, direction="d2h")
+    return out
+
+
+@contextmanager
+def guard(site: str, batches, op: Optional[str] = None,
+          snapshot: Optional[bool] = None, conf=None, metric=None):
+    """Wrap ONE donating dispatch at a retry-covered site.
+
+    Entry: snapshots every donated plane to host as TRUE COPIES
+    (``_snapshot_planes`` — device_get's zero-copy view would pin the
+    buffer and silently block the donation; the d2h still shows up in
+    the transfer accounting like any other pull). Exit on success: bumps the
+    donated-bytes counters — and ``metric``, an exec-owned Metric when
+    the call site has one, so explain_metrics() attributes donation per
+    operator — and, under the witness, asserts jax really
+    deleted the donated buffers. Exit on failure: restores the planes
+    into the batch's (mutable) DeviceColumn slots so split-and-retry /
+    the agg fallback ladder can re-read the input it is contractually
+    owed, then re-raises — translating any use-after-donation error
+    into a typed TpuDonationViolation first."""
+    if not isinstance(batches, (list, tuple)):
+        batches = (batches,)
+    handles = [h for b in batches for h in _plane_arrays(b)]
+    nbytes = sum(int(h[2].nbytes) for h in handles)
+    snaps = None
+    want_snapshot = (snapshot if snapshot is not None
+                     else snapshot_mode(conf))
+    if want_snapshot:
+        snaps = _snapshot_planes([h[2] for h in handles])
+    try:
+        yield
+    except Exception as e:
+        if snaps is not None:
+            import jax.numpy as jnp
+            for (c, slot, _), host in zip(handles, snaps):
+                setattr(c, slot, jnp.asarray(host))
+        if witness_enabled() and _use_after_donation(e):
+            raise TpuDonationViolation(site, op, str(e)) from e
+        raise
+    # count only planes XLA actually deleted: the backend may DECLINE an
+    # individual alias (shape/dtype matches no output — typical for bool
+    # validity planes), in which case the input stays live and donated no
+    # bytes. Declined aliases are a missed optimization, not a soundness
+    # bug; the violation is a mask that had NO effect at all (the argnum
+    # named a parameter the program never received as a buffer).
+    deleted_bytes = 0
+    deleted_planes = 0
+    for _, slot, a in handles:
+        is_del = getattr(a, "is_deleted", None)
+        if is_del is not None and is_del():
+            deleted_bytes += int(a.nbytes)
+            deleted_planes += 1
+    _note_donation(site, op, deleted_bytes, deleted_planes)
+    if metric is not None:
+        metric.add(deleted_bytes)
+    if witness_enabled() and handles and deleted_planes == 0:
+        raise TpuDonationViolation(
+            site, op,
+            f"no donated plane was deleted after dispatch ({nbytes} "
+            f"bytes across {len(handles)} planes still live) — the "
+            "donate mask named an argnum the program does not alias")

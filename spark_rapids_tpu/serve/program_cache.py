@@ -312,11 +312,17 @@ class ProgramCache:
         if _obs.enabled():
             _obs.inc("tpu_program_cache", 1, op="corrupt")
 
-    def lookup(self, site: str, key: Any, build: Callable[[], Any]):
+    def lookup(self, site: str, key: Any, build: Callable[[], Any],
+               donate: Tuple[int, ...] = ()):
         """Disk probe for one pipeline-cache miss. Returns a callable
         (or the mesh ``(callable, aux...)`` tuple) serving the entry, or
         None — and on None the caller compiles exactly as before. Never
-        raises."""
+        raises. ``donate`` is the donate_argnums mask of the program
+        being served: jax.export does NOT preserve donation across
+        serialize/deserialize, so the hit side must re-declare it when
+        compiling the deserialized call (the mask is part of the cache
+        key the caller folded, so an entry is only ever served to
+        callers with the same mask)."""
         path = self.entry_path(site, key)
         if path is None:
             return None
@@ -369,16 +375,20 @@ class ProgramCache:
         if _obs.enabled():
             _obs.inc("tpu_program_cache", 1, op="hit")
         probe = _LoadProbe(self, exported, header, site, key, kd, path,
-                           build, deser_ns)
+                           build, deser_ns, donate)
         if aux is not None:
             return (probe,) + aux
         return probe
 
-    def wrap_store(self, built: Any, site: str, key: Any):
+    def wrap_store(self, built: Any, site: str, key: Any,
+                   donate: Tuple[int, ...] = ()):
         """Miss path: arrange for the freshly-built program to be
         exported + persisted at its first call. Falls back to the plain
         cost-plane wrap (xla_cost.wrap) whenever this program cannot
-        participate — the cost plane must keep working either way."""
+        participate — the cost plane must keep working either way.
+        ``donate`` rides to the store probe so the compile of the
+        exported module carries the same donate_argnums the traced
+        program declared (export drops donation; see lookup)."""
         from .. import xla_cost as _xla_cost
 
         path = self.entry_path(site, key)
@@ -398,7 +408,7 @@ class ProgramCache:
         except Exception:
             return _xla_cost.wrap(built, site, key)
         probe = _StoreProbe(self, fn, site, key, _digest_of(key), path,
-                            aux_b64)
+                            aux_b64, donate)
         if aux:
             return (probe,) + aux
         return probe
@@ -529,11 +539,12 @@ class _StoreProbe:
     compile a plain jit would have paid lazily."""
 
     __slots__ = ("_cache", "_fn", "_site", "_key", "_digest", "_path",
-                 "_aux_b64", "_compiled", "_done", "_lock")
+                 "_aux_b64", "_donate", "_compiled", "_done", "_lock")
 
     def __init__(self, cache: ProgramCache, fn: Callable, site: str,
                  key: Any, digest: str, path: str,
-                 aux_b64: Optional[str]):
+                 aux_b64: Optional[str],
+                 donate: Tuple[int, ...] = ()):
         self._cache = cache
         self._fn = fn
         self._site = site
@@ -541,6 +552,7 @@ class _StoreProbe:
         self._digest = digest
         self._path = path
         self._aux_b64 = aux_b64
+        self._donate = tuple(donate)
         self._compiled = None
         self._done = False
         self._lock = ordered_lock("aot.store_probe")
@@ -586,7 +598,13 @@ class _StoreProbe:
         exported = _export.export(self._fn)(*args, **kwargs)
         blob = exported.serialize()
         t1 = time.perf_counter_ns()
-        compiled = jax.jit(exported.call).lower(*args, **kwargs).compile()
+        # donation does not survive export: exported.call is a plain
+        # function, so the donate_argnums of the original jit must be
+        # re-declared here or the persisted-path compile silently loses
+        # the aliasing (and its temp-bytes savings)
+        compiled = jax.jit(
+            exported.call, donate_argnums=self._donate,
+        ).lower(*args, **kwargs).compile()
         t2 = time.perf_counter_ns()
         cost = _xla_cost.harvest_compiled(compiled)
         hlo_rec = None
@@ -612,6 +630,8 @@ class _StoreProbe:
             if hlo_rec.get("accounted_frac") is not None:
                 header["hlo"]["accounted_frac"] = hlo_rec["accounted_frac"]
         header["aux"] = self._aux_b64
+        if self._donate:
+            header["donate"] = list(self._donate)
         header["blob_len"] = len(blob)
         header["created"] = round(time.time(), 3)
         self._cache.store(self._site, self._digest, self._path, header,
@@ -628,12 +648,13 @@ class _LoadProbe:
     have — a poisoned cache can cost time, never correctness."""
 
     __slots__ = ("_cache", "_exp", "_header", "_site", "_key", "_digest",
-                 "_path", "_build", "_deser_ns", "_compiled", "_fallback",
-                 "_done", "_lock")
+                 "_path", "_build", "_deser_ns", "_donate", "_compiled",
+                 "_fallback", "_done", "_lock")
 
     def __init__(self, cache: ProgramCache, exported, header: dict,
                  site: str, key: Any, digest: str, path: str,
-                 build: Callable[[], Any], deser_ns: int):
+                 build: Callable[[], Any], deser_ns: int,
+                 donate: Tuple[int, ...] = ()):
         self._cache = cache
         self._exp = exported
         self._header = header
@@ -643,6 +664,7 @@ class _LoadProbe:
         self._path = path
         self._build = build
         self._deser_ns = deser_ns
+        self._donate = tuple(donate)
         self._compiled = None
         self._fallback: Optional[Callable] = None
         self._done = False
@@ -688,7 +710,11 @@ class _LoadProbe:
         from .. import xla_cost as _xla_cost
 
         t0 = time.perf_counter_ns()
-        compiled = jax.jit(self._exp.call).lower(
+        # re-declare donation: serialize/deserialize strips the original
+        # jit's donate_argnums, and a warm process that silently compiled
+        # without them would dispatch correctly but lose the input-output
+        # aliasing the donation analyzer certified
+        compiled = jax.jit(self._exp.call, donate_argnums=self._donate).lower(
             *args, **kwargs).compile()
         t1 = time.perf_counter_ns()
         self._compiled = compiled

@@ -15,7 +15,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 from .. import events as _events  # registers the eventLog.* conf entries
 from .. import faults as _faults  # registers the test.faults.* entries
 from .. import obs as _obs
-from ..conf import RACECHECK_WITNESS_ENABLED, RapidsConf
+from ..conf import (DONATION_WITNESS_ENABLED, RACECHECK_WITNESS_ENABLED,
+                    RapidsConf)
 from ..cpu import plan as C
 from ..memory import catalog as _catalog  # noqa: F401 — registers the
 # memory.* conf entries (hbm.budgetBytes) BEFORE RapidsConf validates a
@@ -299,6 +300,14 @@ class TpuSession:
         # tests pair install_witness with uninstall_witness().
         if self.conf.get(RACECHECK_WITNESS_ENABLED):
             _locks.install_witness()
+        # runtime donation witness (plugin/donation.py): asserts donated
+        # planes really were deleted post-dispatch and types use-after-
+        # donation errors. Same lifecycle as the lock witness (process-
+        # global once on; SRTPU_DONATION_WITNESS=1 is the env hook).
+        if self.conf.get(DONATION_WITNESS_ENABLED):
+            from ..plugin import donation as _donation
+
+            _donation.install_witness()
 
     def close(self) -> None:
         """Flush/close the session's event sink (atexit also covers a

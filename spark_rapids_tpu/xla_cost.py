@@ -113,7 +113,7 @@ _SEQ = 0
 #: the program_cost event's REQUIRED fields (None when the backend
 #: didn't report them — consumers .get() and guard)
 COST_FIELDS = ("flops", "bytes_accessed", "temp_bytes", "argument_bytes",
-               "output_bytes")
+               "output_bytes", "alias_bytes")
 
 
 #: lazily-bound obs module (circular import: obs imports events); bound
@@ -228,10 +228,22 @@ def harvest_compiled(compiled) -> Dict[str, Any]:
             ("argument_bytes", "argument_size_in_bytes"),
             ("output_bytes", "output_size_in_bytes"),
             ("generated_code_bytes", "generated_code_size_in_bytes"),
+            ("alias_bytes", "alias_size_in_bytes"),
         ):
             v = getattr(ma, attr, None)
             if v is not None:
                 out[field] = int(v)
+        # donation correction: XLA folds input buffers aliased to
+        # outputs (donate_argnums) INTO temp_size_in_bytes and reports
+        # them separately as alias_size_in_bytes. Raw temp therefore
+        # RISES under donation even though no new HBM is allocated —
+        # the aliased bytes are the donated inputs being reused.
+        # Subtracting restores temp_bytes' meaning ("scratch allocated
+        # beyond the arguments"); a non-donating program has alias 0,
+        # so every existing consumer sees unchanged numbers.
+        if out.get("temp_bytes") is not None and out.get("alias_bytes"):
+            out["temp_bytes"] = max(
+                0, out["temp_bytes"] - out["alias_bytes"])
     return out
 
 

@@ -1,0 +1,25 @@
+"""Must-catch fixture: certified site dispatching with no donate= mask
+(TPU202, warn-level) — the win left on the table.
+
+``"project"`` is donation-certified in the DONATION_SPECS table; a
+``cached_pipeline`` call naming it without plumbing ``donate=`` skips
+the peak-temp win the certification proved safe. tpu_donate must warn
+on ``build_without_mask`` with TPU202 (warning only — exit stays 0)
+and must NOT warn on ``build_with_mask`` or ``build_uncertified``
+(``"sort"`` is not certified, so there is no mask to plumb).
+"""
+from spark_rapids_tpu.exec.base import cached_pipeline
+
+_CACHE = {}
+
+
+def build_without_mask(key, build):
+    return cached_pipeline(_CACHE, key, "project", build)
+
+
+def build_with_mask(key, build, mask):
+    return cached_pipeline(_CACHE, key, "project", build, donate=mask)
+
+
+def build_uncertified(key, build):
+    return cached_pipeline(_CACHE, key, "sort", build)

@@ -1,0 +1,36 @@
+"""Must-catch fixture: donation invisible to the cache key (TPU203) —
+the warm-process alias fork.
+
+``cached_pipeline`` folds the donate mask into the structural key AND
+the AOT program-cache entry identity; a ``donate_argnums`` declared
+anywhere else forks donating and non-donating callers onto one cache
+entry, so the warm process serves a donating program to a caller that
+still owns its planes. tpu_donate must flag ``jit_donating_loose`` and
+``pjit_donating_loose`` with TPU203, and must NOT flag
+``jit_donating_routed`` (the builder hands the jit to a
+``cached_pipeline`` call that carries ``donate=``) or ``jit_plain``
+(no donation declared at all).
+"""
+import jax
+
+from spark_rapids_tpu.exec.base import cached_pipeline
+
+_CACHE = {}
+
+
+def jit_donating_loose(fn):
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def pjit_donating_loose(pjit, fn):
+    return pjit(fn, donate_argnums=(0,))
+
+
+def jit_donating_routed(key, fn, mask):
+    return cached_pipeline(
+        _CACHE, key, "project",
+        lambda: jax.jit(fn, donate_argnums=mask), donate=mask)
+
+
+def jit_plain(fn):
+    return jax.jit(fn)

@@ -1,0 +1,41 @@
+"""Must-catch fixture: batch read after its donating dispatch (TPU201)
+— the use-after-free shape the guard exists to make impossible.
+
+A batch dispatched under ``donation.guard(<certified site>, batch)``
+has its planes DELETED by the donating program; any plane-reaching
+read after the guarded block is a use-after-free the backend reports
+as "Array has been deleted". tpu_donate must flag ``read_after_guard``
+(a raw re-read) and ``rows_after_guard`` (plane-reaching method call)
+with TPU201, and must NOT flag ``metadata_after_guard`` (safe
+metadata attributes only) or ``else_arm_dispatch`` (the engine's
+``if mask: with guard(...): ... else: ...`` idiom, where the else arm
+is textually later but an execution ALTERNATIVE).
+"""
+from spark_rapids_tpu.plugin import donation
+
+
+def read_after_guard(fn, batch, vals_of_batch):
+    with donation.guard("project", batch, op="Project"):
+        out = fn(vals_of_batch(batch))
+    return out, vals_of_batch(batch)     # planes are gone
+
+
+def rows_after_guard(fn, batch, vals_of_batch):
+    with donation.guard("agg_update", batch, op="HashAggregate"):
+        out = fn(vals_of_batch(batch))
+    return out, batch.to_rows()          # plane-reaching method
+
+
+def metadata_after_guard(fn, batch, vals_of_batch):
+    with donation.guard("project", batch, op="Project"):
+        out = fn(vals_of_batch(batch))
+    return out, batch.num_rows, batch.schema   # metadata stays valid
+
+
+def else_arm_dispatch(fn, batch, mask, vals_of_batch):
+    if mask:
+        with donation.guard("project", batch, op="Project"):
+            out = fn(vals_of_batch(batch))
+    else:
+        out = fn(vals_of_batch(batch))   # alternative arm, not "later"
+    return out

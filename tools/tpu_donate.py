@@ -1,0 +1,370 @@
+#!/usr/bin/env python3
+"""tpu_donate — the donation-safety analyzer (static side).
+
+Buffer donation (``jax.jit(..., donate_argnums=...)``) reuses an input
+plane's HBM for a program's outputs and temps — the engine's biggest
+peak-temp lever — but a donated plane is DELETED after dispatch, so a
+caller that reads it afterwards has a use-after-free the backend
+reports as an inscrutable "Array has been deleted". The engine's proof
+obligation lives in the DECLARED certification table
+(``spark_rapids_tpu/plugin/donation.py`` ``DONATION_SPECS``: per
+compile site, the argnums proven dead after dispatch plus the
+split-and-retry reconciliation, or the reason donation is forbidden).
+This tool cross-checks that table against the AST of the pipeline
+builders and their call sites — the same declared-manifest pattern as
+``tools/tpu_racecheck.py`` over ``utils/locks.LOCK_ORDER``; the
+conf-gated runtime witness (``tools.donation.witness.enabled``) is the
+dynamic cross-check.
+
+Rules
+-----
+TPU201  use-after-donation: a batch variable dispatched under
+        ``donation.guard(<site>, <batch>)`` is read again AFTER the
+        guarded block in the same function, through anything other
+        than the safe metadata attributes (num_rows / num_rows_lazy /
+        capacity / schema / exclusive) — its planes are deleted by the
+        donating dispatch, so any plane-reaching use is a
+        use-after-free the guard cannot restore.
+TPU202  (warning) certified site not donating: a
+        ``cached_pipeline(...)`` call naming a site the table
+        certifies, with NO ``donate=`` mask plumbed — the donation win
+        the certification proved safe is being left on the table.
+        Warn-level: it cannot make the build fail, but it prints so
+        the omission is a decision, not an accident.
+TPU203  donation invisible to the cache key: a ``jax.jit``/``pjit``
+        call declaring ``donate_argnums``/``donate_argnames`` outside
+        a builder whose ``cached_pipeline``/``_cached_program`` call
+        carries a ``donate=`` kwarg. ``cached_pipeline`` folds the
+        mask into the structural key AND the AOT program-cache entry
+        identity; a mask declared anywhere else forks donating and
+        non-donating callers onto one cache entry — the warm process
+        would serve a donating program to a caller that still owns its
+        planes (or vice versa).
+
+Allowlist: ``tools/tpu_donate_allow.txt`` (conf entry
+``spark.rapids.tpu.tools.donate.allowlistPath``), one
+``relpath::qualname::RULE  # why`` per line; ``--strict-allowlist``
+fails on stale entries. ``--explain`` prints the certification table
+with each site's safety argument verbatim. Exit 0 clean (TPU202
+warnings do not fail), 1 findings/stale, 2 usage error.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from lint_common import (  # noqa: E402 — path bootstrap above
+    Finding,
+    REPO_ROOT,
+    attr_chain,
+    default_allowlist_path,
+    enclosing_function,
+    iter_py_files,
+    load_allowlist,
+    parents_map,
+    qualname_resolver,
+)
+
+DEFAULT_TARGET = os.path.join(REPO_ROOT, "spark_rapids_tpu")
+MANIFEST_PATH = os.path.join(
+    REPO_ROOT, "spark_rapids_tpu", "plugin", "donation.py")
+
+#: batch attributes that stay valid after the planes donate (python
+#: object metadata, not device planes — donation deletes buffers, not
+#: the ColumnarBatch)
+SAFE_ATTRS = frozenset({
+    "num_rows", "num_rows_lazy", "capacity", "schema", "exclusive",
+})
+
+JAX_ALIASES = frozenset({"jax", "_jax", "_jx"})
+CACHED_BUILDERS = frozenset({"cached_pipeline", "_cached_program"})
+
+
+def _default_allowlist_path() -> str:
+    return default_allowlist_path(
+        "DONATE_ALLOWLIST_PATH",
+        os.path.join("tools", "tpu_donate_allow.txt"))
+
+
+# ---------------------------------------------------------------------------
+# The declared manifest, read straight from donation.py's AST (no engine
+# import — the tool must run without jax installed).
+# ---------------------------------------------------------------------------
+class SpecRow:
+    __slots__ = ("site", "argnums", "retry", "reason", "line")
+
+    def __init__(self, site, argnums, retry, reason, line):
+        self.site = site
+        self.argnums = argnums
+        self.retry = retry
+        self.reason = reason
+        self.line = line
+
+    @property
+    def certified(self) -> bool:
+        return bool(self.argnums)
+
+
+def load_manifest(path: str = MANIFEST_PATH) -> Dict[str, SpecRow]:
+    """site -> SpecRow from the DONATION_SPECS literal."""
+    with open(path, "rb") as f:
+        tree = ast.parse(f.read(), filename=path)
+    rows: Dict[str, SpecRow] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and attr_chain(node.func) == "DonationSpec"
+                and len(node.args) >= 4):
+            continue
+        site_a, argnums_a, retry_a, reason_a = node.args[:4]
+        if not isinstance(site_a, ast.Constant):
+            continue
+        argnums = tuple(
+            e.value for e in ast.walk(argnums_a)
+            if isinstance(e, ast.Constant) and isinstance(e.value, int))
+        retry = retry_a.value if isinstance(retry_a, ast.Constant) else None
+        # reason is usually an implicit concat of string constants
+        reason = "".join(
+            c.value for c in ast.walk(reason_a)
+            if isinstance(c, ast.Constant) and isinstance(c.value, str))
+        rows[site_a.value] = SpecRow(
+            site_a.value, argnums, retry, reason, node.lineno)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# Per-file checks
+# ---------------------------------------------------------------------------
+def _is_guard_call(call: ast.Call) -> bool:
+    chain = attr_chain(call.func)
+    return bool(chain) and chain.split(".")[-1] == "guard"
+
+
+def _is_jit_like(call: ast.Call) -> bool:
+    chain = attr_chain(call.func)
+    if chain is None:
+        return False
+    last = chain.split(".")[-1]
+    if last == "pjit":
+        return True
+    return chain.split(".")[0] in JAX_ALIASES and last == "jit"
+
+
+def _is_cached_builder_call(call: ast.Call) -> bool:
+    chain = attr_chain(call.func)
+    return bool(chain) and chain.split(".")[-1] in CACHED_BUILDERS
+
+
+def _donating_kw(call: ast.Call) -> bool:
+    return any(kw.arg in ("donate_argnums", "donate_argnames")
+               for kw in call.keywords)
+
+
+def _guarded_vars(call: ast.Call) -> Set[str]:
+    """Names of the batch variable(s) a guard() call donates."""
+    if len(call.args) < 2:
+        return set()
+    b = call.args[1]
+    if isinstance(b, ast.Name):
+        return {b.id}
+    if isinstance(b, (ast.List, ast.Tuple)):
+        return {e.id for e in b.elts if isinstance(e, ast.Name)}
+    return set()
+
+
+def _site_of_cached_call(call: ast.Call) -> Optional[str]:
+    """The site string of a cached_pipeline/_cached_program call (3rd
+    positional for cached_pipeline, site= keyword for either)."""
+    for kw in call.keywords:
+        if kw.arg == "site" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    if len(call.args) >= 3 and isinstance(call.args[2], ast.Constant) \
+            and isinstance(call.args[2].value, str):
+        return call.args[2].value
+    return None
+
+
+def _alternative_nodes(with_node: ast.With, parents) -> Set[int]:
+    """ids of nodes in branches that are execution ALTERNATIVES to the
+    guarded block: the engine's donating dispatches are written
+    ``if mask: with guard(...): ... else: <non-donating dispatch>``,
+    and the else arm sits textually after the with but never runs after
+    a donation — a line-number "later read" check must skip it."""
+    out: Set[int] = set()
+    cur: ast.AST = with_node
+    parent = parents.get(cur)
+    while parent is not None and not isinstance(
+            parent, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        if isinstance(parent, ast.If):
+            in_body = any(cur is s or id(cur) in
+                          {id(n) for n in ast.walk(s)}
+                          for s in parent.body)
+            alt = parent.orelse if in_body else parent.body
+            for s in alt:
+                out.update(id(n) for n in ast.walk(s))
+        cur, parent = parent, parents.get(parent)
+    return out
+
+
+def check_file(path: str, relpath: str,
+               manifest: Dict[str, SpecRow]) -> List[Finding]:
+    with open(path, "rb") as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            return [Finding(relpath, e.lineno or 0, "TPU200", "<module>",
+                            f"syntax error: {e.msg}")]
+    parents = parents_map(tree)
+    qual_of = qualname_resolver(tree, parents)
+    findings: List[Finding] = []
+
+    # functions whose body contains a cached-builder call with donate=
+    # (the TPU203 sanctioned regions: a donating jit must sit under one)
+    donate_routed: Set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_cached_builder_call(node) \
+                and any(kw.arg == "donate" for kw in node.keywords):
+            fn = enclosing_function(node, parents)
+            if fn is not None:
+                donate_routed.add(fn)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+
+        # --- TPU201: batch read after its guarded donating dispatch ---
+        if _is_guard_call(node) and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and node.args[0].value in manifest \
+                and manifest[node.args[0].value].certified:
+            with_node = parents.get(node)
+            # guard() must be a `with` item's context expression
+            while with_node is not None \
+                    and not isinstance(with_node, ast.With):
+                with_node = parents.get(with_node)
+            if with_node is None:
+                continue
+            names = _guarded_vars(node)
+            if not names:
+                continue
+            fn = enclosing_function(with_node, parents)
+            if fn is None:
+                continue
+            end = with_node.end_lineno or with_node.lineno
+            skip = _alternative_nodes(with_node, parents)
+            for n in ast.walk(fn):
+                if not (isinstance(n, ast.Name) and n.id in names
+                        and isinstance(n.ctx, ast.Load)
+                        and (n.lineno or 0) > end
+                        and id(n) not in skip):
+                    continue
+                par = parents.get(n)
+                if isinstance(par, ast.Attribute) \
+                        and par.attr in SAFE_ATTRS:
+                    continue
+                findings.append(Finding(
+                    relpath, n.lineno, "TPU201", qual_of(n),
+                    f"batch {n.id!r} read after its planes donated "
+                    f"under guard({node.args[0].value!r}, ...) at line "
+                    f"{with_node.lineno} — donated planes are DELETED "
+                    "at dispatch; restructure so the guarded dispatch "
+                    "is the last plane-reaching use"))
+
+        # --- TPU202 (warn): certified site dispatching with no mask ---
+        if _is_cached_builder_call(node):
+            site = _site_of_cached_call(node)
+            if site is not None and site in manifest \
+                    and manifest[site].certified \
+                    and not any(kw.arg == "donate" for kw in node.keywords):
+                findings.append(Finding(
+                    relpath, node.lineno, "TPU202", qual_of(node),
+                    f"site {site!r} is donation-certified "
+                    f"(donation.py:{manifest[site].line}) but this "
+                    "cached_pipeline call plumbs no donate= mask — the "
+                    "certified peak-temp win is not being taken"))
+
+        # --- TPU203: donation declared outside cached_pipeline --------
+        if _is_jit_like(node) and _donating_kw(node):
+            fn = enclosing_function(node, parents)
+            routed = False
+            while fn is not None:
+                if fn in donate_routed:
+                    routed = True
+                    break
+                fn = enclosing_function(fn, parents)
+            if not routed:
+                findings.append(Finding(
+                    relpath, node.lineno, "TPU203", qual_of(node),
+                    "donate_argnums declared outside a cached_pipeline "
+                    "builder carrying donate= — the mask must fold into "
+                    "the structural key and the AOT entry identity, or "
+                    "donating and non-donating callers share one cache "
+                    "entry"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# CLI (run_tool semantics, with TPU202 degraded to a warning that never
+# affects the exit status)
+# ---------------------------------------------------------------------------
+def explain(manifest: Dict[str, SpecRow]) -> int:
+    for s in manifest.values():
+        verdict = (f"CERTIFIED argnums={s.argnums} retry={s.retry}"
+                   if s.certified else "NOT CERTIFIED")
+        print(f"{s.site}: {verdict}")
+        print(f"    {s.reason}")
+    return 0
+
+
+def main(argv: List[str]) -> int:
+    manifest = load_manifest()
+    if "--explain" in argv:
+        return explain(manifest)
+    args = [a for a in argv if not a.startswith("--")]
+    target = os.path.abspath(args[0]) if args else DEFAULT_TARGET
+    allow_path = _default_allowlist_path()
+    for a in argv:
+        if a.startswith("--allowlist="):
+            allow_path = a.split("=", 1)[1]
+    if not os.path.exists(target):
+        print(f"tpu_donate: no such target {target}", file=sys.stderr)
+        return 2
+    allowed = load_allowlist(allow_path)
+    errors: List[Finding] = []
+    warnings_: List[Finding] = []
+    used: Set[str] = set()
+    for path in iter_py_files(target):
+        rel = os.path.relpath(path, REPO_ROOT)
+        for f in check_file(path, rel, manifest):
+            if f.key() in allowed:
+                used.add(f.key())
+                continue
+            (warnings_ if f.rule == "TPU202" else errors).append(f)
+    for f in errors:
+        print(str(f))
+    for f in warnings_:
+        print(f"warning: {f}")
+    stale = allowed - used
+    if stale and "--strict-allowlist" in argv:
+        for s in sorted(stale):
+            print(f"tpu_donate: stale allowlist entry: {s}",
+                  file=sys.stderr)
+        return 1
+    if errors:
+        print(f"tpu_donate: {len(errors)} finding(s), "
+              f"{len(warnings_)} warning(s) ({len(used)} allowlisted)",
+              file=sys.stderr)
+        return 1
+    if warnings_:
+        print(f"tpu_donate: clean with {len(warnings_)} warning(s) "
+              f"({len(used)} allowlisted site(s))")
+        return 0
+    print(f"tpu_donate: clean ({len(used)} allowlisted site(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

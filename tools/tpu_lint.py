@@ -27,6 +27,15 @@ TPU003  traced-value hazard inside a jit region: within a function
         parameter, ``.item()``, or an ``if``/``while`` whose test reads
         a traced parameter (python control flow cannot branch on traced
         values).
+TPU005  raw ``jax.jit`` / ``pjit`` outside the guarded pipeline-cache
+        layer: every engine executable must be built inside a builder
+        handed to ``exec/base.cached_pipeline`` (or
+        ``exec/mesh._cached_program``) so the program participates in
+        the AOT program cache, the compile-cost harvest, and the
+        donation-mask key fold — a raw jit is invisible to all three.
+        ``exec/base.py`` is exempt (it IS the layer); the two AOT
+        export-probe compiles in serve/program_cache.py are the
+        documented allowlisted exceptions.
 TPU004  capacity decision outside the sanctioned layer: a direct
         ``bucket_rows``/``round_up_pow2`` call, or hand-rolled
         power-of-two arithmetic (``1 << (...).bit_length()``), anywhere
@@ -98,6 +107,16 @@ def _is_jit_call(call: ast.Call) -> bool:
     chain = _attr_chain(call.func)
     return chain is not None and chain.split(".")[0] in JAX_MODULE_ALIASES \
         and chain.endswith(".jit")
+
+
+def _is_jit_like(call: ast.Call) -> bool:
+    """jax.jit OR pjit under any import spelling (TPU005 scope)."""
+    chain = _attr_chain(call.func)
+    if chain is None:
+        return False
+    if chain.split(".")[-1] == "pjit":
+        return True
+    return _is_jit_call(call)
 
 
 def _jit_regions(tree: ast.AST, parents) -> Set[ast.AST]:
@@ -193,6 +212,32 @@ def _passed_to_cached_builder(name: str, tree: ast.AST) -> bool:
     return False
 
 
+def _routes_through_cached_builder(call: ast.Call, parents,
+                                   tree: ast.AST) -> bool:
+    """Does this jit/pjit call's result reach the guarded cache layer —
+    i.e. is it (part of) the return value of a builder handed to
+    cached_pipeline/_cached_program, or inside a lambda passed to one
+    directly? (Tuple wrapping — ``return jax.jit(fn), aux`` — is the
+    mesh builders' shape and counts.)"""
+    cur = call
+    while True:
+        parent = parents.get(cur)
+        if parent is None:
+            return False
+        if isinstance(parent, ast.Lambda):
+            outer = parents.get(parent)
+            return isinstance(outer, ast.Call) \
+                and _is_cached_builder_call(outer)
+        if isinstance(parent, ast.Return):
+            fn = _enclosing_function(parent, parents)
+            return isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _passed_to_cached_builder(fn.name, tree)
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Module)):
+            return False
+        cur = parent
+
+
 def _in_cache_store(call: ast.Call, parents, tree: ast.AST) -> bool:
     """jax.jit(...) whose result lands in a subscript store
     (``_CACHE[key] = jax.jit(run)``), is returned from an
@@ -254,6 +299,10 @@ def lint_file(path: str, relpath: str) -> List[Finding]:
             for d in SYNC_STRICT_DIRS)
         and not any(relpath.endswith(s) for s in SANCTIONED_FILES)
     )
+    jit_strict = (
+        f"spark_rapids_tpu{os.sep}" in relpath
+        and not any(relpath.endswith(s) for s in SANCTIONED_FILES)
+    )
     capacity_strict = (
         f"spark_rapids_tpu{os.sep}" in relpath
         and not any(s in relpath for s in CAPACITY_SANCTIONED)
@@ -309,6 +358,15 @@ def lint_file(path: str, relpath: str) -> List[Finding]:
                     "jax.jit(...) inside a function without a cache "
                     "store — every call retraces; keep compiled fns in "
                     "a keyed cache or an lru_cache'd builder"))
+        # --- TPU005: raw jit/pjit outside the guarded cache layer --------
+        if jit_strict and _is_jit_like(node) \
+                and not _routes_through_cached_builder(node, parents, tree):
+            findings.append(Finding(
+                relpath, node.lineno, "TPU005", qual_of(node),
+                "raw jax.jit/pjit outside exec/base.cached_pipeline — "
+                "build programs inside a builder handed to the guarded "
+                "cache so they join the AOT program cache, the cost "
+                "harvest, and the donation-mask key fold"))
         # --- TPU004: capacity decisions outside the sanctioned layer -----
         if capacity_strict:
             callee = (node.func.id if isinstance(node.func, ast.Name)
