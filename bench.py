@@ -107,10 +107,17 @@ def _mem_snapshot():
     compile peaks, not the run's."""
     from spark_rapids_tpu import obs as _obs
     from spark_rapids_tpu.io.scan_cache import DeviceScanCache
+    from spark_rapids_tpu.memory import ledger as _ledger
     from spark_rapids_tpu.memory.catalog import BufferCatalog
 
     cat = BufferCatalog.get()
     cat.metrics.peak_device_bytes = cat.device_bytes
+    # arm the HBM ledger for the shape window (the FORCE_HARVEST
+    # pattern) so the json carries per-op attribution without standing
+    # up the whole events/obs plane; per-op peaks rebase like the
+    # watermark so the read-back is THIS shape's figure
+    _ledger.force_arm()
+    cat.ledger.rebase_peaks()
     reg = _obs.active()
     if reg is not None:
         reg.rebase_gauge("tpu_program_temp_bytes")
@@ -129,11 +136,23 @@ def _mem_stats(before):
     from spark_rapids_tpu.memory.catalog import BufferCatalog
 
     h0, m0 = before
+    cat = BufferCatalog.get()
+    # read the ledger's shape-window attribution BEFORE _mem_snapshot
+    # rebases it for the next shape
+    peaks = {op: b for op, b in cat.ledger.op_peaks().items() if b > 0}
+    owner_top = max(peaks.items(), key=lambda kv: kv[1]) if peaks else None
+    leaked = cat.ledger.stats()["leaked_live"]
     h1, m1 = _mem_snapshot()
     seen = (h1 - h0) + (m1 - m0)
-    cat = BufferCatalog.get()
     return {
         "peak_device_bytes": cat.metrics.peak_device_bytes,
+        # per-op decomposition of that peak (the HBM ledger, force-armed
+        # per shape): who held the bytes, the single largest owner, and
+        # the leak sentinel's tally — leaked_buffers must be 0 and
+        # tpu_profile --diff gates per-op growth
+        "hbm_peak_by_op": peaks,
+        "hbm_owner_top": list(owner_top) if owner_top else None,
+        "leaked_buffers": leaked,
         "scan_cache_hit_rate": (
             round((h1 - h0) / seen, 3) if seen else None),
         "scan_cache_bytes": (
@@ -1151,11 +1170,16 @@ def run_serve_lane(args) -> None:
         "peak_active": st["peak_active"],
         "peak_inflight_forecast": st["peak_inflight_forecast"],
         "errors": errors,
+        # the HBM ledger's verdict on the stress (armed whenever the
+        # lane ran with --event_log): nothing may outlive its query
+        "leaked_buffers": BufferCatalog.get().ledger.stats()[
+            "leaked_live"],
         # the zero-violation contract: every query completed, nothing
-        # rejected, no bypass, and the summed admitted forecasts never
-        # exceeded the budget
+        # rejected, no bypass, no leaked buffers, and the summed
+        # admitted forecasts never exceeded the budget
         "ok": not errors and st["rejected"] == 0
               and st["bypass_admissions"] == 0
+              and BufferCatalog.get().ledger.stats()["leaked_live"] == 0
               and (st["peak_inflight_forecast"] <= budget
                    if budget else True),
     }

@@ -121,6 +121,22 @@ EVENT_TYPES: Dict[str, tuple] = {
     "transfer": ("direction", "bytes", "site"),
     # spill lifecycle with the catalog's LIVE device-byte watermark
     "spill": ("kind", "bytes", "device_bytes"),
+    # per-buffer HBM ledger lifecycle (memory/ledger.py): one alloc per
+    # ledger-tracked buffer (spillable handle / scan-cache entry /
+    # admission reservation) with its full owner tag — the op in scope,
+    # the owning query window, the creation call site ("file.py:line")
+    # and its stable 12-hex origin digest; one free with the reason
+    # (close / donate / split / evict / release / ...). bid is the
+    # ledger id, unique per catalog generation across all kinds.
+    "buffer_alloc": ("bid", "kind", "bytes", "op", "query_id", "site",
+                     "origin"),
+    "buffer_free": ("bid", "kind", "bytes", "reason", "op", "query_id"),
+    # live-heap snapshot at a query-window close (memory/ledger.py
+    # sweep): total attributed device-live bytes, the per-op breakdown,
+    # the top-3 owners, and how many flagged leaks are still live —
+    # tools/tpu_heap.py cross-checks its reconstruction against these
+    "heap_snapshot": ("query_id", "live_bytes", "by_op", "top",
+                      "leaked"),
     # OOM recovery plane (memory/retry.py): one record per recovery
     # action. ``kind`` is retry (spill+backoff before re-attempt) /
     # split (escalation to half-capacity) / requeue (the serve
@@ -231,6 +247,14 @@ EVENT_OPTIONAL_FIELDS: Dict[str, tuple] = {
     # ``ms``: deserialize(+cached-compile) duration on hit/deserialize
     # records; ``detail``: human-readable cause on corrupt/write_error
     "program_cache": ("ms", "detail"),
+    # ``bid``: the ledger id of the buffer that moved tier (present only
+    # while the HBM ledger is armed — lets tpu_heap.py attribute spill
+    # churn to the owning op without a second bookkeeping stream)
+    "spill": ("bid",),
+    # ``forecast_source``: where the admitted forecast came from —
+    # "analyzer" (static plan bound) or "ledger" (observed per-digest
+    # peak, the ROADMAP 5a measured-stats feed)
+    "admission": ("forecast_source",),
 }
 
 
@@ -446,6 +470,19 @@ def chrome_trace(records: List[dict]) -> dict:
     out: List[dict] = []
     open_queries: Dict[Any, dict] = {}
     compile_s = 0.0
+    #: per-op device-live bytes reconstructed from the HBM ledger's
+    #: buffer lifecycle — rendered as one counter track per op so the
+    #: watermark's owners are visually attributable at any timestamp
+    hbm_by_op: Dict[str, int] = {}
+    ledger_ops: Dict[Any, str] = {}
+    ledger_dev: set = set()  # bids currently device-resident
+
+    def hbm_counter(ts: int, op: Optional[str], delta: int) -> None:
+        key = op or "(unattributed)"
+        hbm_by_op[key] = hbm_by_op.get(key, 0) + delta
+        out.append({"ph": "C", "pid": _PID, "name": f"hbm_bytes {key}",
+                    "ts": us(ts), "args": {"bytes": hbm_by_op[key]}})
+
     for r in records:
         ev = r.get("event")
         ts = r["ts"]
@@ -507,6 +544,33 @@ def chrome_trace(records: List[dict]) -> dict:
                         "ts": us(ts), "args": {"bytes": r["device_bytes"]}})
             out.append({"ph": "i", "pid": _PID, "tid": tid_of("memory"),
                         "name": f"{r['kind']} {r['bytes']}B", "ts": us(ts),
+                        "s": "t"})
+            # a ledger-stamped spill moves its buffer's bytes off (or
+            # back onto) the owning op's counter track
+            bid = r.get("bid")
+            if bid in ledger_ops:
+                if r["kind"] == "unspill" and bid not in ledger_dev:
+                    ledger_dev.add(bid)
+                    hbm_counter(ts, ledger_ops[bid], r["bytes"])
+                elif r["kind"] == "device_to_host" and bid in ledger_dev:
+                    ledger_dev.discard(bid)
+                    hbm_counter(ts, ledger_ops[bid], -r["bytes"])
+        elif ev == "buffer_alloc":
+            if r.get("kind") != "reservation":
+                ledger_ops[r["bid"]] = r.get("op")
+                ledger_dev.add(r["bid"])
+                hbm_counter(ts, r.get("op"), r["bytes"])
+        elif ev == "buffer_free":
+            bid = r.get("bid")
+            if bid in ledger_ops:
+                if bid in ledger_dev:
+                    hbm_counter(ts, ledger_ops[bid], -r["bytes"])
+                ledger_dev.discard(bid)
+                del ledger_ops[bid]
+        elif ev == "heap_snapshot":
+            out.append({"ph": "i", "pid": _PID, "tid": tid_of("memory"),
+                        "name": f"heap {r.get('live_bytes')}B live, "
+                                f"{r.get('leaked')} leaked", "ts": us(ts),
                         "s": "t"})
         elif ev == "oom_retry":
             # the resilience track: recovery actions land beside the

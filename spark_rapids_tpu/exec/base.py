@@ -485,10 +485,13 @@ class TpuExec:
             # live plane: per-op time counters + the open-span table the
             # stall watchdog samples (wrapper only exists while obs is on)
             ctx = _obs_timed(ctx, self.node_name, section)
-        if _xla_cost.harvesting():
-            # cost-plane op attribution rides THE harvester's own gate
-            # (one source of truth — a new harvest consumer must not be
-            # able to harvest with attribution silently missing); the
+        if (_xla_cost.harvesting() or _events.enabled()
+                or _obs.enabled()):
+            # ambient op attribution has two consumers: the cost-plane
+            # harvester (programs compiled in this hot section record
+            # op=<node_name>) and the HBM ledger (buffers registered in
+            # it carry an owning op — the ledger arms exactly when
+            # events or obs are on, so ride the same gates); the
             # disabled fast path stays the plain timed() context
             ctx = _op_scoped(ctx, self.node_name)
         return ctx
@@ -721,11 +724,22 @@ def memory_footer() -> str:
     def mb(v: int) -> str:
         return f"{v / 1e6:.1f}MB"
 
-    return (f"memory: device {mb(cat.device_bytes)} "
+    line = (f"memory: device {mb(cat.device_bytes)} "
             f"(peak {mb(m.peak_device_bytes)}), "
             f"spilled {mb(m.spilled_bytes)} in {m.device_to_host} "
             f"spill(s) ({m.host_to_disk} to disk), "
             f"{m.unspills} unspill(s)")
+    # the HBM ledger (when armed) decomposes that peak by owning op —
+    # the "who held the bytes" column the bare watermark can't answer
+    peaks = {op: b for op, b in cat.ledger.op_peaks().items() if b > 0}
+    if peaks:
+        rows = sorted(peaks.items(), key=lambda kv: kv[1], reverse=True)
+        line += "\nmemory by op (peak): " + ", ".join(
+            f"{op} {mb(b)}" for op, b in rows)
+        leaked = cat.ledger.stats()["leaked_live"]
+        if leaked:
+            line += f"; LEAKED {leaked} buffer(s)"
+    return line
 
 
 # ---------------------------------------------------------------------------

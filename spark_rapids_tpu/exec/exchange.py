@@ -723,8 +723,14 @@ class TpuBroadcastExchangeExec(TpuExec):
                     # only the handle keeps a reference, so a spill really
                     # frees the device copy
                     from ..memory import SpillableColumnarBatch
+                    from .. import xla_cost as _xc
 
-                    self._spillable = SpillableColumnarBatch(built)
+                    # scoped registration: materialize() runs on first
+                    # consumer pull, outside op_timed, so the ledger
+                    # needs the op pushed explicitly
+                    with _xc.op_scope(self.node_name):
+                        self._spillable = SpillableColumnarBatch(
+                            built, ledger_kind="plan_state")
                 self._built = True  # latch: build attempted
             if getattr(self, "_spillable", None) is not None:
                 return self._spillable.get_batch()

@@ -41,10 +41,15 @@ class _SpillableBuild:
         from ..memory import ACTIVE_BATCHING_PRIORITY, SpillableVals
         from ..memory.catalog import SpillableHandle
 
-        self._cols = SpillableVals(cols, ACTIVE_BATCHING_PRIORITY)
+        # ledger_kind="plan_state": the build side is retained with the
+        # exec instance for re-execution — designed to outlive queries,
+        # so the leak sentinel must not flag it
+        self._cols = SpillableVals(cols, ACTIVE_BATCHING_PRIORITY,
+                                   ledger_kind="plan_state")
         aux = {f"w{i}": w for i, w in enumerate(words)}
         aux["live"] = live
-        self._aux = SpillableHandle(aux, ACTIVE_BATCHING_PRIORITY)
+        self._aux = SpillableHandle(aux, ACTIVE_BATCHING_PRIORITY,
+                                    ledger_kind="plan_state")
         self._nw = len(words)
 
     def get(self):
@@ -425,8 +430,14 @@ class TpuShuffledHashJoinExec(TpuExec):
         # the build side is registered with the buffer catalog so memory
         # pressure can spill it between build and probe (reference:
         # SpillableColumnarBatch around the concatenated build table,
-        # GpuShuffledHashJoinExec)
-        sb = _SpillableBuild(sorted_cols, sorted_words, live_all)
+        # GpuShuffledHashJoinExec). The registration runs under this
+        # exec's op scope: builds happen lazily on first probe — outside
+        # any op_timed section — so the HBM ledger would otherwise book
+        # the plan-state bytes as unattributed.
+        from .. import xla_cost as _xc
+
+        with _xc.op_scope(self.node_name):
+            sb = _SpillableBuild(sorted_cols, sorted_words, live_all)
         # the raw concatenated batch must NOT ride in the tuple: the handle
         # is the only reference so a spill actually frees the device copy
         built = (sb, int(count), cap, sml)
@@ -563,12 +574,19 @@ class TpuShuffledHashJoinExec(TpuExec):
         from ..memory import ACTIVE_BATCHING_PRIORITY
         from ..memory.catalog import SpillableHandle
 
+        from .. import xla_cost as _xc
+
         arrays = {"tbl": packed_tbl, "kmin": kmin}
         if need_mat:
             arrays["mat"] = res[4]
+        # fast builds run at fusion-planning time, outside op_timed:
+        # scope the registration so the ledger attributes the state
+        with _xc.op_scope(self.node_name):
+            handle = SpillableHandle(arrays, ACTIVE_BATCHING_PRIORITY,
+                                     ledger_kind="plan_state")
         state = {
             "kind": "direct",
-            "handle": SpillableHandle(arrays, ACTIVE_BATCHING_PRIORITY),
+            "handle": handle,
             "has_mat": need_mat,
         }
         if need_mat:
@@ -629,13 +647,18 @@ class TpuShuffledHashJoinExec(TpuExec):
         from ..memory import ACTIVE_BATCHING_PRIORITY
         from ..memory.catalog import SpillableHandle
 
+        from .. import xla_cost as _xc
+
         arrays = {f"w{i}": w for i, w in enumerate(sorted_words)}
         arrays["count"] = count
         if need_mat:
             arrays["mat"] = res[3]
+        with _xc.op_scope(self.node_name):
+            handle = SpillableHandle(arrays, ACTIVE_BATCHING_PRIORITY,
+                                     ledger_kind="plan_state")
         state = {
             "kind": "radix",
-            "handle": SpillableHandle(arrays, ACTIVE_BATCHING_PRIORITY),
+            "handle": handle,
             "has_mat": need_mat,
             "nwords": len(sorted_words),
         }

@@ -22,6 +22,17 @@ from typing import Any, Dict, Optional, Tuple
 
 from .. import events as _events
 from .. import obs as _obs
+from ..utils.locks import ordered_lock
+
+
+def _ledger():
+    """The HBM ledger riding the process catalog: cache entries hold
+    device arrays the catalog watermark never sees, so the ledger is
+    where their residency gets an owner tag. Entries are exempt from the
+    leak sentinel (kind=scan_cache — outliving queries is the point)."""
+    from ..memory.catalog import BufferCatalog
+
+    return BufferCatalog.get().ledger
 
 
 class DeviceScanCache:
@@ -30,8 +41,12 @@ class DeviceScanCache:
 
     def __init__(self, max_bytes: int):
         self.max_bytes = max_bytes
-        self._lock = threading.Lock()
-        self._entries: "OrderedDict[tuple, Tuple[Any, int]]" = OrderedDict()
+        # declared: put/evict feed the HBM ledger + leaf sinks while held
+        self._lock = ordered_lock("io.scan_cache")
+        #: key -> (value, nbytes, ledger id) — lid is None while the
+        #: HBM ledger is unarmed (the zero-overhead-off path)
+        self._entries: "OrderedDict[tuple, Tuple[Any, int, Any]]" = \
+            OrderedDict()
         self._bytes = 0
         self.hits = 0
         self.misses = 0
@@ -47,20 +62,27 @@ class DeviceScanCache:
         with cls._instance_lock:
             if cls._instance is None:
                 cls._instance = DeviceScanCache(budget)
-            elif cls._instance.max_bytes != budget:
-                # a later session's budget governs: the singleton resizes
-                # instead of silently pinning the first session's value
-                cls._instance.resize(budget)
-            return cls._instance
+                return cls._instance
+            inst = cls._instance
+        # a later session's budget governs: the singleton resizes instead
+        # of silently pinning the first session's value. Outside the latch
+        # — resize takes the declared cache lock and calls into the
+        # ledger, which must not nest under a raw singleton latch; two
+        # concurrent sessions racing here both resize, idempotently.
+        if inst.max_bytes != budget:
+            inst.resize(budget)
+        return inst
 
     def resize(self, max_bytes: int) -> None:
         """Adopt a new byte budget, evicting LRU entries if it shrank."""
         with self._lock:
             self.max_bytes = int(max_bytes)
             while self._bytes > self.max_bytes and self._entries:
-                _, (_, sz) = self._entries.popitem(last=False)
+                _, (_, sz, lid) = self._entries.popitem(last=False)
                 self._bytes -= sz
                 self.evictions += 1
+                if lid is not None:
+                    _ledger().note_free(lid, reason="evict")
                 if _events.enabled():
                     _events.emit("scan_cache", op="evict", bytes=sz)
                 if _obs.enabled():
@@ -113,21 +135,28 @@ class DeviceScanCache:
     def put(self, key: tuple, value: Any, nbytes: int) -> None:
         with self._lock:
             if key in self._entries:
-                _, old = self._entries.pop(key)
+                _, old, old_lid = self._entries.pop(key)
                 self._bytes -= old
+                if old_lid is not None:
+                    _ledger().note_free(old_lid, reason="replace")
             # one oversized entry must not wedge the pool
             if nbytes > self.max_bytes:
                 return
-            self._entries[key] = (value, nbytes)
+            led = _ledger()
+            lid = led.note_alloc(nbytes, kind="scan_cache") \
+                if led.armed() else None
+            self._entries[key] = (value, nbytes, lid)
             self._bytes += nbytes
             if _events.enabled():
                 _events.emit("scan_cache", op="put", bytes=nbytes)
             if _obs.enabled():
                 self._obs_note("put", nbytes)
             while self._bytes > self.max_bytes and self._entries:
-                _, (_, sz) = self._entries.popitem(last=False)
+                _, (_, sz, elid) = self._entries.popitem(last=False)
                 self._bytes -= sz
                 self.evictions += 1
+                if elid is not None:
+                    _ledger().note_free(elid, reason="evict")
                 if _events.enabled():
                     _events.emit("scan_cache", op="evict", bytes=sz)
                 if _obs.enabled():
@@ -141,6 +170,9 @@ class DeviceScanCache:
         with self._lock:
             freed = self._bytes
             n = len(self._entries)
+            for _, _, lid in self._entries.values():
+                if lid is not None:
+                    _ledger().note_free(lid, reason="pressure_drop")
             self._entries.clear()
             self._bytes = 0
             self.evictions += n
@@ -160,8 +192,10 @@ class DeviceScanCache:
         with self._lock:
             dead = [k for k in self._entries if k and k[0] == path]
             for k in dead:
-                _, sz = self._entries.pop(k)
+                _, sz, lid = self._entries.pop(k)
                 self._bytes -= sz
+                if lid is not None:
+                    _ledger().note_free(lid, reason="invalidate")
 
 
 _REALPATH_CACHE: dict = {}

@@ -15,6 +15,7 @@ from .catalog import (
     TIER_DISK,
     TIER_HOST,
 )
+from .ledger import Ledger, current_query, force_arm, query_scope
 from .retry import (
     TpuOOMError,
     TpuOutOfDeviceMemory,
@@ -34,6 +35,7 @@ __all__ = [
     "BufferCatalog",
     "HOST_MEMORY_BUFFER_SPILL_PRIORITY",
     "INPUT_FROM_SHUFFLE_PRIORITY",
+    "Ledger",
     "SpillableHandle",
     "SpillableColumnarBatch",
     "SpillableVals",
@@ -47,8 +49,11 @@ __all__ = [
     "TpuSemaphoreTimeout",
     "TpuSplitAndRetryOOM",
     "classify_oom",
+    "current_query",
+    "force_arm",
     "is_device_oom",
     "named_oom",
+    "query_scope",
     "with_oom_retry",
     "with_oom_retry_nosplit",
 ]

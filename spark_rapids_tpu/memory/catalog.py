@@ -32,6 +32,7 @@ from ..conf import (
     conf,
 )
 from ..utils.locks import ordered_lock
+from .ledger import KIND_RESERVATION, Ledger
 
 log = logging.getLogger("spark_rapids_tpu.memory")
 
@@ -116,6 +117,10 @@ class BufferCatalog:
         self._reservations: Dict[int, tuple] = {}
         self._reserved_bytes = 0
         self._next_rid = 0
+        #: per-buffer lifecycle book (owner attribution, leak sentinel,
+        #: observed-peak admission feed) — armed only while events/obs
+        #: are on (or force-armed by bench/tests)
+        self.ledger = Ledger(self.conf)
 
     # -- singleton (reference: RapidsBufferCatalog.singleton) --------------
     @classmethod
@@ -168,10 +173,14 @@ class BufferCatalog:
                          bid, handle.size, handle.priority, self._device_bytes)
             if _obs.enabled():
                 self._obs_watermark()
+            if self.ledger.armed():
+                handle._lid = self.ledger.note_alloc(
+                    handle.size,
+                    kind=getattr(handle, "ledger_kind", "spillable"))
         self.request(0)
         return bid
 
-    def unregister(self, bid: int) -> None:
+    def unregister(self, bid: int, reason: str = "close") -> None:
         with self._lock:
             h = self._buffers.pop(bid, None)
             if h is None:
@@ -182,6 +191,7 @@ class BufferCatalog:
                 self._host_bytes -= h.size
             if _obs.enabled():
                 self._obs_watermark()
+            self.ledger.note_free(getattr(h, "_lid", None), reason)
 
     def on_unspill(self, h: "SpillableHandle", from_host: bool) -> None:
         with self._lock:
@@ -193,22 +203,27 @@ class BufferCatalog:
                 self.metrics.peak_device_bytes = self._device_bytes
             if _events.enabled():
                 _events.emit("spill", kind="unspill", bytes=h.size,
-                             device_bytes=self._device_bytes)
+                             device_bytes=self._device_bytes,
+                             bid=getattr(h, "_lid", None))
             if _obs.enabled():
                 _obs.inc("tpu_spills", 1, kind="unspill")
                 _obs.inc("tpu_spill_bytes", h.size, kind="unspill")
                 self._obs_watermark()
+            self.ledger.note_unspill(getattr(h, "_lid", None))
         # the just-materialized buffer is the one in use: spill OTHERS to
         # make room (the reference pins via addReference during access)
         self.request(0, exclude=h)
 
     # -- pressure ----------------------------------------------------------
-    def _account_device_spill(self, freed: int, emergency: bool) -> None:
+    def _account_device_spill(self, freed: int, emergency: bool,
+                              handle: Optional["SpillableHandle"] = None
+                              ) -> None:
         """THE device->host spill bookkeeping (byte counters, metrics,
         spill event, obs twins, debug log) — one body shared by the
         proactive path (:meth:`request`) and the OOM-recovery path
         (:meth:`ensure_headroom`) so the two sets of books can never
         diverge. Called after a successful ``spill_to_host``."""
+        lid = getattr(handle, "_lid", None)
         with self._lock:
             self._device_bytes -= freed
             self._host_bytes += freed
@@ -217,12 +232,14 @@ class BufferCatalog:
             if _events.enabled():
                 _events.emit("spill", kind="device_to_host",
                              bytes=freed,
-                             device_bytes=self._device_bytes)
+                             device_bytes=self._device_bytes,
+                             bid=lid)
             if _obs.enabled():
                 _obs.inc("tpu_spills", 1, kind="device_to_host")
                 _obs.inc("tpu_spill_bytes", freed,
                          kind="device_to_host")
                 self._obs_watermark()
+            self.ledger.note_spill(lid)
         if self.conf.get(MEMORY_DEBUG):
             log.info("%sspilled %d B to host (device=%d B)",
                      "emergency " if emergency else "", freed,
@@ -284,7 +301,8 @@ class BufferCatalog:
                 break
             freed = h.spill_to_host()
             if freed:
-                self._account_device_spill(freed, emergency=False)
+                self._account_device_spill(freed, emergency=False,
+                                           handle=h)
                 need -= freed
         self._drain_host_overage()
 
@@ -318,7 +336,7 @@ class BufferCatalog:
             if not freed:
                 continue
             total += freed
-            self._account_device_spill(freed, emergency=True)
+            self._account_device_spill(freed, emergency=True, handle=h)
         # unconditional (not gated on total): a recovery pass that freed
         # nothing itself must still drain an overage a concurrent
         # spiller left — the host cap holds on every exit path
@@ -348,6 +366,13 @@ class BufferCatalog:
         return self._device_bytes
 
     # -- admission reservations (serve/scheduler.py) -----------------------
+    def observed_query_peak(self, query_id: Optional[str]
+                            ) -> Optional[int]:
+        """Ledger-observed device-byte peak of one query — the figure
+        the PR 13 requeue inflates its forecast to (replacing the raw
+        global watermark the typed OOM carries)."""
+        return self.ledger.query_peak(query_id)
+
     def reserve(self, nbytes: int, label: str = "") -> int:
         """Charge an admitted query's peak-HBM forecast against the
         budget until :meth:`release_reservation`. Accounting only — no
@@ -358,7 +383,11 @@ class BufferCatalog:
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
-            self._reservations[rid] = (int(nbytes), label)
+            lid = self.ledger.note_alloc(
+                int(nbytes), kind=KIND_RESERVATION,
+                site=f"reservation:{label}" if label else "reservation",
+            ) if self.ledger.armed() else None
+            self._reservations[rid] = (int(nbytes), label, lid)
             self._reserved_bytes += int(nbytes)
             if _obs.enabled():
                 _obs.set_gauge("tpu_hbm_reserved_bytes",
@@ -374,6 +403,7 @@ class BufferCatalog:
             if entry is None:
                 return
             self._reserved_bytes -= entry[0]
+            self.ledger.note_free(entry[2], reason="release")
             if _obs.enabled():
                 _obs.set_gauge("tpu_hbm_reserved_bytes",
                                self._reserved_bytes)
@@ -398,8 +428,14 @@ class SpillableHandle:
     RapidsBuffer implementations)."""
 
     def __init__(self, arrays: Dict[str, "object"], priority: int = 0,
-                 catalog: Optional[BufferCatalog] = None):
+                 catalog: Optional[BufferCatalog] = None,
+                 ledger_kind: str = "spillable"):
         self._catalog = catalog or BufferCatalog.get()
+        #: HBM-ledger record kind. Sites whose buffers DELIBERATELY
+        #: outlive the creating query (join build sides, broadcast
+        #: batches — reused with the cached plan) declare "plan_state"
+        #: so the leak sentinel doesn't flag designed retention.
+        self.ledger_kind = ledger_kind
         self._device: Optional[Dict[str, object]] = dict(arrays)
         self._host: Optional[Dict[str, object]] = None
         self._disk_path: Optional[str] = None
@@ -408,6 +444,9 @@ class SpillableHandle:
         self.pinned = False
         self.size = sum(a.size * a.dtype.itemsize for a in arrays.values())
         self._closed = False
+        #: ledger record id — assigned by register() when the ledger is
+        #: armed, None otherwise (the zero-overhead-off path)
+        self._lid: Optional[int] = None
         # guards tier transitions; "memory.spillable" ranks just above
         # the catalog — close() unregisters while holding it
         self._tlock = ordered_lock("memory.spillable", reentrant=True)
@@ -468,7 +507,7 @@ class SpillableHandle:
         return dev
 
     # -- lifecycle (Arm idiom: with_resource(SpillableHandle(...))) --------
-    def close(self) -> None:
+    def close(self, reason: str = "close") -> None:
         # taken under the tier lock so a close can't interleave with an
         # in-flight spill: unregister() reads self.tier to pick which byte
         # counter to decrement, and the spill loop decrements the same
@@ -478,7 +517,7 @@ class SpillableHandle:
             if self._closed:
                 return
             self._closed = True
-            self._catalog.unregister(self._id)
+            self._catalog.unregister(self._id, reason=reason)
             self._device = None
             self._host = None
             if self._disk_path and os.path.exists(self._disk_path):

@@ -18,7 +18,8 @@ class SpillableVals:
     attached yet."""
 
     def __init__(self, vals, priority: int = ACTIVE_BATCHING_PRIORITY,
-                 catalog: Optional[BufferCatalog] = None):
+                 catalog: Optional[BufferCatalog] = None,
+                 ledger_kind: str = "spillable"):
         from ..expr.values import StrV
 
         arrays = {}
@@ -33,7 +34,8 @@ class SpillableVals:
                 arrays[f"c{i}_data"] = v.data
                 arrays[f"c{i}_validity"] = v.validity
                 self._layout.append("f")
-        self._handle = SpillableHandle(arrays, priority, catalog)
+        self._handle = SpillableHandle(arrays, priority, catalog,
+                                       ledger_kind=ledger_kind)
 
     @property
     def size_bytes(self) -> int:
@@ -56,14 +58,15 @@ class SpillableVals:
                 out.append(ColV(arrs[f"c{i}_data"], arrs[f"c{i}_validity"]))
         return out
 
-    def close(self) -> None:
-        self._handle.close()
+    def close(self, reason: str = "close") -> None:
+        self._handle.close(reason=reason)
 
 
 class SpillableColumnarBatch:
     def __init__(self, batch: ColumnarBatch,
                  priority: int = ACTIVE_BATCHING_PRIORITY,
-                 catalog: Optional[BufferCatalog] = None):
+                 catalog: Optional[BufferCatalog] = None,
+                 ledger_kind: str = "spillable"):
         self.schema = batch.schema
         self.num_rows = batch.num_rows
         arrays = {}
@@ -78,7 +81,8 @@ class SpillableColumnarBatch:
                 arrays[f"c{i}_data"] = c.data
                 arrays[f"c{i}_validity"] = c.validity
                 self._layout.append("f")
-        self._handle = SpillableHandle(arrays, priority, catalog)
+        self._handle = SpillableHandle(arrays, priority, catalog,
+                                       ledger_kind=ledger_kind)
 
     @property
     def size_bytes(self) -> int:
@@ -104,5 +108,5 @@ class SpillableColumnarBatch:
     def tier(self) -> int:
         return self._handle.tier
 
-    def close(self) -> None:
-        self._handle.close()
+    def close(self, reason: str = "close") -> None:
+        self._handle.close(reason=reason)

@@ -201,6 +201,18 @@ METRICS: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
         "temps — zero here with donation enabled means no dispatch "
         "qualified (batches not exclusive, dict columns, or the site "
         "is uncertified).", ("site",)),
+    "tpu_hbm_bytes": (
+        GAUGE, "Device-live HBM bytes attributed per owning op by the "
+        "per-buffer ledger (memory/ledger.py; the buffer_alloc/"
+        "buffer_free events' live twin). Covers spillable handles AND "
+        "scan-cache entries — the attributed decomposition of "
+        "tpu_hbm_device_bytes plus cache residency; '(unattributed)' "
+        "rows are buffers created outside any op scope.", ("op",)),
+    "tpu_hbm_leaked_buffers": (
+        COUNTER, "Buffers the leak sentinel flagged as outliving their "
+        "owning query (memory/ledger.py sweep at query end; the "
+        "heap_snapshot event's live twin). Any nonzero value is a "
+        "lifecycle bug — the /status heap block names the owners.", ()),
 }
 
 #: event type -> the live metric family that carries the same signal, so
@@ -232,6 +244,9 @@ EVENT_BACKED_METRICS: Dict[str, str] = {
     "oom_retry": "tpu_oom_retries",
     "batch_split": "tpu_batch_splits",
     "donation": "tpu_donated_bytes",
+    "buffer_alloc": "tpu_hbm_bytes",
+    "buffer_free": "tpu_hbm_bytes",
+    "heap_snapshot": "tpu_hbm_leaked_buffers",
 }
 
 

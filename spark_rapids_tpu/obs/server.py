@@ -68,6 +68,10 @@ def build_status(registry: MetricsRegistry, progress: ProgressTracker,
         "queries_live": progress.live_count(),
         "env": envinfo.environment_info(),
         "hbm": hbm,
+        # per-buffer HBM ledger (memory/ledger.py): live bytes broken
+        # down by owning op, top owners, and the leak sentinel's tally —
+        # all zeros while the ledger is unarmed
+        "heap": cat.ledger.status_block(),
         "serve": serve,
         "program_cache": program_cache.stats(),
         "alerts": [a.to_json() for a in watchdog.alerts()]

@@ -48,6 +48,7 @@ STALL = "stall"
 HBM_PRESSURE = "hbm_pressure"
 RECOMPILE_STORM = "recompile_storm"
 RETRY_STORM = "retry_storm"
+BUFFER_LEAK = "buffer_leak"
 
 
 def _default_storm_threshold() -> int:
@@ -98,7 +99,8 @@ class WatchdogRules:
 
 @dataclasses.dataclass
 class Alert:
-    kind: str        # stall | hbm_pressure | recompile_storm
+    kind: str        # stall | hbm_pressure | recompile_storm |
+                     # retry_storm | buffer_leak
     detail: str      # what tripped (op name, site, watermark source)
     value: float     # the measured quantity (ns, bytes, miss count)
     threshold: float  # the rule it crossed
@@ -121,6 +123,10 @@ class Alert:
                     f"OOM recovery actions in window "
                     f"(threshold {self.threshold:g}) — forecasts or the "
                     "HBM budget need attention")
+        if self.kind == BUFFER_LEAK:
+            return (f"buffer_leak: {self.value:g} buffer"
+                    f"{'' if self.value == 1 else 's'} outlived the "
+                    f"owning query — {self.detail}")
         return (f"recompile_storm: site {self.detail} compiled "
                 f"{self.value:g} times in window "
                 f"(threshold {self.threshold:g})")
@@ -181,9 +187,29 @@ class Watchdog:
             limit = self.rules.pressure_fraction * budget
             dev = cat.device_bytes
             if dev >= limit:
+                detail = "BufferCatalog device watermark"
+                # the HBM ledger (when armed) knows WHO holds the bytes —
+                # an actionable alert names the owners, not just the level
+                owners = cat.ledger.top_owners(3)
+                if owners:
+                    detail += " — top owners: " + ", ".join(
+                        f"{op} {b / 1e6:.1f}MB" for op, b in owners)
                 found[(HBM_PRESSURE,)] = Alert(
-                    HBM_PRESSURE, "BufferCatalog device watermark",
-                    dev, limit, now)
+                    HBM_PRESSURE, detail, dev, limit, now)
+
+        # buffer leaks: the ledger's query-end sentinel flagged live
+        # buffers that outlived their owning query — the alert stays
+        # active until the leaked buffers are actually freed
+        leaks = cat.ledger.live_leaks()
+        if leaks:
+            top = sorted(
+                leaks, key=lambda r: -(r.get("bytes") or 0))[:3]
+            detail = ", ".join(
+                f"{r.get('op') or '(unattributed)'} "
+                f"{(r.get('bytes') or 0) / 1e6:.1f}MB "
+                f"(query {r.get('query_id')})" for r in top)
+            found[(BUFFER_LEAK,)] = Alert(
+                BUFFER_LEAK, detail, len(leaks), 1, now)
 
         # live recompile storm: misses per site inside the window
         lo = now - self.rules.storm_window_ns
@@ -295,7 +321,17 @@ def replay_alerts(events: List[dict], rules: WatchdogRules,
                            threshold before the same site alerts again);
       * retry_storm      — the same sliding-window/episode rule over
                            ``oom_retry`` events per op (the live rule
-                           samples the registry's retry ring).
+                           samples the registry's retry ring);
+      * buffer_leak      — any ``heap_snapshot`` with ``leaked > 0``
+                           (the ledger's query-end sentinel fired); one
+                           alert per episode, cleared by a clean
+                           snapshot.
+
+    When the log carries ledger events (``buffer_alloc``/``buffer_free``
+    plus bid-stamped spills), the pressure alert reconstructs per-op
+    device residency and names the top-3 owning ops at the moment the
+    watermark crossed the line — the replay twin of the live alert's
+    ``top_owners`` detail.
     """
     out: List[Alert] = []
     site_win: Dict[str, deque] = {}
@@ -303,6 +339,11 @@ def replay_alerts(events: List[dict], rules: WatchdogRules,
     retry_win: Dict[str, deque] = {}
     retry_storming: Dict[str, bool] = {}
     pressure_active = False
+    leak_active = False
+    # bid -> (op, bytes) for device-resident ledger buffers, so the
+    # pressure alert can name owners from the recording alone
+    heap: Dict[object, tuple] = {}
+    off_device: Set[object] = set()
     for r in events:
         ev = r.get("event")
         ts = r.get("ts", 0)
@@ -320,14 +361,44 @@ def replay_alerts(events: List[dict], rules: WatchdogRules,
                 name = r.get("op", "?") + (
                     "." + r["section"] if r.get("section") else "")
                 out.append(Alert(STALL, name, dur, rules.stall_ns, ts))
-        elif ev == "spill" and budget:
+        elif ev == "spill":
+            bid = r.get("bid")
+            if bid is not None and bid in heap:
+                if r.get("kind") == "device_to_host":
+                    off_device.add(bid)
+                elif r.get("kind") == "unspill":
+                    off_device.discard(bid)
+            if not budget:
+                continue
             limit = rules.pressure_fraction * budget
             dev = r.get("device_bytes") or 0
             if dev >= limit and not pressure_active:
-                out.append(Alert(
-                    HBM_PRESSURE, "BufferCatalog device watermark",
-                    dev, limit, ts))
+                detail = "BufferCatalog device watermark"
+                by_op: Dict[str, int] = {}
+                for hbid, (hop, hbytes) in heap.items():
+                    if hbid not in off_device:
+                        by_op[hop] = by_op.get(hop, 0) + hbytes
+                owners = sorted(
+                    by_op.items(), key=lambda kv: -kv[1])[:3]
+                if owners:
+                    detail += " — top owners: " + ", ".join(
+                        f"{op} {b / 1e6:.1f}MB" for op, b in owners)
+                out.append(Alert(HBM_PRESSURE, detail, dev, limit, ts))
             pressure_active = dev >= limit
+        elif ev == "buffer_alloc":
+            if r.get("kind") != "reservation":
+                heap[r.get("bid")] = (
+                    r.get("op") or "(unattributed)", r.get("bytes") or 0)
+        elif ev == "buffer_free":
+            heap.pop(r.get("bid"), None)
+            off_device.discard(r.get("bid"))
+        elif ev == "heap_snapshot":
+            leaked = r.get("leaked") or 0
+            if leaked and not leak_active:
+                out.append(Alert(
+                    BUFFER_LEAK, f"query {r.get('query_id')}",
+                    leaked, 1, ts))
+            leak_active = leaked > 0
         elif ev == "compile_miss":
             site = r.get("site", "?")
             win = site_win.setdefault(site, deque())

@@ -107,13 +107,19 @@ class Ticket:
 
     __slots__ = ("session", "digest", "forecast", "priority", "seq",
                  "event", "enqueue_ns", "admit_ns", "reservation",
-                 "verdict", "reason", "bypass")
+                 "verdict", "reason", "bypass", "forecast_source")
 
     def __init__(self, session: str, digest: str, forecast: Optional[int],
-                 priority: int, seq: int):
+                 priority: int, seq: int,
+                 forecast_source: str = "analyzer"):
         self.session = session
         self.digest = digest
         self.forecast = forecast
+        #: where the forecast figure came from: "analyzer" (static HLO
+        #: cost model) or "ledger" (a measured per-digest peak from the
+        #: HBM ledger replaced the static guess — the measured-stats
+        #: admission loop)
+        self.forecast_source = forecast_source
         self.priority = priority
         self.seq = seq
         self.event = threading.Event()
@@ -238,7 +244,8 @@ class QueryScheduler:
         if _events.enabled():
             _events.emit("admission", session=t.session, digest=t.digest,
                          verdict=verdict, forecast_bytes=t.forecast,
-                         free_bytes=free, reason=t.reason)
+                         free_bytes=free, reason=t.reason,
+                         forecast_source=t.forecast_source)
 
     def _emit_queue(self, t: Ticket, op: str, depth: int,
                     wait_ns: int = 0) -> None:
@@ -315,7 +322,8 @@ class QueryScheduler:
     # -- API ---------------------------------------------------------------
     def acquire(self, session: str, priority: int,
                 forecast: Optional[int], digest: str,
-                conf_: Optional[RapidsConf] = None) -> Ticket:
+                conf_: Optional[RapidsConf] = None,
+                forecast_source: str = "analyzer") -> Ticket:
         """Block until the query is admitted (or raise). The caller runs
         its host prefetch + drain after this returns and MUST pair it
         with :meth:`release` in a finally.
@@ -332,7 +340,8 @@ class QueryScheduler:
         timeout_ms = conf_.get(SERVE_QUEUE_TIMEOUT_MS)
         with self._lock:
             self._seq += 1
-            t = Ticket(session, digest, forecast, priority, self._seq)
+            t = Ticket(session, digest, forecast, priority, self._seq,
+                       forecast_source=forecast_source)
             if session not in self._rr_order:
                 self._rr_order.append(session)
                 self._queues.setdefault(session, collections.deque())
@@ -416,7 +425,8 @@ class QueryScheduler:
         return t
 
     def note_oom_requeue(self, session: str, digest: str,
-                         inflated_forecast: Optional[int]) -> None:
+                         inflated_forecast: Optional[int],
+                         forecast_source: str = "watermark") -> None:
         """Record one OOM-driven requeue (sql/session._collect_serve):
         the admitted query failed with a typed device-OOM despite the
         recovery plane, its reservation is already released, and it is
@@ -436,7 +446,8 @@ class QueryScheduler:
                 free_bytes=None,
                 reason="admitted query OOMed at runtime; requeued once "
                        "with forecast inflated to the observed peak "
-                       "watermark")
+                       "watermark",
+                forecast_source=forecast_source)
             _events.emit(
                 "oom_retry", op=f"serve {session}", kind="requeue",
                 attempt=1, depth=0, watermark=inflated_forecast,

@@ -137,7 +137,7 @@ class DeviceShuffleTransport(ShuffleTransport):
             victims = [k for k in self._catalog if k[0] == shuffle_id]
             entries = [e for k in victims for e in self._catalog.pop(k)]
         for _, (sv, _n, _bl) in entries:
-            sv.close()
+            sv.close(reason="shuffle_release")
 
 
 class SerializingTransportBase(ShuffleTransport):

@@ -505,7 +505,8 @@ class TpuSession:
     _PENDING_UNSET = object()
 
     def _run_collect(self, final: C.CpuExec, qid: Optional[int] = None,
-                     pending: Any = _PENDING_UNSET) -> List[tuple]:
+                     pending: Any = _PENDING_UNSET,
+                     digest: Optional[str] = None) -> List[tuple]:
         """Driver-side collect with the query_end event (duration + row
         count) paired to _execute's query_start. Emitted in a finally so a
         failing query still CLOSES its window — an unterminated
@@ -522,9 +523,21 @@ class TpuSession:
         if qid is None:
             qid = self._active_query
         obs_qid = self._obs_begin(pending)
+        # the HBM ledger's ownership window: buffers registered by this
+        # drain belong to this query; the sweep at close folds the
+        # observed peak into the per-digest admission feed and runs the
+        # leak sentinel. qid is None exactly when events+obs are off, so
+        # the off path never touches the ledger (zero-overhead contract).
+        from ..memory import ledger as _ledger
+
+        scope = _ledger.query_scope(qid) if qid is not None else None
         rows: Optional[List[tuple]] = None
         try:
-            rows = final.collect()
+            if scope is not None:
+                with scope:
+                    rows = final.collect()
+            else:
+                rows = final.collect()
             return rows
         finally:
             if self.events.enabled:
@@ -538,6 +551,9 @@ class TpuSession:
                     obs_qid,
                     rows=len(rows) if rows is not None else None,
                     error=rows is None)
+            if qid is not None:
+                _catalog.BufferCatalog.get().ledger.sweep_query(
+                    qid, digest=digest or self._last_digest)
 
     # -- serving path (serve/scheduler.py) ---------------------------------
     def _serve_enabled(self) -> bool:
@@ -569,19 +585,25 @@ class TpuSession:
         except TpuOOMError as e:
             from ..memory.catalog import BufferCatalog
 
-            # THIS query's observed need: the catalog watermark the
-            # typed error captured at its failure — NOT the process-
-            # lifetime peak_device_bytes, which an earlier heavy query
-            # pins forever and would inflate every later small query's
-            # requeue. Capped at the total budget so a transient OOM can
-            # never convert into a permanent ServeAdmissionRejected
-            # (acquire rejects forecasts above the budget outright).
-            observed = getattr(e, "watermark", None) or 0
-            budget, _, _ = BufferCatalog.get().admission_state()
+            # THIS query's observed need: the ledger's per-query peak
+            # when it tracked the failed attempt (the attributed figure
+            # — catalog-registered buffers this query actually owned),
+            # else the catalog watermark the typed error captured at its
+            # failure. NEVER the process-lifetime peak_device_bytes,
+            # which an earlier heavy query pins forever and would
+            # inflate every later small query's requeue. Capped at the
+            # total budget so a transient OOM can never convert into a
+            # permanent ServeAdmissionRejected (acquire rejects
+            # forecasts above the budget outright).
+            cat = BufferCatalog.get()
+            led_peak = cat.observed_query_peak(self._active_query)
+            observed = led_peak or getattr(e, "watermark", None) or 0
+            budget, _, _ = cat.admission_state()
             if budget is not None:
                 observed = min(observed, budget)
             QueryScheduler.get(self.conf).note_oom_requeue(
-                self.serve_id, self._last_digest or "", observed or None)
+                self.serve_id, self._last_digest or "", observed or None,
+                forecast_source="ledger" if led_peak else "watermark")
             return self._collect_serve_once(
                 node, forecast_floor=observed or None)
 
@@ -613,6 +635,17 @@ class TpuSession:
         # admission check needs: parquet plans forecast a peak (footer-
         # derived residency) without being fully bounded
         forecast = analysis.peak_hbm if analysis is not None else None
+        forecast_source = "analyzer"
+        # the measured-stats loop (ROADMAP 5a): once the HBM ledger has
+        # observed a completed run of this plan digest, its per-query
+        # peak replaces the static bound — admission charges what the
+        # plan was MEASURED to hold, not what the analyzer guessed
+        from ..memory.catalog import BufferCatalog as _BC
+
+        observed = _BC.get().ledger.observed_peak(digest)
+        if observed:
+            forecast = observed
+            forecast_source = "ledger"
         if forecast_floor is not None:
             forecast = max(forecast or 0, forecast_floor)
         try:
@@ -620,7 +653,8 @@ class TpuSession:
             # scheduler singleton may have been created by another one
             ticket = sched.acquire(
                 self.serve_id, self.conf.get(SERVE_PRIORITY), forecast,
-                digest, conf_=self.conf)
+                digest, conf_=self.conf,
+                forecast_source=forecast_source)
         except Exception:
             # a reject/timeout must still CLOSE the query_start window
             # _execute opened, or the offline profiler attributes every
@@ -635,7 +669,8 @@ class TpuSession:
                 # the shared pools, while whoever holds the semaphore
                 # keeps the device busy
                 final.tpu_child.host_prefetch()
-            rows = self._run_collect(final, qid=qid, pending=pending)
+            rows = self._run_collect(final, qid=qid, pending=pending,
+                                     digest=digest)
             if plan_key is not None:
                 SharedPlanCache.get().mark_warm(plan_key)
             return rows
@@ -749,8 +784,13 @@ class DataFrameWriter:
             # attribution lands on this query (and the finally below
             # guarantees the matching end)
             obs_qid = sess._obs_begin(obs_pending)
+            from ..memory import ledger as _ledger
+
+            scope = _ledger.query_scope(qid) if qid is not None else None
             ok = False
             try:
+                if scope is not None:
+                    scope.__enter__()
                 if isinstance(final, ColumnarToRowExec):
                     # columnar fast path: hand device batches to the writer
                     yield from final.tpu_child.execute_columnar()
@@ -770,6 +810,8 @@ class DataFrameWriter:
                         yield batch_from_rows(buf, schema)
                 ok = True
             finally:
+                if scope is not None:
+                    scope.__exit__(None, None, None)
                 if sess.events.enabled:
                     # writer path: duration only (a row count would force
                     # a device sync per batch just for logging); the
@@ -779,6 +821,9 @@ class DataFrameWriter:
                                  rows=None, error=not ok)
                 if obs_qid is not None:
                     _obs.note_query_end(obs_qid, rows=None, error=not ok)
+                if qid is not None:
+                    _catalog.BufferCatalog.get().ledger.sweep_query(
+                        qid, digest=sess._last_digest)
 
         return gen(), schema
 

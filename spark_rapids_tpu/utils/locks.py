@@ -76,6 +76,14 @@ LOCK_ORDER = (
     # spillable-buffer registry: spill decisions + reservation
     # accounting; re-entrant (spill paths re-enter through handles)
     "memory.catalog",
+    # device scan-cache entry table: put/evict call into the HBM ledger
+    # (entries carry owner tags) and the event/obs leaf sinks while
+    # held; OOM recovery calls drop_under_pressure with no lock above
+    "io.scan_cache",
+    # per-buffer HBM ledger (owner attribution + leak sentinel): fed by
+    # the catalog under ITS lock and by the scan cache, emits into the
+    # event/obs leaf sinks — so it sits between the two
+    "memory.ledger",
     # TpuSemaphore's holder table (who to blame on acquire timeout)
     "memory.semaphore_holders",
     # -- leaf sinks: pure accounting, must never call out while held --

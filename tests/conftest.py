@@ -59,6 +59,31 @@ def pytest_configure(config):
         "(-m 'not slow'); dedicated CI jobs run these files unfiltered")
 
 
+@pytest.fixture(autouse=True)
+def _hbm_leak_guard():
+    """Harness teardown twin of the HBM ledger's leak sentinel: any test
+    whose queries left sentinel-flagged buffers live fails HERE, by
+    name, instead of poisoning a later test's catalog state. Peeks only
+    (no catalog is conjured for tests that never touched memory); a test
+    that DELIBERATELY leaks must reset the BufferCatalog itself."""
+    yield
+    from spark_rapids_tpu.memory.catalog import BufferCatalog
+
+    cat = BufferCatalog._instance
+    if cat is None:
+        return
+    leaked = cat.ledger.stats()["leaked_live"]
+    if leaked:
+        leaks = cat.ledger.live_leaks()
+        BufferCatalog.reset()  # don't cascade into the next test
+        raise AssertionError(
+            f"HBM leak sentinel: {leaked} buffer(s) outlived their "
+            "owning query: " + ", ".join(
+                f"{r.get('op') or '(unattributed)'} {r['bytes']}B "
+                f"from {r['site']} (query {r.get('query_id')})"
+                for r in leaks[:5]))
+
+
 def pytest_collection_modifyitems(config, items):
     if not ON_TPU:
         return

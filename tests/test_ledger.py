@@ -1,0 +1,549 @@
+"""HBM ledger: per-buffer lifecycle attribution + leak sentinel.
+
+Pins this PR's acceptance contracts:
+  1. zero-overhead-off: with events+obs off and no force arm, a full
+     register/spill/unspill/close lifecycle builds NO ledger record and
+     touches NO registry method (the PR 5/6 contract, mirrored);
+  2. lifecycle round-trip: every registered buffer emits buffer_alloc
+     with its owner tag (op, query id, creation site, origin digest),
+     bid-stamped spill/unspill hops, and buffer_free with a reason; the
+     query-end sweep emits heap_snapshot — and tools/tpu_heap.py
+     reconstructs the same peak/churn/ownership story from the log;
+  3. the leak sentinel flags a deliberately-pinned buffer at query end
+     (ledger, watchdog alert, live counter) and stays quiet for clean
+     queries, declared plan state, scan-cache entries, reservations;
+  4. close is idempotent and a spilled buffer's free reconciles (no
+     double-free, no phantom device-live bytes);
+  5. attribution holds under concurrent sessions: records carry the
+     owning thread's (tid, query_id);
+  6. the admission feed (ROADMAP 5a): swept per-query peaks fold into
+     the per-digest history the serve scheduler consumes, and admission
+     events carry forecast_source.
+"""
+import importlib.util
+import json
+import os
+import threading
+
+import jax.numpy as jnp
+import pytest
+
+from spark_rapids_tpu import events as EV
+from spark_rapids_tpu import obs
+from spark_rapids_tpu import xla_cost
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.memory import SpillableHandle, TIER_HOST
+from spark_rapids_tpu.memory import ledger as L
+from spark_rapids_tpu.memory.catalog import BufferCatalog
+from spark_rapids_tpu.memory.ledger import Ledger, query_scope
+from spark_rapids_tpu.memory.spillable import SpillableVals
+from spark_rapids_tpu.obs.registry import MetricsRegistry
+from spark_rapids_tpu.obs.server import build_status
+from spark_rapids_tpu.obs.watchdog import (
+    Watchdog,
+    WatchdogRules,
+    replay_alerts,
+)
+from spark_rapids_tpu.serve import QueryScheduler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+tpu_heap = _load_tool("tpu_heap")
+tpu_top = _load_tool("tpu_top")
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    """Both planes down, no force arm, fresh catalog on both sides."""
+    obs.shutdown()
+    obs.uninstall()
+    EV.uninstall()
+    L.force_arm(False)
+    BufferCatalog.reset()
+    QueryScheduler.reset()
+    yield
+    obs.shutdown()
+    obs.uninstall()
+    EV.uninstall()
+    L.force_arm(False)
+    BufferCatalog.reset()
+    QueryScheduler.reset()
+
+
+def _cat(budget=None):
+    conf = {}
+    if budget is not None:
+        conf["spark.rapids.tpu.memory.hbm.budgetBytes"] = budget
+    return BufferCatalog.reset(RapidsConf(conf))
+
+
+def _handle(cat, nbytes=4096, priority=0, **kw):
+    return SpillableHandle(
+        {"d": jnp.zeros(nbytes // 4, jnp.int32)}, priority, cat, **kw)
+
+
+def _logger(tmp_path):
+    logger = EV.EventLogger(RapidsConf(
+        {"spark.rapids.tpu.eventLog.dir": str(tmp_path)}))
+    EV.install(logger)
+    return logger
+
+
+# ---------------------------------------------------------------------------
+# 1. zero-overhead-off
+# ---------------------------------------------------------------------------
+def test_zero_overhead_when_both_planes_off(monkeypatch):
+    """The spy: with events+obs off and no force arm, a full lifecycle
+    (register -> pressure spill -> unspill -> close) must not build one
+    ledger record, emit one event, or touch one registry method."""
+    def _boom(name):
+        def fail(*a, **k):
+            raise AssertionError(f"{name} touched while planes off")
+        return fail
+
+    monkeypatch.setattr(Ledger, "note_alloc", _boom("Ledger.note_alloc"))
+    monkeypatch.setattr(EV.EventLogger, "emit", _boom("EventLogger.emit"))
+    for m in ("inc", "set_gauge", "set_gauge_max", "observe",
+              "span_open", "note_compile_miss"):
+        monkeypatch.setattr(MetricsRegistry, m, _boom(f"registry.{m}"))
+
+    cat = _cat(budget=10_000)
+    assert not cat.ledger.armed()
+    low = _handle(cat, 4096, priority=-50)
+    high = _handle(cat, 4096)
+    third = _handle(cat, 4096, priority=10)  # forces low to spill
+    assert low.tier == TIER_HOST
+    low.materialize()                        # unspill hop
+    for h in (low, high, third):
+        h.close()
+    st = cat.ledger.stats()
+    assert st == {"allocs": 0, "frees": 0, "tracked": 0,
+                  "live_bytes": 0, "leaked_live": 0, "leaked_total": 0}
+    assert low._lid is None and high._lid is None
+
+
+# ---------------------------------------------------------------------------
+# 2. lifecycle round-trip: events, owner tags, and the offline profiler
+# ---------------------------------------------------------------------------
+def test_lifecycle_events_round_trip(tmp_path):
+    logger = _logger(tmp_path)
+    cat = _cat(budget=10_000)
+    with query_scope("q1"), xla_cost.op_scope("TpuSortExec"):
+        low = _handle(cat, 4096, priority=-50)
+        high = _handle(cat, 4096)
+        third = _handle(cat, 4096, priority=10)  # low spills to host
+        assert low.tier == TIER_HOST
+        low.materialize()                        # unspill; high spills
+        for h in (low, high, third):
+            h.close()
+    leaks = cat.ledger.sweep_query("q1", digest="dg-rt")
+    assert leaks == []
+
+    recs = logger.records()
+    allocs = [r for r in recs if r["event"] == "buffer_alloc"]
+    frees = [r for r in recs if r["event"] == "buffer_free"]
+    spills = [r for r in recs if r["event"] == "spill"]
+    snaps = [r for r in recs if r["event"] == "heap_snapshot"]
+
+    assert len(allocs) == 3 and len(frees) == 3
+    for r in allocs:
+        assert r["kind"] == "spillable" and r["bytes"] == 4096
+        assert r["op"] == "TpuSortExec" and r["query_id"] == "q1"
+        assert "test_ledger.py:" in r["site"]
+        assert len(r["origin"]) == 12
+    # every free names a reason and pairs a recorded alloc by bid
+    assert {r["reason"] for r in frees} == {"close"}
+    assert {r["bid"] for r in frees} == {r["bid"] for r in allocs}
+    # spill hops are bid-stamped: low out, low back in, high out
+    assert [(r["kind"], r["bid"] is not None) for r in spills] == [
+        ("device_to_host", True), ("unspill", True),
+        ("device_to_host", True)]
+    assert spills[0]["bid"] == spills[1]["bid"]
+    # the sweep's snapshot closes the story: empty heap, nothing leaked
+    assert len(snaps) == 1
+    assert snaps[0]["query_id"] == "q1" and snaps[0]["leaked"] == 0
+    assert snaps[0]["live_bytes"] == 0
+
+    st = cat.ledger.stats()
+    assert st["allocs"] == 3 and st["frees"] == 3
+    assert st["tracked"] == 0 and st["live_bytes"] == 0
+
+    # the offline profiler reconstructs the same story from the log
+    t = tpu_heap.build_timeline(recs)
+    assert t.peak_bytes == 12288
+    assert t.peak_by_op == {"TpuSortExec": 12288}
+    assert t.unattributed_fraction() == 0.0
+    assert t.churn_by_op == {"TpuSortExec": 8192}
+    assert t.free_reasons == {"close": 3}
+    assert t.end_leaks() == [] and t.sentinel_leaks == 0
+    report = tpu_heap.build_report(t)
+    assert "top owners at peak: TpuSortExec" in report
+    assert "no leaks" in report
+
+    # and the watchdog replay twin names the owner when the spill
+    # watermark crosses the pressure line (budget 9000 -> limit 7650)
+    alerts = replay_alerts(recs, WatchdogRules(), budget=9_000)
+    pressure = [a for a in alerts if a.kind == "hbm_pressure"]
+    assert len(pressure) == 1  # one episode, not one per spill event
+    assert "top owners: TpuSortExec" in pressure[0].detail
+    assert not [a for a in alerts if a.kind == "buffer_leak"]
+
+
+def test_live_gauge_and_leak_counter_twins():
+    reg = MetricsRegistry()
+    obs.install(reg)
+    cat = _cat()
+    with query_scope("qg"), xla_cost.op_scope("TpuHashJoinExec"):
+        h = _handle(cat, 8192)
+        assert reg.value("tpu_hbm_bytes", op="TpuHashJoinExec") == 8192
+        h.close()
+        assert reg.value("tpu_hbm_bytes", op="TpuHashJoinExec") == 0
+        pinned = _handle(cat, 4096)
+    assert cat.ledger.sweep_query("qg")  # pinned outlived the query
+    assert reg.value("tpu_hbm_leaked_buffers") == 1
+    pinned.close()
+    assert cat.ledger.stats()["leaked_live"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 3. leak sentinel
+# ---------------------------------------------------------------------------
+def test_leak_sentinel_flags_pinned_buffer_and_watchdog_alerts():
+    L.force_arm(True)
+    cat = _cat()
+    with query_scope("qA"), xla_cost.op_scope("TpuSortExec"):
+        pinned = _handle(cat, 8192)
+        closed = _handle(cat, 4096)
+        closed.close()
+    leaks = cat.ledger.sweep_query("qA")
+    assert len(leaks) == 1
+    assert leaks[0]["query_id"] == "qA" and leaks[0]["bytes"] == 8192
+    assert leaks[0]["op"] == "TpuSortExec"
+    assert "test_ledger.py:" in leaks[0]["site"]
+    assert cat.ledger.stats()["leaked_live"] == 1
+    assert cat.ledger.live_leaks()[0]["lid"] == leaks[0]["lid"]
+    # re-sweeping the same query does not double-flag
+    assert cat.ledger.sweep_query("qA") == []
+    assert cat.ledger.stats()["leaked_total"] == 1
+
+    # the live watchdog surfaces it, naming op/bytes/query
+    wd = Watchdog(MetricsRegistry(), WatchdogRules())
+    alerts = [a for a in wd.check_now() if a.kind == "buffer_leak"]
+    assert len(alerts) == 1 and alerts[0].value == 1
+    assert "TpuSortExec" in alerts[0].detail
+    assert "qA" in alerts[0].detail
+    assert "outlived the owning query" in alerts[0].describe()
+    # the alert stays active (not re-raised) while the leak lives...
+    assert not wd.check_now()
+    # ...and clears when the buffer is actually freed
+    pinned.close()
+    assert cat.ledger.stats()["leaked_live"] == 0
+    wd2 = Watchdog(MetricsRegistry(), WatchdogRules())
+    assert not [a for a in wd2.check_now() if a.kind == "buffer_leak"]
+
+
+def test_sentinel_exempts_declared_plan_state_cache_and_reservations():
+    from spark_rapids_tpu.expr.values import ColV
+
+    L.force_arm(True)
+    cat = _cat()
+    with query_scope("qB"):
+        build = _handle(cat, 4096, ledger_kind="plan_state")
+        sv = SpillableVals(
+            [ColV(jnp.zeros(64, jnp.int64), jnp.ones(64, jnp.bool_))],
+            catalog=cat, ledger_kind="plan_state")
+        rid = cat.reserve(2048, label="admission")
+        cache_lid = cat.ledger.note_alloc(1024, kind=L.KIND_SCAN_CACHE)
+    assert cat.ledger.sweep_query("qB") == []
+    assert cat.ledger.stats()["leaked_live"] == 0
+    # reservations are bookkeeping, not device residency
+    assert cat.ledger.snapshot()["live_bytes"] == \
+        cat.ledger.stats()["live_bytes"]
+    build.close()
+    sv.close()
+    cat.release_reservation(rid)
+    cat.ledger.note_free(cache_lid, reason="evict")
+    assert cat.ledger.stats()["tracked"] == 0
+    assert cat.ledger.stats()["live_bytes"] == 0
+
+
+def test_harness_guard_catches_deliberate_leak():
+    """The conftest teardown twin: prove it actually trips (then reset
+    the catalog ourselves, exactly as a deliberately-leaking test
+    must)."""
+    L.force_arm(True)
+    cat = _cat()
+    with query_scope("qX"):
+        _handle(cat, 4096)
+    cat.ledger.sweep_query("qX")
+    assert cat.ledger.stats()["leaked_live"] == 1
+    BufferCatalog.reset()  # what the guard demands of a leaking test
+
+
+# ---------------------------------------------------------------------------
+# 4. reconciliation: idempotent close, spilled free, no phantom bytes
+# ---------------------------------------------------------------------------
+def test_double_close_and_spilled_close_reconcile():
+    L.force_arm(True)
+    cat = _cat(budget=10_000)
+    with query_scope("qC"):
+        low = _handle(cat, 4096, priority=-50)
+        high = _handle(cat, 4096)
+        third = _handle(cat, 4096, priority=10)
+        assert low.tier == TIER_HOST  # spilled: off-device in the ledger
+        low.close(reason="split")     # freeing a HOST buffer...
+        # ...must not deduct device-live bytes it no longer holds
+        assert cat.ledger.stats()["live_bytes"] == 8192
+        low.close(reason="split")     # idempotent: one free, not two
+        assert cat.ledger.stats()["frees"] == 1
+        high.close()
+        third.close()
+    assert cat.ledger.sweep_query("qC") == []
+    st = cat.ledger.stats()
+    assert st["allocs"] == 3 and st["frees"] == 3
+    assert st["live_bytes"] == 0 and st["tracked"] == 0
+    assert cat.ledger.snapshot()["by_op"] == {}
+
+
+def test_concurrent_queries_attribute_by_tid_and_query_id():
+    L.force_arm(True)
+    cat = _cat()
+    handles, tids = {}, {}
+    barrier = threading.Barrier(2)
+
+    def run(qid):
+        barrier.wait()
+        with query_scope(qid):
+            handles[qid] = _handle(cat, 4096)
+            tids[qid] = threading.get_ident()
+
+    threads = [threading.Thread(target=run, args=(f"q{i}",))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    for qid in ("q0", "q1"):
+        leaks = cat.ledger.sweep_query(qid)
+        assert len(leaks) == 1, f"{qid} swept {len(leaks)} records"
+        assert leaks[0]["query_id"] == qid
+        assert leaks[0]["tid"] == tids[qid]
+    for h in handles.values():
+        h.close()
+    assert cat.ledger.stats()["leaked_live"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 5. admission feed: observed peaks + forecast_source
+# ---------------------------------------------------------------------------
+def test_sweep_folds_query_peak_into_digest_history():
+    L.force_arm(True)
+    cat = _cat()
+    with query_scope("qq"):
+        a = _handle(cat, 8192)
+        b = _handle(cat, 4096)
+        b.close()
+        a.close()
+    assert cat.ledger.sweep_query("qq", digest="dg") == []
+    assert cat.ledger.observed_peak("dg") == 12288
+    assert cat.ledger.query_peak("qq") == 12288  # survives the sweep
+    assert cat.observed_query_peak("qq") == 12288
+    # a smaller later run never lowers the digest's observed peak
+    with query_scope("qr"):
+        c = _handle(cat, 4096)
+        c.close()
+    cat.ledger.sweep_query("qr", digest="dg")
+    assert cat.ledger.observed_peak("dg") == 12288
+    assert cat.ledger.observed_peak(None) is None
+
+
+def test_admission_events_carry_forecast_source(tmp_path):
+    logger = _logger(tmp_path)
+    _cat(budget=1 << 20)
+    sched = QueryScheduler.reset(RapidsConf({}))
+    t = sched.acquire("sess-a", 0, 500_000, "d1",
+                      forecast_source="ledger")
+    assert t.forecast_source == "ledger"
+    sched.release(t)
+    sched.note_oom_requeue("sess-a", "d1", 600_000)
+    adm = [r for r in logger.records() if r["event"] == "admission"]
+    assert [r["forecast_source"] for r in adm] == ["ledger", "watermark"]
+    assert adm[1]["verdict"] == "requeue"
+
+
+# ---------------------------------------------------------------------------
+# 6. surfaces: /status block, tpu_top panel, explain footer, op peaks
+# ---------------------------------------------------------------------------
+def test_status_heap_block_and_surfaces():
+    from spark_rapids_tpu.exec.base import memory_footer
+    from spark_rapids_tpu.obs.progress import ProgressTracker
+
+    L.force_arm(True)
+    cat = _cat()
+    with query_scope("qs"), xla_cost.op_scope("TpuSortExec"):
+        h = _handle(cat, 8192)
+    st = build_status(MetricsRegistry(), ProgressTracker(), None)
+    heap = st["heap"]
+    json.dumps(st)  # the whole payload must stay JSON-serializable
+    assert heap["live_bytes"] == 8192
+    assert heap["by_op"] == {"TpuSortExec": 8192}
+    assert heap["top"] == [["TpuSortExec", 8192]]
+    assert heap["leaked"] == 0 and heap["tracked"] == 1
+    assert heap["allocs"] == 1 and heap["frees"] == 0
+
+    # tpu_top renders the block (and the leak line when flagged)
+    cat.ledger.sweep_query("qs")
+    status = {"hbm": {}, "heap": cat.ledger.status_block(),
+              "alerts": [], "metrics": {}}
+    text = tpu_top.render_status(status, clock="12:00:00")
+    assert "heap 0.0MB attributed — top: TpuSortExec 0.0MB" in text
+    assert "heap LEAKS: 1 live (1 total flagged)" in text
+
+    # explain_metrics' memory footer decomposes the peak by op
+    footer = memory_footer()
+    assert "memory by op (peak): TpuSortExec 0.0MB" in footer
+    assert "LEAKED 1 buffer(s)" in footer
+
+    h.close()
+    assert cat.ledger.stats()["leaked_live"] == 0
+    # rebase (the bench per-shape window) drops the freed peak
+    cat.ledger.rebase_peaks()
+    assert cat.ledger.op_peaks() == {}
+    assert "memory by op" not in memory_footer()
+
+
+def test_event_schema_and_metric_twins_pinned():
+    assert EV.EVENT_TYPES["buffer_alloc"] == (
+        "bid", "kind", "bytes", "op", "query_id", "site", "origin")
+    assert EV.EVENT_TYPES["buffer_free"] == (
+        "bid", "kind", "bytes", "reason", "op", "query_id")
+    assert EV.EVENT_TYPES["heap_snapshot"] == (
+        "query_id", "live_bytes", "by_op", "top", "leaked")
+    assert "forecast_source" in EV.EVENT_OPTIONAL_FIELDS["admission"]
+    assert "bid" in EV.EVENT_OPTIONAL_FIELDS["spill"]
+    assert obs.EVENT_BACKED_METRICS["buffer_alloc"] == "tpu_hbm_bytes"
+    assert obs.EVENT_BACKED_METRICS["buffer_free"] == "tpu_hbm_bytes"
+    assert obs.EVENT_BACKED_METRICS["heap_snapshot"] == \
+        "tpu_hbm_leaked_buffers"
+    # the exempt-kind lists cannot drift between the ledger and the tool
+    assert set(tpu_heap.LEAK_EXEMPT_KINDS) == set(L.SWEEP_EXEMPT_KINDS)
+
+
+# ---------------------------------------------------------------------------
+# 7. offline tools: tpu_heap snapshot/diff/gates, replay leak episodes
+# ---------------------------------------------------------------------------
+def _synth_events():
+    MB = 1 << 20
+    return [
+        {"event": "buffer_alloc", "ts": 100, "bid": 1, "kind": "spillable",
+         "bytes": 6 * MB, "op": "TpuSortExec", "site": "exec/sort.py:10",
+         "query_id": "s1"},
+        {"event": "buffer_alloc", "ts": 200, "bid": 2, "kind": "spillable",
+         "bytes": 5 * MB, "op": "TpuHashJoinExec",
+         "site": "exec/join.py:20", "query_id": "s1"},
+        {"event": "buffer_alloc", "ts": 250, "bid": 3,
+         "kind": "reservation", "bytes": 99 * MB, "op": None,
+         "site": "serve/scheduler.py:1", "query_id": None},
+        {"event": "spill", "ts": 300, "kind": "device_to_host",
+         "bytes": 5 * MB, "device_bytes": 6 * MB, "bid": 2},
+        {"event": "buffer_free", "ts": 400, "bid": 2, "kind": "spillable",
+         "bytes": 5 * MB, "reason": "close", "op": "TpuHashJoinExec",
+         "query_id": "s1"},
+        {"event": "buffer_free", "ts": 500, "bid": 1, "kind": "spillable",
+         "bytes": 6 * MB, "reason": "close", "op": "TpuSortExec",
+         "query_id": "s1"},
+        {"event": "heap_snapshot", "ts": 600, "query_id": "s1",
+         "live_bytes": 0, "by_op": {}, "top": [], "leaked": 0},
+    ]
+
+
+def test_tpu_heap_timeline_snapshot_and_cli(tmp_path, capsys):
+    MB = 1 << 20
+    events = _synth_events()
+    t = tpu_heap.build_timeline(events)
+    assert t.peak_bytes == 11 * MB  # the reservation never counts
+    assert t.peak_by_op == {"TpuSortExec": 6 * MB,
+                            "TpuHashJoinExec": 5 * MB}
+    assert t.churn_by_op == {"TpuHashJoinExec": 5 * MB}
+    assert t.end_leaks() == [] and t.sentinel_leaks == 0
+
+    # --at: bid 2 is off-device at ts 350, so only the sort owns bytes
+    mid = tpu_heap.snapshot_at(events, 350)
+    assert mid._by_op() == {"TpuSortExec": 6 * MB}
+    assert "1 spilled" in tpu_heap.build_snapshot_report(mid, 350)
+
+    p = str(tmp_path / "log.jsonl")
+    with open(p, "w") as f:
+        for r in events:
+            f.write(json.dumps(r) + "\n")
+    rc = tpu_heap.main([p, "--fail-on-leaks", "--max-unattributed",
+                        "0.01"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "top owners at peak: TpuSortExec 6.29MB" in out
+    assert "unattributed at peak: 0.00%" in out
+
+    # a log whose query never swept a live buffer fails the leak gate
+    leaky = events[:4]  # bid 1 still live, bid 2 spilled but live
+    p2 = str(tmp_path / "leaky.jsonl")
+    with open(p2, "w") as f:
+        for r in leaky:
+            f.write(json.dumps(r) + "\n")
+    assert tpu_heap.main([p2]) == 0              # report-only: no gate
+    assert tpu_heap.main([p2, "--fail-on-leaks"]) == 1
+    capsys.readouterr()
+
+
+def test_tpu_heap_diff_gates_per_op_growth_with_noise_floor():
+    MB = 1 << 20
+
+    def tl(op_peaks):
+        t = tpu_heap.HeapTimeline()
+        t.op_peak = dict(op_peaks)
+        t.peak_bytes = sum(op_peaks.values())
+        return t
+
+    # +3MB on a 6MB op (>20% and >1MB): regression
+    text, bad = tpu_heap.diff_heap(
+        tl({"TpuSortExec": 6 * MB}), tl({"TpuSortExec": 9 * MB}), 0.2)
+    assert bad == 1 and "REGRESSION TpuSortExec" in text
+    # +0.5MB: above 20% relative but under the absolute jitter floor
+    _, bad = tpu_heap.diff_heap(
+        tl({"TpuSortExec": 2 * MB}),
+        tl({"TpuSortExec": 2 * MB + MB // 2}), 0.2)
+    assert bad == 0
+    # +100MB on a 1GB op: huge absolute, under the relative threshold
+    _, bad = tpu_heap.diff_heap(
+        tl({"TpuSortExec": 1024 * MB}), tl({"TpuSortExec": 1124 * MB}),
+        0.2)
+    assert bad == 0
+    # a brand-new op needs only the absolute floor
+    text, bad = tpu_heap.diff_heap(
+        tl({}), tl({"TpuExpandExec": 2 * MB}), 0.2)
+    assert bad == 1 and "(new op)" in text
+    # an end-of-log leak count regression gates regardless of peaks
+    new = tl({})
+    new.live[7] = {"op": "TpuSortExec", "site": "s", "bytes": MB,
+                   "kind": "spillable", "query_id": "q", "ts": 0}
+    text, bad = tpu_heap.diff_heap(tl({}), new, 0.2)
+    assert bad == 1 and "REGRESSION leaks: 0 -> 1" in text
+
+
+def test_replay_leak_alert_episode_semantics():
+    mk = lambda ts, leaked: {
+        "event": "heap_snapshot", "ts": ts, "query_id": f"q{ts}",
+        "live_bytes": 0, "by_op": {}, "top": [], "leaked": leaked}
+    alerts = replay_alerts(
+        [mk(1, 2), mk(2, 2), mk(3, 0), mk(4, 1)], WatchdogRules())
+    leaks = [a for a in alerts if a.kind == "buffer_leak"]
+    # one per episode: 2-leak episode, cleared, then a fresh 1-leak one
+    assert [(a.value, a.ts) for a in leaks] == [(2, 1), (1, 4)]

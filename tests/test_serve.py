@@ -680,7 +680,7 @@ def test_scan_cache_accounting_consistent_under_race():
     st = cache.stats()
     # byte accounting stayed single-entry: resident == sum over entries
     with cache._lock:
-        real = sum(sz for (_, sz) in cache._entries.values())
+        real = sum(sz for (_, sz, _lid) in cache._entries.values())
     assert st["bytes"] == real
     assert st["bytes"] <= st["max_bytes"]
 

@@ -92,6 +92,9 @@ DIFF_MIN_MS = 1.0
 #: run ~2e-4..6e-3 on the CPU fallback, or the gate is dead exactly
 #: where CI runs it)
 DIFF_MIN_FRAC = 1e-4
+#: per-op HBM peak growth below this many bytes is allocator jitter
+#: (padding, pool rounding), not an operator holding more memory
+DIFF_MIN_HBM_BYTES = 1 << 20
 
 #: per-backend (peak HBM GB/s, peak TFLOP/s) used when --peak-hbm-gbps /
 #: --peak-tflops are not given; MUST mirror
@@ -1137,6 +1140,32 @@ def diff_bench(old: dict, new: dict, threshold: float
             else:
                 lines.append(f"  {shape}.xla_peak_temp_bytes: ok "
                              f"{pa} -> {pb}")
+        # per-op HBM peak (the ledger's per-shape attribution,
+        # bench._mem_stats hbm_peak_by_op): any single op's peak growing
+        # beyond the threshold AND the 1MiB jitter floor means that
+        # operator started holding more device memory at once — gated
+        # same-lowering only (a strategy flip redraws who holds what)
+        ha, hb = a.get("hbm_peak_by_op"), b.get("hbm_peak_by_op")
+        if isinstance(ha, dict) and isinstance(hb, dict) and same_lowering:
+            for op in sorted(set(ha) | set(hb)):
+                oa, ob = ha.get(op) or 0, hb.get(op) or 0
+                if ob - oa <= DIFF_MIN_HBM_BYTES:
+                    continue
+                if oa and ob / oa <= 1.0 + threshold:
+                    continue
+                regressions += 1
+                lines.append(
+                    f"  {shape}.hbm_peak_by_op[{op}]: REGRESSION "
+                    f"{oa} -> {ob} bytes"
+                    + (f" ({ob / oa:.2f}x)" if oa else " (new op)"))
+        # leaked buffers are an absolute gate, not a diff: any nonzero
+        # count in the NEW run fails regardless of the old run
+        leaked_new = b.get("leaked_buffers")
+        if leaked_new:
+            regressions += 1
+            lines.append(f"  {shape}.leaked_buffers: REGRESSION "
+                         f"{leaked_new} buffer(s) outlived the query "
+                         "(must be 0)")
         ka, kb = a.get("hlo_scatter_count"), b.get("hlo_scatter_count")
         if ka is not None and kb is not None:
             # growth is gated only when NEITHER lowering changed (agg

@@ -11,7 +11,10 @@ interval:
     forecasts (an unbounded op shows its counts without a bar);
   * the HBM watermark vs the derived budget (the same derive_hbm_budget
     the spiller and the plan analyzer use) and the spill story;
-  * watchdog alerts (stall / hbm_pressure / recompile_storm);
+  * the HBM ledger's heap panel: live bytes by owning op and the leak
+    sentinel's tally (present when the ledger is armed);
+  * watchdog alerts (stall / hbm_pressure / recompile_storm /
+    retry_storm / buffer_leak);
   * a counter footer: compile misses, shuffle traffic, scan-cache hit
     rate, host-link transfers.
 
@@ -81,6 +84,22 @@ def render_status(status: dict, clock: str = "") -> str:
         f"{_mb(budget) if budget else 'unlimited'} "
         f"(peak {_mb(hbm.get('peak_device_bytes', 0))}, "
         f"spilled {_mb(hbm.get('spilled_bytes', 0))})")
+
+    # per-buffer heap panel (the HBM ledger's /status block): who owns
+    # the live bytes, and whether the leak sentinel has flagged anything
+    heap = status.get("heap") or {}
+    if heap.get("live_bytes") or heap.get("leaked") \
+            or heap.get("leaked_total"):
+        owners = ", ".join(f"{op} {_mb(b)}"
+                           for op, b in (heap.get("top") or [])) or "none"
+        lines.append(
+            f"heap {_mb(heap.get('live_bytes', 0))} attributed — "
+            f"top: {owners}")
+        leaked = heap.get("leaked", 0)
+        if leaked or heap.get("leaked_total"):
+            lines.append(
+                f"heap LEAKS: {leaked} live "
+                f"({heap.get('leaked_total', 0)} total flagged)")
 
     alerts = status.get("alerts") or []
     for a in alerts[-5:]:
